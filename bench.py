@@ -1,0 +1,95 @@
+"""Benchmark: covering-index build throughput (rows/sec/chip).
+
+Generates a TPC-H-lineitem-like table, builds a covering index through the
+full API (decode -> device hash+sort kernel -> bucketed parquet write), and
+reports end-to-end build throughput per chip.
+
+Baseline (BASELINE.md): >= 1,000,000 rows/sec/chip; ``vs_baseline`` is
+value / 1e6.
+
+Prints exactly ONE JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def make_lineitem_like(root: str, num_rows: int, num_files: int = 8) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(0)
+    per = num_rows // num_files
+    base = np.datetime64("1992-01-01")
+    for i in range(num_files):
+        table = pa.table(
+            {
+                "l_orderkey": rng.integers(0, num_rows // 4, per).astype(np.int64),
+                "l_partkey": rng.integers(0, 200_000, per).astype(np.int64),
+                "l_quantity": rng.integers(1, 50, per).astype(np.int64),
+                "l_extendedprice": rng.uniform(900.0, 105000.0, per),
+                "l_discount": rng.uniform(0.0, 0.1, per),
+                "l_shipdate": base + rng.integers(0, 2500, per).astype("timedelta64[D]"),
+            }
+        )
+        pq.write_table(table, os.path.join(root, f"part-{i:05d}.parquet"))
+
+
+def main() -> None:
+    num_rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_")
+    try:
+        data_dir = os.path.join(tmp, "lineitem")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        make_lineitem_like(data_dir, num_rows)
+
+        import jax
+
+        import hyperspace_tpu as hst
+
+        sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sys_dir, hst.keys.NUM_BUCKETS: 64})
+        hst.set_session(sess)
+        hs = hst.Hyperspace(sess)
+        df = sess.read_parquet(data_dir)
+
+        # warm up compile on a tiny build so jit time isn't billed
+        warm_dir = os.path.join(tmp, "warm")
+        os.makedirs(warm_dir)
+        make_lineitem_like(warm_dir, 10_000, 1)
+        warm_df = sess.read_parquet(warm_dir)
+        hs.create_index(warm_df, hst.CoveringIndexConfig("warm", ["l_orderkey"], ["l_extendedprice"]))
+
+        t0 = time.perf_counter()
+        hs.create_index(
+            df, hst.CoveringIndexConfig("bench_idx", ["l_orderkey"], ["l_extendedprice", "l_discount"])
+        )
+        dt = time.perf_counter() - t0
+
+        n_chips = max(1, len(jax.devices()))
+        rows_per_sec_per_chip = num_rows / dt / n_chips
+        print(
+            json.dumps(
+                {
+                    "metric": "covering_index_build_rows_per_sec_per_chip",
+                    "value": round(rows_per_sec_per_chip, 1),
+                    "unit": "rows/s/chip",
+                    "vs_baseline": round(rows_per_sec_per_chip / 1_000_000.0, 4),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

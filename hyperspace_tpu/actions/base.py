@@ -1,0 +1,111 @@
+"""Action FSM.
+
+Every lifecycle operation is an Action sharing one protocol
+(ref: HS/actions/Action.scala:34-108):
+
+    run() = validate() -> begin()  [write transient-state entry at base_id+1]
+            -> op()                [the actual work]
+            -> end()               [write final-state entry at base_id+2,
+                                    recreate latestStable]
+
+with telemetry events at start/success/failure. Optimistic concurrency: the
+transient-entry write fails if another writer took the id first
+(ref: Action.scala:49-55; IndexLogManager.scala:178-194). A failure mid-op
+abandons the transient state; CancelAction recovers to the last stable state
+(ref: HS/actions/CancelAction.scala:35-67).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from hyperspace_tpu.models import states
+from hyperspace_tpu.models.data_manager import IndexDataManager
+from hyperspace_tpu.models.log_entry import IndexLogEntry
+from hyperspace_tpu.models.log_manager import IndexLogManager
+from hyperspace_tpu.telemetry.events import ActionEvent, get_event_logger
+
+
+class HyperspaceActionException(Exception):
+    pass
+
+
+class ConcurrentModificationException(HyperspaceActionException):
+    pass
+
+
+class NoChangesException(HyperspaceActionException):
+    """Signals a no-op refresh/optimize (ref: HS/actions/NoChangesException.scala)."""
+
+
+class Action:
+    transient_state: str = ""
+    final_state: str = ""
+    event_class = ActionEvent
+
+    def __init__(self, session, log_manager: IndexLogManager, data_manager: Optional[IndexDataManager] = None):
+        self.session = session
+        self.log_manager = log_manager
+        self.data_manager = data_manager
+        self.base_id: int = -1
+
+    # --- to be provided by concrete actions --------------------------------
+    @property
+    def index_name(self) -> str:
+        raise NotImplementedError
+
+    def validate(self) -> None:
+        pass
+
+    def op(self) -> None:
+        raise NotImplementedError
+
+    def log_entry(self) -> IndexLogEntry:
+        """The final-state entry to persist at base_id + 2."""
+        raise NotImplementedError
+
+    def transient_log_entry(self) -> IndexLogEntry:
+        """The transient entry; default = latest entry with transient state
+        (ref: Action.scala begin)."""
+        latest = self.log_manager.get_latest_log()
+        if latest is None:
+            raise HyperspaceActionException(f"Index {self.index_name!r} has no log to transition")
+        latest.state = self.transient_state
+        return latest
+
+    # --- protocol ----------------------------------------------------------
+    def _emit(self, state: str, message: str = "") -> None:
+        get_event_logger(self.session).log_event(
+            self.event_class(index_name=self.index_name, state=state, message=message)
+        )
+
+    def run(self) -> IndexLogEntry:
+        self.validate()
+        self._emit("Started")
+        latest = self.log_manager.get_latest_id()
+        self.base_id = latest if latest is not None else -1
+        try:
+            entry = self.transient_log_entry()
+            entry.timestamp = int(time.time() * 1000)
+            if not self.log_manager.write_log(self.base_id + 1, entry):
+                raise ConcurrentModificationException(
+                    f"Another operation is in progress on index {self.index_name!r} "
+                    f"(log id {self.base_id + 1} already exists)."
+                )
+            self.op()
+            final = self.log_entry()
+            final.state = self.final_state
+            final.timestamp = int(time.time() * 1000)
+            if not self.log_manager.write_log(self.base_id + 2, final):
+                raise ConcurrentModificationException(
+                    f"Failed to commit final state for index {self.index_name!r}."
+                )
+            self.log_manager.create_latest_stable_log(self.base_id + 2)
+        except NoChangesException:
+            raise
+        except Exception as e:
+            self._emit("Failure", str(e))
+            raise
+        self._emit("Success")
+        return final

@@ -1,0 +1,99 @@
+"""OptimizeAction: compact small index files per bucket
+(ref: HS/actions/OptimizeAction.scala:57-148).
+
+quick mode — only files below ``hyperspace.index.optimize.fileSizeThreshold``;
+full mode — all files. Buckets with more than one eligible file get their
+files merged (rows re-sorted) into a single file in a new data version; files
+left out ("ignored") stay referenced by the merged content tree
+(ref: OptimizeAction.scala:96-143).
+"""
+
+from __future__ import annotations
+
+import os
+from collections import defaultdict
+from typing import Dict, List
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+
+from hyperspace_tpu import config as C
+from hyperspace_tpu.actions.base import Action, HyperspaceActionException, NoChangesException
+from hyperspace_tpu.indexes import registry
+from hyperspace_tpu.indexes.covering import CoveringIndex, bucket_of_file, write_bucketed
+from hyperspace_tpu.models import states
+from hyperspace_tpu.models.log_entry import Content, FileIdTracker, FileInfo, IndexLogEntry
+from hyperspace_tpu.telemetry.events import OptimizeActionEvent
+
+
+class OptimizeAction(Action):
+    transient_state = states.OPTIMIZING
+    final_state = states.ACTIVE
+    event_class = OptimizeActionEvent
+
+    def __init__(self, session, name: str, log_manager, data_manager, mode: str):
+        super().__init__(session, log_manager, data_manager)
+        self._name = name
+        self._mode = mode
+        self._entry: IndexLogEntry = None  # type: ignore[assignment]
+        self._to_optimize: Dict[int, List[FileInfo]] = {}
+        self._ignored: List[FileInfo] = []
+        self._version = 0
+        self._tracker = FileIdTracker()
+
+    @property
+    def index_name(self) -> str:
+        return self._name
+
+    def validate(self) -> None:
+        entry = self.log_manager.get_latest_stable_log()
+        if entry is None or entry.state != states.ACTIVE:
+            state = entry.state if entry else states.DOESNOTEXIST
+            raise HyperspaceActionException(
+                f"Optimize is only supported on an ACTIVE index; {self._name!r} is {state}."
+            )
+        if entry.kind != CoveringIndex.kind:
+            raise HyperspaceActionException(f"Optimize is not supported for {entry.kind} indexes.")
+        self._entry = entry
+        self._tracker = entry.file_id_tracker()
+
+        threshold = self.session.conf.optimize_file_size_threshold
+        per_bucket: Dict[int, List[FileInfo]] = defaultdict(list)
+        ignored: List[FileInfo] = []
+        for fi in entry.content.file_infos():
+            bucket = bucket_of_file(fi.name)
+            eligible = self._mode == C.OPTIMIZE_MODE_FULL or fi.size < threshold
+            if bucket is None or not eligible:
+                ignored.append(fi)
+            else:
+                per_bucket[bucket].append(fi)
+        # only buckets with >1 file benefit from compaction (ref: :96-114)
+        self._to_optimize = {b: fs for b, fs in per_bucket.items() if len(fs) > 1}
+        for b, fs in per_bucket.items():
+            if len(fs) <= 1:
+                ignored.extend(fs)
+        self._ignored = ignored
+        if not self._to_optimize:
+            raise NoChangesException(
+                "Optimize aborted as no optimizable index files "
+                f"(multiple files per bucket, mode={self._mode}) found."
+            )
+
+    def op(self) -> None:
+        index = registry.index_of_entry(self._entry)
+        assert isinstance(index, CoveringIndex)
+        latest = self.data_manager.get_latest_version()
+        self._version = 0 if latest is None else latest + 1
+        out_dir = self.data_manager.version_path(self._version)
+        files = [fi.name for group in self._to_optimize.values() for fi in group]
+        table = pads.dataset(files, format="parquet").to_table()
+        # one write_bucketed pass re-buckets + re-sorts the merged rows
+        write_bucketed(table, index.indexed_columns, index.num_buckets, out_dir)
+
+    def log_entry(self) -> IndexLogEntry:
+        new_content = Content.from_directory(self.data_manager.version_path(self._version), self._tracker)
+        if self._ignored:
+            new_content = new_content.merge(Content.from_leaf_files(self._ignored))
+        entry = IndexLogEntry.from_dict(self._entry.to_dict())
+        entry.content = new_content
+        return entry

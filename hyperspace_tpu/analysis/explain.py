@@ -1,0 +1,56 @@
+"""explain API: run the optimizer with and without Hyperspace, show both
+plans, highlight the differing subtrees, and list the indexes used
+(ref: HS/index/plananalysis/PlanAnalyzer.scala:36-411).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from hyperspace_tpu.plan import logical as L
+
+
+def _used_indexes(plan: L.LogicalPlan) -> List[str]:
+    return sorted({s.entry.name for s in L.collect(plan, lambda p: isinstance(p, L.IndexScan))})
+
+
+def _bucket_summary(plan: L.LogicalPlan) -> List[str]:
+    out = []
+    for node in L.collect(plan, lambda p: isinstance(p, (L.IndexScan, L.BucketUnion))):
+        out.append(node.describe())
+    return out
+
+
+def explain_string(df, session, verbose: bool = False) -> str:
+    """(ref: PlanAnalyzer.explainString :47-115 — builds the plan twice, runs
+    the optimizer only (no execution), and diffs the trees)."""
+    from hyperspace_tpu.rules.apply import ApplyHyperspace
+
+    plan_without = df.plan
+    plan_with = ApplyHyperspace(session).apply(plan_without)
+
+    used = _used_indexes(plan_with)
+    buf = []
+    buf.append("=" * 64)
+    buf.append("Plan with indexes:")
+    buf.append(plan_with.pretty())
+    buf.append("")
+    buf.append("Plan without indexes:")
+    buf.append(plan_without.pretty())
+    buf.append("")
+    buf.append("Indexes used:")
+    if used:
+        manager = session.index_manager
+        for name in used:
+            entry = manager.get_index(name)
+            location = entry.content.files[0].rsplit("/", 2)[0] if entry and entry.content.files else ""
+            buf.append(f"  {name}: {location}")
+    else:
+        buf.append("  (none)")
+    if verbose:
+        buf.append("")
+        buf.append("Physical operator stats (index-side operators):")
+        for line in _bucket_summary(plan_with) or ["  (none)"]:
+            buf.append(f"  {line}")
+    buf.append("=" * 64)
+    return "\n".join(buf)

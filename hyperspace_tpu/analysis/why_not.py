@@ -1,0 +1,57 @@
+"""whyNot API: report, per candidate index, why the optimizer did not apply
+it to the given query
+(ref: HS/index/plananalysis/CandidateIndexAnalyzer.scala:29-346).
+
+Mechanism mirrors the reference: enable analysis mode, re-run the collector +
+optimizer so the filter chain tags each entry with ``FilterReason``s, then
+collect the tags into a table.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from hyperspace_tpu.analysis import reasons as R
+from hyperspace_tpu.models import states
+from hyperspace_tpu.plan import logical as L
+
+
+def why_not_string(df, session, index_name: Optional[str] = None, extended: bool = False) -> str:
+    from hyperspace_tpu.rules.apply import ApplyHyperspace
+
+    applier = ApplyHyperspace(session, analysis_enabled=True)
+    indexes = session.index_manager.get_indexes([states.ACTIVE])
+    if index_name is not None:
+        missing = index_name not in {e.name for e in indexes}
+        if missing:
+            return f"Index {index_name!r} does not exist or is not ACTIVE."
+    plan = df.plan
+    new_plan = applier.apply(plan)
+    applied = {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
+
+    scans = L.collect(plan, lambda p: isinstance(p, L.Scan))
+    buf: List[str] = []
+    buf.append("=" * 64)
+    buf.append("whyNot report")
+    buf.append(f"Applied indexes: {sorted(applied) or '(none)'}")
+    buf.append("")
+    header = f"{'Index':<24} {'Subplan':<28} Reason"
+    buf.append(header)
+    buf.append("-" * len(header))
+    for entry in indexes:
+        if index_name is not None and entry.name != index_name:
+            continue
+        if entry.name in applied:
+            buf.append(f"{entry.name:<24} {'-':<28} (applied)")
+            continue
+        any_reason = False
+        for scan in scans:
+            tagged = entry.get_tag(L.plan_key(scan), R.FILTER_REASONS) or []
+            for reason in tagged:
+                any_reason = True
+                text = str(reason) if extended else f"[{reason.code}] {reason.arg_str}"
+                buf.append(f"{entry.name:<24} {scan.describe()[:28]:<28} {text}")
+        if not any_reason:
+            buf.append(f"{entry.name:<24} {'-':<28} [NO_CANDIDATE] not a candidate for any sub-plan")
+    buf.append("=" * 64)
+    return "\n".join(buf)

@@ -1,0 +1,201 @@
+"""Configuration system.
+
+All config keys and defaults centralized here, mirroring the reference's
+``IndexConstants`` (ref: HS/index/IndexConstants.scala:21-131) and the typed
+accessors of ``HyperspaceConf`` (ref: HS/util/HyperspaceConf.scala:27-153).
+Keys are namespaced ``hyperspace.*`` (the reference uses ``spark.hyperspace.*``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class keys:
+    """All configuration keys (ref: HS/index/IndexConstants.scala:21-131)."""
+
+    SYSTEM_PATH = "hyperspace.system.path"
+    NUM_BUCKETS = "hyperspace.index.numBuckets"
+    HYBRID_SCAN_ENABLED = "hyperspace.index.hybridscan.enabled"
+    HYBRID_SCAN_MAX_DELETED_RATIO = "hyperspace.index.hybridscan.maxDeletedRatio"
+    HYBRID_SCAN_MAX_APPENDED_RATIO = "hyperspace.index.hybridscan.maxAppendedRatio"
+    FILTER_RULE_USE_BUCKET_SPEC = "hyperspace.index.filterRule.useBucketSpec"
+    NESTED_COLUMN_ENABLED = "hyperspace.index.nestedColumn.enabled"
+    CACHE_EXPIRY_SECONDS = "hyperspace.index.cache.expiryDurationInSeconds"
+    LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
+    OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
+    SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
+    GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
+    DATASKIPPING_TARGET_FILE_SIZE = "hyperspace.index.dataskipping.targetIndexDataFileSize"
+    EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"
+    DISPLAY_MODE = "hyperspace.explain.displayMode"
+    HIGHLIGHT_BEGIN_TAG = "hyperspace.explain.displayMode.highlight.beginTag"
+    HIGHLIGHT_END_TAG = "hyperspace.explain.displayMode.highlight.endTag"
+    # TPU-specific knobs (no reference counterpart: the reference delegates
+    # execution tuning to Spark; here the framework owns the execution layer).
+    TPU_ROWS_PER_SHARD_CAPACITY_FACTOR = "hyperspace.tpu.rebucket.capacityFactor"
+    TPU_MESH_AXIS = "hyperspace.tpu.mesh.axis"
+    TPU_BUILD_BATCH_ROWS = "hyperspace.tpu.build.batchRows"
+
+
+# Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
+# spark.sql.shuffle.partitions' default of 200, hybrid-scan ratios 0.2/0.3,
+# optimize threshold 256 MiB, cache TTL 300 s).
+DEFAULTS: Dict[str, Any] = {
+    keys.SYSTEM_PATH: None,  # resolved by PathResolver; must be set per session
+    keys.NUM_BUCKETS: 200,
+    keys.HYBRID_SCAN_ENABLED: False,
+    keys.HYBRID_SCAN_MAX_DELETED_RATIO: 0.2,
+    keys.HYBRID_SCAN_MAX_APPENDED_RATIO: 0.3,
+    keys.FILTER_RULE_USE_BUCKET_SPEC: False,
+    keys.NESTED_COLUMN_ENABLED: False,
+    keys.CACHE_EXPIRY_SECONDS: 300,
+    keys.LINEAGE_ENABLED: False,
+    keys.OPTIMIZE_FILE_SIZE_THRESHOLD: 256 * 1024 * 1024,
+    keys.SOURCE_BUILDERS: (
+        "hyperspace_tpu.sources.default.DefaultFileBasedSourceBuilder,"
+        "hyperspace_tpu.sources.delta.DeltaLakeSourceBuilder"
+    ),
+    keys.GLOBBING_PATTERN: None,
+    keys.DATASKIPPING_TARGET_FILE_SIZE: 256 * 1024 * 1024,
+    keys.EVENT_LOGGER_CLASS: None,
+    keys.DISPLAY_MODE: "console",
+    keys.HIGHLIGHT_BEGIN_TAG: "",
+    keys.HIGHLIGHT_END_TAG: "",
+    keys.TPU_ROWS_PER_SHARD_CAPACITY_FACTOR: 2.0,
+    keys.TPU_MESH_AXIS: "buckets",
+    keys.TPU_BUILD_BATCH_ROWS: 1 << 22,
+}
+
+REFRESH_MODE_INCREMENTAL = "incremental"
+REFRESH_MODE_FULL = "full"
+REFRESH_MODE_QUICK = "quick"
+REFRESH_MODES = (REFRESH_MODE_INCREMENTAL, REFRESH_MODE_FULL, REFRESH_MODE_QUICK)
+
+OPTIMIZE_MODE_QUICK = "quick"
+OPTIMIZE_MODE_FULL = "full"
+OPTIMIZE_MODES = (OPTIMIZE_MODE_QUICK, OPTIMIZE_MODE_FULL)
+
+# Operation-log layout constants (ref: HS/index/IndexConstants.scala:93-95).
+HYPERSPACE_LOG_DIR = "_hyperspace_log"
+INDEX_VERSION_DIR_PREFIX = "v__"
+INDEXES_DIR = "indexes"
+
+# Lineage column name (ref: HS/index/IndexConstants.scala:104).
+DATA_FILE_NAME_ID = "_data_file_id"
+# Default id for a file whose id is unknown (ref: HS/index/IndexConstants.scala:116).
+UNKNOWN_FILE_ID = -1
+
+# Index metadata property names (ref: HS/index/IndexConstants.scala:118-127).
+LINEAGE_PROPERTY = "lineage"
+HAS_PARQUET_AS_SOURCE_FORMAT_PROPERTY = "hasParquetAsSourceFormat"
+HYPERSPACE_VERSION_PROPERTY = "hyperspaceVersion"
+INDEX_LOG_VERSION_PROPERTY = "indexLogVersion"
+
+
+def _coerce(value: Any, like: Any) -> Any:
+    """Coerce a raw (possibly string) conf value to the type of the default."""
+    if value is None or like is None:
+        return value
+    if isinstance(like, bool):
+        if isinstance(value, str):
+            return value.strip().lower() in ("true", "1", "yes")
+        return bool(value)
+    if isinstance(like, int) and not isinstance(like, bool):
+        return int(value)
+    if isinstance(like, float):
+        return float(value)
+    return value
+
+
+class HyperspaceConf:
+    """A mutable string-keyed configuration with typed accessors.
+
+    Mirrors HS/util/HyperspaceConf.scala:27-153: every accessor reads the raw
+    key and falls back to the centralized default.
+    """
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._conf: Dict[str, Any] = dict(overrides or {})
+
+    def set(self, key: str, value: Any) -> "HyperspaceConf":
+        self._conf[key] = value
+        return self
+
+    def unset(self, key: str) -> "HyperspaceConf":
+        self._conf.pop(key, None)
+        return self
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._conf:
+            return _coerce(self._conf[key], DEFAULTS.get(key, default))
+        if key in DEFAULTS:
+            return DEFAULTS[key] if default is None else default
+        return default
+
+    def copy(self) -> "HyperspaceConf":
+        return HyperspaceConf(dict(self._conf))
+
+    # Typed accessors -------------------------------------------------------
+    @property
+    def system_path(self) -> Optional[str]:
+        return self.get(keys.SYSTEM_PATH)
+
+    @property
+    def num_buckets(self) -> int:
+        return int(self.get(keys.NUM_BUCKETS))
+
+    @property
+    def hybrid_scan_enabled(self) -> bool:
+        return bool(self.get(keys.HYBRID_SCAN_ENABLED))
+
+    @property
+    def hybrid_scan_deleted_ratio_threshold(self) -> float:
+        return float(self.get(keys.HYBRID_SCAN_MAX_DELETED_RATIO))
+
+    @property
+    def hybrid_scan_appended_ratio_threshold(self) -> float:
+        return float(self.get(keys.HYBRID_SCAN_MAX_APPENDED_RATIO))
+
+    @property
+    def use_bucket_spec(self) -> bool:
+        return bool(self.get(keys.FILTER_RULE_USE_BUCKET_SPEC))
+
+    @property
+    def nested_column_enabled(self) -> bool:
+        return bool(self.get(keys.NESTED_COLUMN_ENABLED))
+
+    @property
+    def cache_expiry_seconds(self) -> int:
+        return int(self.get(keys.CACHE_EXPIRY_SECONDS))
+
+    @property
+    def lineage_enabled(self) -> bool:
+        return bool(self.get(keys.LINEAGE_ENABLED))
+
+    @property
+    def optimize_file_size_threshold(self) -> int:
+        return int(self.get(keys.OPTIMIZE_FILE_SIZE_THRESHOLD))
+
+    @property
+    def source_builders(self) -> str:
+        return str(self.get(keys.SOURCE_BUILDERS))
+
+    @property
+    def dataskipping_target_file_size(self) -> int:
+        return int(self.get(keys.DATASKIPPING_TARGET_FILE_SIZE))
+
+    @property
+    def rebucket_capacity_factor(self) -> float:
+        return float(self.get(keys.TPU_ROWS_PER_SHARD_CAPACITY_FACTOR))
+
+    @property
+    def mesh_axis(self) -> str:
+        return str(self.get(keys.TPU_MESH_AXIS))
+
+    @property
+    def build_batch_rows(self) -> int:
+        return int(self.get(keys.TPU_BUILD_BATCH_ROWS))
+
+    def __repr__(self) -> str:
+        return f"HyperspaceConf({self._conf!r})"

@@ -1,0 +1,65 @@
+"""Columnar batch: the unit of data flowing between physical operators.
+
+A batch is a dict ``column name -> numpy array`` (object dtype for strings on
+the host path). Device execution dictionary-encodes string columns into int32
+codes so everything on TPU is dense numeric (see exec/device.py) — covering
+indexes carry arbitrary included columns, and TPU has no native variable-length
+type (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+Batch = Dict[str, np.ndarray]
+
+
+def table_to_batch(table: pa.Table) -> Batch:
+    out: Batch = {}
+    for name in table.column_names:
+        col = table.column(name)
+        try:
+            out[name] = col.to_numpy(zero_copy_only=False)
+        except pa.ArrowInvalid:
+            out[name] = np.asarray(col.to_pylist(), dtype=object)
+    return out
+
+
+def batch_to_table(batch: Batch, column_order: Optional[List[str]] = None) -> pa.Table:
+    names = column_order if column_order is not None else list(batch)
+    arrays = []
+    for n in names:
+        v = batch[n]
+        if v.dtype == object or v.dtype.kind in ("U", "S"):
+            arrays.append(pa.array([None if x is None else str(x) for x in v.tolist()], type=pa.string()))
+        else:
+            arrays.append(pa.array(v))
+    return pa.table(dict(zip(names, arrays)))
+
+
+def num_rows(batch: Batch) -> int:
+    for v in batch.values():
+        return len(v)
+    return 0
+
+
+def take(batch: Batch, indices: np.ndarray) -> Batch:
+    return {k: v[indices] for k, v in batch.items()}
+
+
+def mask_rows(batch: Batch, mask: np.ndarray) -> Batch:
+    return {k: v[mask] for k, v in batch.items()}
+
+
+def concat(batches: List[Batch]) -> Batch:
+    if not batches:
+        return {}
+    names = list(batches[0])
+    return {n: np.concatenate([b[n] for b in batches]) for n in names}
+
+
+def select(batch: Batch, columns: List[str]) -> Batch:
+    return {c: batch[c] for c in columns}

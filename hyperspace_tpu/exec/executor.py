@@ -1,0 +1,135 @@
+"""Host-path physical executor.
+
+Executes a (possibly index-rewritten) logical plan over pyarrow + numpy. This
+is the correctness baseline and the non-indexed fallback; index-accelerated
+scans and joins are dispatched to the TPU device path (exec/device.py) when a
+session mesh is available.
+
+The reference delegates all of this to Spark's physical planner/executors;
+here the framework owns it (SURVEY.md §7 design stance).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import pyarrow.dataset as pads
+
+from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import INPUT_FILE_NAME, Expr, InputFileName, extract_equi_join_keys
+
+
+def _plan_needs_file_names(plan: L.LogicalPlan) -> bool:
+    def expr_has(e: Expr) -> bool:
+        if isinstance(e, InputFileName):
+            return True
+        return any(expr_has(c) for c in e.children())
+
+    if isinstance(plan, L.Filter) and expr_has(plan.condition):
+        return True
+    return any(_plan_needs_file_names(c) for c in plan.children())
+
+
+def _read_files(files: List[str], file_format: str, columns: Optional[List[str]], with_file_names: bool) -> B.Batch:
+    if with_file_names:
+        batches = []
+        for f in files:
+            t = pads.dataset([f], format=file_format).to_table(columns=columns)
+            b = B.table_to_batch(t)
+            b[INPUT_FILE_NAME] = np.full(t.num_rows, f, dtype=object)
+            batches.append(b)
+        return B.concat(batches)
+    t = pads.dataset(files, format=file_format).to_table(columns=columns)
+    return B.table_to_batch(t)
+
+
+class Executor:
+    def __init__(self, session):
+        self.session = session
+
+    def execute(self, plan: L.LogicalPlan, required_columns: Optional[List[str]] = None) -> B.Batch:
+        with_file_names = _plan_needs_file_names(plan)
+        batch = self._exec(plan, with_file_names)
+        if required_columns is not None:
+            batch = B.select(batch, required_columns)
+        elif INPUT_FILE_NAME in batch:
+            batch = {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
+        return batch
+
+    def _exec(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
+        if isinstance(plan, L.Scan):
+            rel = plan.relation
+            files = [fi.name for fi in rel.all_file_infos()]
+            return _read_files(files, rel.physical_format, None, with_file_names)
+
+        if isinstance(plan, L.FileScan):
+            return _read_files(list(plan.files), plan.file_format, list(plan.columns), with_file_names)
+
+        if isinstance(plan, L.IndexScan):
+            return _read_files(list(plan.files), "parquet", list(plan.columns), with_file_names)
+
+        if isinstance(plan, L.Filter):
+            child = self._exec(plan.child, with_file_names)
+            mask = np.asarray(plan.condition.eval(child), dtype=bool)
+            return B.mask_rows(child, mask)
+
+        if isinstance(plan, L.Project):
+            child = self._exec(plan.child, with_file_names)
+            cols = list(plan.columns)
+            if with_file_names and INPUT_FILE_NAME in child:
+                cols = cols + [INPUT_FILE_NAME]
+            return B.select(child, cols)
+
+        if isinstance(plan, L.Join):
+            return self._exec_join(plan, with_file_names)
+
+        if isinstance(plan, (L.Union, L.BucketUnion)):
+            return B.concat([self._exec(c, with_file_names) for c in plan.children()])
+
+        if isinstance(plan, L.Repartition):
+            # Host path: in-memory data has no physical bucketing; pass through.
+            return self._exec(plan.child, with_file_names)
+
+        raise NotImplementedError(f"Cannot execute {type(plan).__name__}")
+
+    def _exec_join(self, plan: L.Join, with_file_names: bool) -> B.Batch:
+        import pandas as pd
+
+        pairs = extract_equi_join_keys(plan.condition)
+        if pairs is None:
+            raise NotImplementedError("Only conjunctive equi-joins are supported")
+        left = self._exec(plan.left, with_file_names)
+        right = self._exec(plan.right, with_file_names)
+        left = {k: v for k, v in left.items() if k != INPUT_FILE_NAME}
+        right = {k: v for k, v in right.items() if k != INPUT_FILE_NAME}
+
+        left_cols = list(left)
+        right_cols = list(right)
+        # validate key sides (columns may arrive swapped from the user)
+        lkeys, rkeys = [], []
+        for a, b in pairs:
+            if a in left_cols and b in right_cols:
+                lkeys.append(a)
+                rkeys.append(b)
+            elif b in left_cols and a in right_cols:
+                lkeys.append(b)
+                rkeys.append(a)
+            else:
+                raise ValueError(f"Join keys ({a}, {b}) not found in the two sides")
+
+        # rename duplicated right-side columns up front so every output column
+        # (including unmatched-row nulls on outer joins) comes straight out of
+        # the merge result
+        rename = {c: f"{c}#r" for c in right_cols if c in left_cols}
+        ldf = pd.DataFrame(left)
+        rdf = pd.DataFrame(right).rename(columns=rename)
+        rkeys_renamed = [rename.get(k, k) for k in rkeys]
+        merged = ldf.merge(rdf, left_on=lkeys, right_on=rkeys_renamed, how=plan.how)
+        out: B.Batch = {}
+        for name in plan.output_columns:
+            if name not in merged.columns:
+                raise KeyError(f"Join output column {name!r} missing")
+            out[name] = merged[name].to_numpy()
+        return out

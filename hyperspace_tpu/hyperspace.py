@@ -1,0 +1,71 @@
+"""The user-facing ``Hyperspace`` facade
+(ref: HS/Hyperspace.scala:27-231).
+
+Maintenance operations run with the optimizer rule disabled so that index
+builds never recursively consult indexes
+(ref: Hyperspace.scala:193-200 withHyperspaceRuleDisabled).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from hyperspace_tpu import config as C
+from hyperspace_tpu.manager import CachingIndexCollectionManager
+from hyperspace_tpu.models.log_entry import IndexLogEntry
+from hyperspace_tpu.session import Session, get_session
+
+
+class Hyperspace:
+    def __init__(self, session: Optional[Session] = None):
+        self.session = session or get_session()
+
+    @property
+    def _manager(self) -> CachingIndexCollectionManager:
+        return self.session.index_manager
+
+    # --- index management (ref: Hyperspace.scala:43-150) -------------------
+    def create_index(self, df, index_config) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.create(df, index_config)
+
+    def delete_index(self, name: str) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.delete(name)
+
+    def restore_index(self, name: str) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.restore(name)
+
+    def vacuum_index(self, name: str) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.vacuum(name)
+
+    def cancel(self, name: str) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.cancel(name)
+
+    def refresh_index(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.refresh(name, mode)
+
+    def optimize_index(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> IndexLogEntry:
+        with self.session.with_hyperspace_disabled():
+            return self._manager.optimize(name, mode)
+
+    # --- introspection (ref: Hyperspace.scala indexes/index/explain/whyNot) -
+    def indexes(self):
+        return self._manager.indexes()
+
+    def index(self, name: str):
+        return self._manager.index_stats(name, extended=True)
+
+    def explain(self, df, verbose: bool = False) -> str:
+        from hyperspace_tpu.analysis.explain import explain_string
+
+        return explain_string(df, self.session, verbose)
+
+    def why_not(self, df, index_name: Optional[str] = None, extended: bool = False) -> str:
+        from hyperspace_tpu.analysis.why_not import why_not_string
+
+        return why_not_string(df, self.session, index_name, extended)

@@ -1,0 +1,95 @@
+"""Index SPI.
+
+``Index`` is the derived-dataset interface every index kind implements
+(ref: HS/index/Index.scala:32-168); ``IndexConfig`` is the user-facing config
+SPI (ref: HS/index/IndexConfigTrait.scala:31-59); ``CreateContext`` carries
+what the reference passes as ``IndexerContext`` (session, data path, file-id
+tracker).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from hyperspace_tpu.models.log_entry import Content, DerivedDataset, FileIdTracker
+
+
+class UpdateMode:
+    """(ref: HS/index/Index.scala UpdateMode.{Merge,Overwrite})"""
+
+    MERGE = "merge"
+    OVERWRITE = "overwrite"
+
+
+@dataclass
+class CreateContext:
+    """Context for index build/refresh operations
+    (ref: ``IndexerContext`` in HS/index/Index.scala)."""
+
+    session: Any
+    index_data_path: str  # versioned data dir (v__=N) to write into
+    file_id_tracker: FileIdTracker = field(default_factory=FileIdTracker)
+    properties: Dict[str, str] = field(default_factory=dict)
+
+
+class Index:
+    """A derived dataset (ref: HS/index/Index.scala:32-168)."""
+
+    kind: str = ""
+    kind_abbr: str = ""
+
+    @property
+    def indexed_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def referenced_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    @property
+    def properties(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def with_new_properties(self, properties: Dict[str, Any]) -> "Index":
+        raise NotImplementedError
+
+    def to_derived_dataset(self) -> DerivedDataset:
+        return DerivedDataset(self.kind, dict(self.properties))
+
+    def write(self, ctx: CreateContext, df) -> None:
+        """Build and persist index data for ``df`` into ``ctx.index_data_path``."""
+        raise NotImplementedError
+
+    def can_handle_deleted_files(self) -> bool:
+        return False
+
+    def optimize(self, ctx: CreateContext, files_to_optimize: List[str]) -> None:
+        raise NotImplementedError
+
+    def refresh_incremental(self, ctx: CreateContext, appended_df, deleted_files, previous_content: Content):
+        """Returns (index, update_mode)."""
+        raise NotImplementedError
+
+    def refresh_full(self, ctx: CreateContext, df):
+        """Returns the refreshed index."""
+        raise NotImplementedError
+
+    def stats(self) -> Dict[str, Any]:
+        return {}
+
+
+class IndexConfig:
+    """User-facing index configuration (ref: HS/index/IndexConfigTrait.scala:31-59)."""
+
+    @property
+    def index_name(self) -> str:
+        raise NotImplementedError
+
+    @property
+    def referenced_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    def create_index(self, ctx: CreateContext, df, properties: Dict[str, str]) -> Index:
+        """Resolve columns against ``df``, build index data, return the Index."""
+        raise NotImplementedError

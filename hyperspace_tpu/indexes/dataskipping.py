@@ -1,0 +1,266 @@
+"""DataSkippingIndex: per-source-file sketch table.
+
+One row per source data file (keyed by ``_data_file_id``) holding sketch
+aggregates (min/max, bloom filter, distinct value list) of chosen columns;
+query-time file pruning translates predicates against the sketch table
+(ref: HS/index/dataskipping/DataSkippingIndex.scala:35-179,
+DataSkippingIndexConfig.scala:40-76, sketch/MinMaxSketch.scala:33-43).
+
+Note the reference snapshot ships build/refresh/optimize but never registered
+a query-rewrite rule (SURVEY.md §2.3); this framework implements the pruning
+rule too (rules/dataskipping_rule.py).
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from hyperspace_tpu import config as C
+from hyperspace_tpu.indexes import registry
+from hyperspace_tpu.indexes.base import CreateContext, Index, IndexConfig, UpdateMode
+from hyperspace_tpu.models.log_entry import Content, DerivedDataset
+from hyperspace_tpu.plan.resolver import resolve_columns_against_schema
+
+
+class Sketch:
+    """Sketch SPI (ref: HS/index/dataskipping/sketch/Sketch.scala:33-78)."""
+
+    kind = ""
+
+    def __init__(self, expr: str):
+        self.expr = expr  # column name (expression strings kept simple)
+
+    @property
+    def referenced_columns(self) -> List[str]:
+        return [self.expr]
+
+    def output_names(self) -> List[str]:
+        raise NotImplementedError
+
+    def aggregate(self, values: np.ndarray) -> List[Any]:
+        """Compute this sketch's aggregates over one file's column values."""
+        raise NotImplementedError
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "expr": self.expr}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Sketch":
+        kind = d["kind"]
+        for sk in (MinMaxSketch, BloomFilterSketch, ValueListSketch, PartitionSketch):
+            if sk.kind == kind:
+                if kind == "BloomFilter":
+                    return BloomFilterSketch(d["expr"], d.get("fpp", 0.01), d.get("expectedItems", 10000))
+                return sk(d["expr"])
+        raise ValueError(f"Unknown sketch kind {kind!r}")
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash((self.kind, self.expr))
+
+    def __repr__(self):
+        return f"{self.kind}({self.expr})"
+
+
+class MinMaxSketch(Sketch):
+    """(ref: sketch/MinMaxSketch.scala:33-43)"""
+
+    kind = "MinMax"
+
+    def output_names(self) -> List[str]:
+        return [f"MinMax_{self.expr}__min", f"MinMax_{self.expr}__max"]
+
+    def aggregate(self, values: np.ndarray) -> List[Any]:
+        if len(values) == 0:
+            return [None, None]
+        return [values.min(), values.max()]
+
+
+class ValueListSketch(Sketch):
+    """Distinct values per file — exact membership pruning
+    (ref: dataskipping sketches; ValueListSketch exists in later reference versions)."""
+
+    kind = "ValueList"
+    MAX_VALUES = 1024
+
+    def output_names(self) -> List[str]:
+        return [f"ValueList_{self.expr}__values"]
+
+    def aggregate(self, values: np.ndarray) -> List[Any]:
+        uniq = np.unique(values)
+        if len(uniq) > self.MAX_VALUES:
+            return [None]  # too many distincts: no pruning signal
+        return [uniq.tolist()]
+
+
+class BloomFilterSketch(Sketch):
+    """Bloom-filter membership per file. The filter is a fixed-size bit array
+    stored as a list of uint64 words; membership tests run vectorized."""
+
+    kind = "BloomFilter"
+
+    def __init__(self, expr: str, fpp: float = 0.01, expected_items: int = 10000):
+        super().__init__(expr)
+        self.fpp = float(fpp)
+        self.expected_items = int(expected_items)
+        m = max(64, int(-expected_items * math.log(fpp) / (math.log(2) ** 2)))
+        self.num_bits = 1 << max(6, (m - 1).bit_length())  # power of two
+        self.num_hashes = max(1, int(round(self.num_bits / expected_items * math.log(2))))
+
+    def output_names(self) -> List[str]:
+        return [f"BloomFilter_{self.expr}__bits"]
+
+    def _positions(self, values: np.ndarray) -> np.ndarray:
+        from hyperspace_tpu.ops.encode import hash_input_uint32
+
+        h1 = hash_input_uint32(values).astype(np.uint64)
+        h2 = (h1 * np.uint64(0x9E3779B97F4A7C15)) >> np.uint64(32) | np.uint64(1)
+        ks = np.arange(self.num_hashes, dtype=np.uint64)
+        return ((h1[:, None] + ks[None, :] * h2[:, None]) % np.uint64(self.num_bits)).astype(np.int64)
+
+    def aggregate(self, values: np.ndarray) -> List[Any]:
+        bits = np.zeros(self.num_bits // 64, dtype=np.uint64)
+        pos = self._positions(values).reshape(-1)
+        np.bitwise_or.at(bits, pos // 64, np.uint64(1) << (pos % np.uint64(64)).astype(np.uint64))
+        return [bits.view(np.int64).tolist()]
+
+    def might_contain(self, bits_words: List[int], value) -> bool:
+        bits = np.asarray(bits_words, dtype=np.int64).view(np.uint64)
+        pos = self._positions(np.asarray([value])).reshape(-1)
+        return bool(np.all((bits[pos // 64] >> (pos % np.uint64(64)).astype(np.uint64)) & np.uint64(1)))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"kind": self.kind, "expr": self.expr, "fpp": self.fpp, "expectedItems": self.expected_items}
+
+
+class PartitionSketch(Sketch):
+    """Single partition value per file (for hive-partitioned sources)."""
+
+    kind = "Partition"
+
+    def output_names(self) -> List[str]:
+        return [f"Partition_{self.expr}__value"]
+
+    def aggregate(self, values: np.ndarray) -> List[Any]:
+        uniq = np.unique(values)
+        return [uniq[0] if len(uniq) == 1 else None]
+
+
+class DataSkippingIndex(Index):
+    kind = "DataSkippingIndex"
+    kind_abbr = "DS"
+
+    def __init__(self, sketches: List[Sketch], extra_properties: Optional[Dict[str, Any]] = None):
+        self.sketches = list(sketches)
+        self._extra = dict(extra_properties or {})
+
+    @property
+    def indexed_columns(self) -> List[str]:
+        out: List[str] = []
+        for s in self.sketches:
+            for c in s.referenced_columns:
+                if c not in out:
+                    out.append(c)
+        return out
+
+    @property
+    def referenced_columns(self) -> List[str]:
+        return self.indexed_columns
+
+    @property
+    def properties(self) -> Dict[str, Any]:
+        props = {"sketches": [s.to_dict() for s in self.sketches]}
+        props.update(self._extra)
+        return props
+
+    def with_new_properties(self, properties: Dict[str, Any]) -> "DataSkippingIndex":
+        extra = {k: v for k, v in properties.items() if k != "sketches"}
+        return DataSkippingIndex(self.sketches, extra)
+
+    @classmethod
+    def from_derived_dataset(cls, dd: DerivedDataset) -> "DataSkippingIndex":
+        extra = {k: v for k, v in dd.properties.items() if k != "sketches"}
+        return cls([Sketch.from_dict(s) for s in dd.properties["sketches"]], extra)
+
+    def can_handle_deleted_files(self) -> bool:
+        return True  # rows are keyed by file id; deleted files' rows are dropped
+
+    def stats(self) -> Dict[str, Any]:
+        return {"sketches": [repr(s) for s in self.sketches]}
+
+    # --- build (ref: DataSkippingIndex.index() :116-138) -------------------
+    def write(self, ctx: CreateContext, df) -> None:
+        from hyperspace_tpu.plan.logical import Scan
+
+        assert isinstance(df.plan, Scan)
+        relation = df.plan.relation
+        cols = [c.name for c in resolve_columns_against_schema(self.indexed_columns, relation.schema)]
+        rows = self._sketch_rows(relation, relation.all_file_infos(), cols, ctx)
+        self._write_rows(rows, ctx.index_data_path)
+
+    def _sketch_rows(self, relation, file_infos, cols: List[str], ctx: CreateContext) -> List[Dict[str, Any]]:
+        rows = []
+        for fi in file_infos:
+            fid = ctx.file_id_tracker.add_file(fi)
+            t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=cols)
+            row: Dict[str, Any] = {C.DATA_FILE_NAME_ID: fid}
+            for s in self.sketches:
+                col = t.column(s.expr).to_numpy(zero_copy_only=False)
+                for name, value in zip(s.output_names(), s.aggregate(col)):
+                    row[name] = value
+            rows.append(row)
+        return rows
+
+    def _write_rows(self, rows: List[Dict[str, Any]], out_dir: str) -> None:
+        os.makedirs(out_dir, exist_ok=True)
+        if not rows:
+            return
+        names = list(rows[0])
+        table = pa.table({n: [r[n] for r in rows] for n in names})
+        pq.write_table(table, os.path.join(out_dir, "sketches-00000.parquet"))
+
+    def read_sketch_table(self, entry) -> pa.Table:
+        return pads.dataset(entry.content.files, format="parquet").to_table()
+
+
+class DataSkippingIndexConfig(IndexConfig):
+    """(ref: HS/index/dataskipping/DataSkippingIndexConfig.scala:40-76)"""
+
+    def __init__(self, index_name: str, first_sketch: Sketch, *more_sketches: Sketch):
+        if not index_name:
+            raise ValueError("Index name must not be empty")
+        sketches = [first_sketch, *more_sketches]
+        if len(set(sketches)) != len(sketches):
+            raise ValueError("Duplicate sketches are not allowed")
+        self._name = index_name
+        self._sketches = sketches
+
+    @property
+    def index_name(self) -> str:
+        return self._name
+
+    @property
+    def referenced_columns(self) -> List[str]:
+        out: List[str] = []
+        for s in self._sketches:
+            for c in s.referenced_columns:
+                if c not in out:
+                    out.append(c)
+        return out
+
+    def create_index(self, ctx: CreateContext, df, properties: Dict[str, str]) -> DataSkippingIndex:
+        index = DataSkippingIndex(self._sketches, dict(properties))
+        index.write(ctx, df)
+        return index
+
+
+registry.register(DataSkippingIndex.kind, DataSkippingIndex.from_derived_dataset)

@@ -1,0 +1,154 @@
+"""Distributed re-bucketing: the TPU-native replacement for Spark's shuffle.
+
+``rebucket`` moves each row to the device that owns its bucket
+(``device = bucket % n_devices``) with ONE ``all_to_all`` over ICI inside a
+``shard_map`` — replacing the JVM hash-shuffle behind
+``repartition(numBuckets, cols)`` (ref: HS/index/covering/CoveringIndex.scala:54-69)
+and the on-the-fly re-bucketing of appended data in hybrid scan
+(ref: HS/index/covering/CoveringIndexRuleUtils.scala:357-417).
+
+Rows are exchanged in fixed-capacity slots (static shapes for XLA): each
+device reserves ``capacity`` rows for every destination; a validity mask marks
+real rows. Capacity overflow is detected and reported so callers can retry
+with a larger factor — the skew-handling strategy (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Tuple
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental.shard_map import shard_map  # noqa: E402
+from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+
+
+def _stage_for_exchange(values, dest, n_dev: int, capacity: int, fill=0):
+    """Scatter local rows into a (n_dev, capacity) staging grid keyed by
+    destination device; rows beyond capacity are dropped (and counted)."""
+    n_loc = dest.shape[0]
+    order = jnp.argsort(dest, stable=True)
+    dest_sorted = dest[order]
+    counts = jnp.bincount(dest, length=n_dev)
+    offsets = jnp.cumsum(counts) - counts
+    rank = jnp.arange(n_loc) - offsets[dest_sorted]
+    valid = rank < capacity
+    slot = dest_sorted * capacity + jnp.clip(rank, 0, capacity - 1)
+    slot = jnp.where(valid, slot, n_dev * capacity)  # overflow -> scratch slot
+
+    staged = []
+    for v in values:
+        v_sorted = v[order]
+        buf = jnp.full((n_dev * capacity + 1,), fill, dtype=v.dtype)
+        buf = buf.at[slot].set(v_sorted)
+        staged.append(buf[:-1].reshape(n_dev, capacity))
+    mask = jnp.zeros((n_dev * capacity + 1,), dtype=bool).at[slot].set(valid)
+    return staged, mask[:-1].reshape(n_dev, capacity), counts
+
+
+def rebucket(
+    mesh: Mesh,
+    arrays: Dict[str, "jax.Array"],
+    bucket_ids: "jax.Array",
+    capacity: int,
+) -> Tuple[Dict[str, "jax.Array"], "jax.Array", "jax.Array", "jax.Array"]:
+    """Exchange rows so device ``d`` ends up holding exactly the rows with
+    ``bucket % n_devices == d``.
+
+    Args:
+      mesh: 1-D device mesh; inputs must be sharded along its axis.
+      arrays: name -> (n,) numeric arrays (row-aligned).
+      bucket_ids: (n,) int32 bucket of each row.
+      capacity: per-source-per-destination row slots (static).
+
+    Returns:
+      (out_arrays, out_buckets, valid_mask, overflow): each output has shape
+      (n_devices * capacity,) per device shard — n_dev*n_dev*capacity global —
+      with ``valid_mask`` marking real rows. ``overflow`` is the per-device
+      count of rows dropped because a destination slot overflowed (callers
+      must check it is all zero and retry with larger capacity otherwise).
+    """
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+    names = list(arrays)
+    values = [arrays[n] for n in names]
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis),) * (len(values) + 1),
+        out_specs=(P(axis),) * (len(values) + 3),
+    )
+    def exchange(*args):
+        *vals, buckets = args
+        dest = (buckets % n_dev).astype(jnp.int32)
+        # stage the bucket-id array together with the data columns: one
+        # argsort/bincount/scatter pass serves all of them
+        staged, mask, counts = _stage_for_exchange([*vals, buckets], dest, n_dev, capacity)
+        sent = jnp.minimum(counts, capacity)
+        overflow = jnp.sum(counts - sent)
+
+        out = [
+            jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+            for s in staged
+        ]
+        out_mask = jax.lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+        return (*out, out_mask, overflow[None])
+
+    results = exchange(*values, bucket_ids)
+    out_arrays = dict(zip(names, results[: len(names)]))
+    out_buckets, valid, overflow = results[len(names)], results[len(names) + 1], results[len(names) + 2]
+    return out_arrays, out_buckets, valid, overflow
+
+
+def rebucket_and_sort(
+    mesh: Mesh,
+    arrays: Dict[str, "jax.Array"],
+    hash_inputs: List["jax.Array"],
+    sort_keys: List["jax.Array"],
+    num_buckets: int,
+    capacity: int,
+):
+    """Full distributed index-build step: hash -> all_to_all -> per-device
+    stable sort by (bucket, sort keys). Invalid (padding) rows sort to the end.
+
+    This is the device program the driver's ``dryrun_multichip`` compiles: the
+    entire reference hot path (ref: SURVEY.md §3.1 boxed region) as one XLA
+    computation over the mesh.
+    """
+    from hyperspace_tpu.ops.hashing import bucket_ids_jnp
+    from hyperspace_tpu.ops.sort import lex_argsort
+
+    axis = mesh.axis_names[0]
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(axis),) * len(hash_inputs), out_specs=P(axis))
+    def assign(*hi):
+        return bucket_ids_jnp(list(hi), num_buckets)
+
+    buckets = assign(*hash_inputs)
+    n_keys = len(sort_keys)
+    key_names = [f"__sk{i}" for i in range(n_keys)]
+    all_arrays = {**arrays, **dict(zip(key_names, sort_keys))}
+    out, out_buckets, valid, overflow = rebucket(mesh, all_arrays, buckets, capacity)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(axis),) * (n_keys + 2 + len(arrays)),
+        out_specs=(P(axis),) * (2 + len(arrays)),
+    )
+    def local_sort(buckets_, valid_, *cols):
+        sort_cols = cols[:n_keys]
+        data_cols = cols[n_keys:]
+        # invalid rows last: sort primarily by ~valid, then bucket, then keys
+        order = lex_argsort([(~valid_).astype(jnp.int32), buckets_] + list(sort_cols))
+        return (buckets_[order], valid_[order], *[c[order] for c in data_cols])
+
+    sorted_res = local_sort(out_buckets, valid, *[out[k] for k in key_names], *[out[k] for k in arrays])
+    sorted_buckets, sorted_valid = sorted_res[0], sorted_res[1]
+    sorted_arrays = dict(zip(list(arrays), sorted_res[2:]))
+    return sorted_arrays, sorted_buckets, sorted_valid, overflow

@@ -1,0 +1,52 @@
+"""Host-side column encoding for device consumption.
+
+TPU has no variable-length types, so every column is encoded to dense numerics
+before ``device_put`` (SURVEY.md §7 "Variable-length data (strings) on TPU"):
+
+  - ``hash_input``  — uint32 per row, feeds bucket hashing (ops/hashing.py)
+  - ``sort_key``    — int64 per row whose ordering equals the column's natural
+                      ordering (strings -> dictionary rank; floats -> an
+                      order-preserving bit transform; ints/dates -> identity)
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from hyperspace_tpu.ops import hashing
+
+
+def sort_key_int64(arr: np.ndarray) -> np.ndarray:
+    """Order-preserving int64 key for any supported column dtype."""
+    kind = arr.dtype.kind
+    if kind in ("i", "u", "b"):
+        return arr.astype(np.int64)
+    if kind == "M":  # datetime64
+        return arr.view("int64").astype(np.int64)
+    if kind == "f":
+        bits = arr.astype(np.float64).view(np.int64)
+        # IEEE-754 total order: flip sign bit for positives, all bits for negatives
+        return np.where(bits >= 0, bits ^ np.int64(-0x8000000000000000), ~bits)
+    if kind in ("U", "S", "O"):
+        uniques, inverse = np.unique(arr.astype(object), return_inverse=True)
+        return inverse.astype(np.int64)
+    raise TypeError(f"Unsupported column dtype for sorting: {arr.dtype}")
+
+
+def hash_input_uint32(arr: np.ndarray) -> np.ndarray:
+    """uint32 bucket-hash input for any supported column dtype."""
+    if arr.dtype.kind in ("U", "S", "O"):
+        return hashing.string_hash32_array(arr)
+    return hashing.numeric_hash32(arr)
+
+
+def encode_key_columns(columns) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode the ordered list of key columns.
+
+    Returns ``(hash_inputs, sort_keys)`` with shapes (k, n) — uint32 and int64.
+    """
+    hash_inputs = np.stack([hash_input_uint32(c) for c in columns])
+    sort_keys = np.stack([sort_key_int64(c) for c in columns])
+    return hash_inputs, sort_keys

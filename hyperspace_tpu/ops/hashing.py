@@ -1,0 +1,113 @@
+"""Bucket hashing — identical on host (numpy) and device (jnp).
+
+The bucket assignment ``bucket = mix(key columns) % num_buckets`` must agree
+between index build, query-time bucket pruning (hash the filter literal), and
+hybrid-scan re-bucketing of appended rows — these are three call sites of one
+function, so both backends share the same 32-bit finalizer arithmetic.
+
+Plays the role of Spark's ``HashPartitioning`` over bucket columns
+(ref: HS/index/covering/CoveringIndex.scala:54-69 repartition;
+HS/index/covering/CoveringIndexRuleUtils.scala:357-417 on-the-fly re-bucketing).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import List, Sequence
+
+import numpy as np
+
+_C1 = np.uint32(0x85EBCA6B)
+_C2 = np.uint32(0xC2B2AE35)
+_SEED = np.uint32(0x9747B28C)
+
+
+def _mix32_np(h):
+    h = h ^ (h >> np.uint32(16))
+    h = h * _C1
+    h = h ^ (h >> np.uint32(13))
+    h = h * _C2
+    h = h ^ (h >> np.uint32(16))
+    return h
+
+
+def _mix32_jnp(h):
+    import jax.numpy as jnp
+
+    h = h ^ (h >> jnp.uint32(16))
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> jnp.uint32(13))
+    h = h * jnp.uint32(0xC2B2AE35)
+    h = h ^ (h >> jnp.uint32(16))
+    return h
+
+
+def combine_hashes_np(cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Combine per-column uint32 hash inputs into one row hash."""
+    with np.errstate(over="ignore"):
+        h = np.full(cols[0].shape, _SEED, dtype=np.uint32)
+        for i, c in enumerate(cols):
+            h = _mix32_np(h ^ _mix32_np(c.astype(np.uint32) + np.uint32((i * 0x9E3779B9) & 0xFFFFFFFF)))
+        return h
+
+
+def combine_hashes_jnp(cols) -> "jnp.ndarray":  # noqa: F821
+    import jax.numpy as jnp
+
+    h = jnp.full(cols[0].shape, jnp.uint32(0x9747B28C), dtype=jnp.uint32)
+    for i, c in enumerate(cols):
+        h = _mix32_jnp(h ^ _mix32_jnp(c.astype(jnp.uint32) + jnp.uint32((i * 0x9E3779B9) & 0xFFFFFFFF)))
+    return h
+
+
+def bucket_ids_np(hash_inputs: Sequence[np.ndarray], num_buckets: int) -> np.ndarray:
+    return (combine_hashes_np(hash_inputs) % np.uint32(num_buckets)).astype(np.int32)
+
+
+def bucket_ids_jnp(hash_inputs, num_buckets: int):
+    import jax.numpy as jnp
+
+    return (combine_hashes_jnp(hash_inputs) % jnp.uint32(num_buckets)).astype(jnp.int32)
+
+
+def string_hash32(value: str) -> np.uint32:
+    """Stable 32-bit hash input for a string value (md5-derived; the per-row
+    hash then mixes it like any numeric input)."""
+    digest = hashlib.md5(str(value).encode("utf-8")).digest()
+    return np.uint32(int.from_bytes(digest[:4], "little"))
+
+
+def string_hash32_array(values: np.ndarray) -> np.ndarray:
+    """Vectorized over uniques: factorize, hash each unique once, gather."""
+    uniques, inverse = np.unique(values.astype(object), return_inverse=True)
+    table = np.array([string_hash32(u) for u in uniques], dtype=np.uint32)
+    return table[inverse]
+
+
+def numeric_hash32(arr: np.ndarray) -> np.ndarray:
+    """uint32 hash input for numeric/datetime columns: fold the int64 bit
+    pattern to 32 bits."""
+    if arr.dtype.kind == "f":
+        bits = arr.astype(np.float64).view(np.uint64)
+    elif arr.dtype.kind == "M":
+        bits = arr.view("int64").astype(np.uint64)
+    elif arr.dtype.kind == "b":
+        bits = arr.astype(np.uint64)
+    else:
+        bits = arr.astype(np.int64).view(np.uint64)
+    return ((bits ^ (bits >> np.uint64(32))) & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def literal_hash32(value) -> np.uint32:
+    """Hash input of a scalar literal — used for query-time bucket pruning
+    (ref: FilterIndexRule useBucketSpec, HS/index/covering/FilterIndexRule.scala:162-167)."""
+    if isinstance(value, str):
+        return string_hash32(value)
+    arr = np.asarray([value])
+    return numeric_hash32(arr)[0]
+
+
+def bucket_of_literals(values: List, num_buckets: int) -> int:
+    """Bucket id for one composite key tuple (one value per bucket column)."""
+    inputs = [np.asarray([literal_hash32(v)], dtype=np.uint32) for v in values]
+    return int(bucket_ids_np(inputs, num_buckets)[0])

@@ -1,0 +1,62 @@
+"""On-device sort primitives.
+
+Replaces the per-partition sort of Spark's bucketed write
+(``sortWithinPartitions``; ref: HS/index/DataFrameWriterExtensions.scala:50-68).
+Lexicographic multi-key ordering is built from successive stable argsorts —
+each pass is one XLA sort, fused and tiled by the compiler.
+
+int64 keys require x64; enabled process-wide on import of this module (the
+framework owns the process' JAX config the way Spark owns its executors).
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from functools import partial  # noqa: E402
+
+
+def lex_argsort(keys) -> "jnp.ndarray":
+    """Stable argsort by ``keys[0]`` then ``keys[1]`` ... (most-significant
+    first). ``keys`` is a (k, n) array or list of (n,) arrays."""
+    keys = list(keys)
+    order = jnp.argsort(keys[-1], stable=True)
+    for key in reversed(keys[:-1]):
+        order = order[jnp.argsort(key[order], stable=True)]
+    return order
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_sort_perm(hash_inputs, sort_keys, num_buckets: int):
+    """The index-build kernel: assign buckets, then produce the permutation
+    that clusters rows by bucket and sorts by the indexed columns within each
+    bucket — the device replacement for Spark's
+    ``repartition(numBuckets, cols).sortWithinPartitions(cols)``
+    (ref: HS/index/covering/CoveringIndex.scala:54-69).
+
+    Args:
+      hash_inputs: (k, n) uint32 per-column hash inputs of the bucket keys.
+      sort_keys:   (k, n) int64 order-preserving keys of the sort columns.
+      num_buckets: static bucket count.
+
+    Returns:
+      (perm, sorted_buckets): ``perm`` (n,) row permutation; ``sorted_buckets``
+      (n,) the bucket id of each permuted row (non-decreasing).
+    """
+    from hyperspace_tpu.ops.hashing import bucket_ids_jnp
+
+    buckets = bucket_ids_jnp(list(hash_inputs), num_buckets)
+    perm = lex_argsort([buckets] + list(sort_keys))
+    return perm, buckets[perm]
+
+
+@partial(jax.jit, static_argnames=("num_buckets",))
+def bucket_counts(hash_inputs, num_buckets: int):
+    """Histogram of rows per bucket (used for write planning and skew checks)."""
+    from hyperspace_tpu.ops.hashing import bucket_ids_jnp
+
+    buckets = bucket_ids_jnp(list(hash_inputs), num_buckets)
+    return jnp.bincount(buckets, length=num_buckets)

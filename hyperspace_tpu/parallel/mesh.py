@@ -1,0 +1,36 @@
+"""Device-mesh helpers: bucket id ≡ device shard.
+
+The reference's parallelism is Spark hash-partitioning ("bucketing"); here the
+same layout is a 1-D ``jax.sharding.Mesh`` where bucket ``b`` lives on device
+``b % n_devices`` — so a bucketed join needs no collective at all, and
+re-bucketing is one ``all_to_all`` over ICI (SURVEY.md §2.9, §5.8).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DEFAULT_AXIS = "buckets"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = DEFAULT_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def device_of_bucket(bucket: int, n_devices: int) -> int:
+    return bucket % n_devices
+
+def sharded(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
+    axis = axis or mesh.axis_names[0]
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,94 @@
+"""User-facing DataFrame facade.
+
+A thin, lazy wrapper over the logical plan so that
+``hs.create_index(df, CoveringIndexConfig(...))`` and queries have something to
+operate on (SURVEY.md §7 stage 3). Collect triggers: optimizer rewrite (when
+Hyperspace is enabled on the session) then physical execution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union as TUnion
+
+import numpy as np
+
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import Col, Expr, col
+from hyperspace_tpu.plan.resolver import resolve_column, resolve_expr
+
+
+class DataFrame:
+    def __init__(self, plan: L.LogicalPlan, session):
+        self.plan = plan
+        self.session = session
+
+    # --- transformations ---------------------------------------------------
+    def filter(self, condition: Expr) -> "DataFrame":
+        resolved = resolve_expr(condition, self.plan.output_columns)
+        return DataFrame(L.Filter(resolved, self.plan), self.session)
+
+    where = filter
+
+    def select(self, *columns: TUnion[str, Col]) -> "DataFrame":
+        names = []
+        for c in columns:
+            name = c.name if isinstance(c, Col) else str(c)
+            resolved = resolve_column(name, self.plan.output_columns)
+            if resolved is None:
+                raise ValueError(f"Column {name!r} not found among {self.plan.output_columns}")
+            names.append(resolved)
+        return DataFrame(L.Project(names, self.plan), self.session)
+
+    def join(self, other: "DataFrame", on: TUnion[str, List[str], Expr], how: str = "inner") -> "DataFrame":
+        if isinstance(on, Expr):
+            condition = on
+        else:
+            keys = [on] if isinstance(on, str) else list(on)
+            terms: Optional[Expr] = None
+            for k in keys:
+                lk = resolve_column(k, self.plan.output_columns)
+                rk = resolve_column(k, other.plan.output_columns)
+                if lk is None or rk is None:
+                    raise ValueError(f"Join key {k!r} must exist on both sides")
+                term = col(lk) == col(rk)
+                terms = term if terms is None else (terms & term)
+            assert terms is not None
+            condition = terms
+        return DataFrame(L.Join(self.plan, other.plan, condition, how), self.session)
+
+    # --- actions -----------------------------------------------------------
+    def optimized_plan(self) -> L.LogicalPlan:
+        if self.session.hyperspace_enabled:
+            from hyperspace_tpu.rules.apply import ApplyHyperspace
+
+            return ApplyHyperspace(self.session).apply(self.plan)
+        return self.plan
+
+    def collect(self) -> Dict[str, np.ndarray]:
+        from hyperspace_tpu.exec.executor import Executor
+
+        plan = self.optimized_plan()
+        return Executor(self.session).execute(plan, required_columns=plan.output_columns)
+
+    def to_arrow(self):
+        from hyperspace_tpu.exec.batch import batch_to_table
+
+        return batch_to_table(self.collect(), self.plan.output_columns)
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def count(self) -> int:
+        from hyperspace_tpu.exec.batch import num_rows
+
+        return num_rows(self.collect())
+
+    @property
+    def columns(self) -> List[str]:
+        return self.plan.output_columns
+
+    def explain(self) -> str:
+        return self.plan.pretty()
+
+    def __repr__(self) -> str:
+        return f"DataFrame[{', '.join(self.plan.output_columns)}]"

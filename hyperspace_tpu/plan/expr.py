@@ -1,0 +1,321 @@
+"""Expression tree.
+
+A deliberately small expression language — exactly what the optimizer rules
+need: column refs, literals, comparisons, boolean connectives, arithmetic,
+``isin``/``is_null``, and ``input_file_name()`` (used for lineage, ref:
+HS/index/covering/CoveringIndex.scala:239-273). This replaces the slice of
+Spark Catalyst expressions the reference operates on; scope intentionally kept
+to what ``JoinPlanNodeFilter`` accepts (ref: HS/index/covering/JoinIndexRule.scala:135-155).
+
+Expressions evaluate over a column batch: a dict ``name -> numpy array``.
+Device-side evaluation compiles the same tree to jnp ops (see exec/device.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Set
+
+import numpy as np
+
+INPUT_FILE_NAME = "__input_file_name"
+
+# Nested-field normalization prefix (ref: util/ResolverUtils.scala:44-105).
+NESTED_PREFIX = "__hs_nested."
+
+
+class Expr:
+    """Base expression node. Python comparison operators build trees, so
+    identity-based hashing is retained explicitly."""
+
+    def references(self) -> Set[str]:
+        out: Set[str] = set()
+        self._collect_refs(out)
+        return out
+
+    def _collect_refs(self, out: Set[str]) -> None:
+        for c in self.children():
+            c._collect_refs(out)
+
+    def children(self) -> Sequence["Expr"]:
+        return ()
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+    # -- operator sugar ----------------------------------------------------
+    def __eq__(self, other: Any) -> "Expr":  # type: ignore[override]
+        return BinaryOp("=", self, _wrap(other))
+
+    def __ne__(self, other: Any) -> "Expr":  # type: ignore[override]
+        return BinaryOp("!=", self, _wrap(other))
+
+    def __lt__(self, other: Any) -> "Expr":
+        return BinaryOp("<", self, _wrap(other))
+
+    def __le__(self, other: Any) -> "Expr":
+        return BinaryOp("<=", self, _wrap(other))
+
+    def __gt__(self, other: Any) -> "Expr":
+        return BinaryOp(">", self, _wrap(other))
+
+    def __ge__(self, other: Any) -> "Expr":
+        return BinaryOp(">=", self, _wrap(other))
+
+    def __and__(self, other: Any) -> "Expr":
+        return BinaryOp("AND", self, _wrap(other))
+
+    def __or__(self, other: Any) -> "Expr":
+        return BinaryOp("OR", self, _wrap(other))
+
+    def __invert__(self) -> "Expr":
+        return Not(self)
+
+    def __add__(self, other: Any) -> "Expr":
+        return BinaryOp("+", self, _wrap(other))
+
+    def __sub__(self, other: Any) -> "Expr":
+        return BinaryOp("-", self, _wrap(other))
+
+    def __mul__(self, other: Any) -> "Expr":
+        return BinaryOp("*", self, _wrap(other))
+
+    def __truediv__(self, other: Any) -> "Expr":
+        return BinaryOp("/", self, _wrap(other))
+
+    def __mod__(self, other: Any) -> "Expr":
+        return BinaryOp("%", self, _wrap(other))
+
+    def isin(self, *values: Any) -> "Expr":
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        return In(self, [(_wrap(v)) for v in values])
+
+    def is_null(self) -> "Expr":
+        return IsNull(self)
+
+    def is_not_null(self) -> "Expr":
+        return Not(IsNull(self))
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __bool__(self) -> bool:
+        raise TypeError(
+            "Cannot convert Expr to bool; use & | ~ for boolean connectives."
+        )
+
+
+class Col(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def _collect_refs(self, out: Set[str]) -> None:
+        out.add(self.name)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        if self.name in batch:
+            return batch[self.name]
+        # case-insensitive fallback; resolution normally happens before eval
+        for k, v in batch.items():
+            if k.lower() == self.name.lower():
+                return v
+        raise KeyError(f"Column {self.name!r} not found in batch with columns {list(batch)}")
+
+    def __repr__(self) -> str:
+        return f"col({self.name!r})"
+
+
+class Lit(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.asarray(self.value)
+
+    def __repr__(self) -> str:
+        return f"lit({self.value!r})"
+
+
+class InputFileName(Expr):
+    """Evaluates to the source file path of each row
+    (ref: Spark's input_file_name(), used at HS/index/covering/CoveringIndex.scala:250)."""
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        if INPUT_FILE_NAME not in batch:
+            raise KeyError("input_file_name() requires a scan that tracks source files")
+        return batch[INPUT_FILE_NAME]
+
+    def __repr__(self) -> str:
+        return "input_file_name()"
+
+
+_COMPARES = {"=", "!=", "<", "<=", ">", ">="}
+_ARITH = {"+", "-", "*", "/", "%"}
+
+
+class BinaryOp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def children(self) -> Sequence[Expr]:
+        return (self.left, self.right)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        l = self.left.eval(batch)
+        r = self.right.eval(batch)
+        op = self.op
+        if op == "=":
+            return np.asarray(l == r)
+        if op == "!=":
+            return np.asarray(l != r)
+        if op == "<":
+            return np.asarray(l < r)
+        if op == "<=":
+            return np.asarray(l <= r)
+        if op == ">":
+            return np.asarray(l > r)
+        if op == ">=":
+            return np.asarray(l >= r)
+        if op == "AND":
+            return np.logical_and(l, r)
+        if op == "OR":
+            return np.logical_or(l, r)
+        if op == "+":
+            return l + r
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            return l / r
+        if op == "%":
+            return l % r
+        raise ValueError(f"Unknown op {op!r}")
+
+    def __repr__(self) -> str:
+        return f"({self.left!r} {self.op} {self.right!r})"
+
+
+class Not(Expr):
+    def __init__(self, child: Expr):
+        self.child = child
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.logical_not(self.child.eval(batch))
+
+    def __repr__(self) -> str:
+        return f"(NOT {self.child!r})"
+
+
+class IsNull(Expr):
+    def __init__(self, child: Expr):
+        self.child = child
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        v = self.child.eval(batch)
+        if v.dtype.kind == "f":
+            return np.isnan(v)
+        if v.dtype == object:
+            return np.asarray([x is None for x in v])
+        return np.zeros(v.shape, dtype=bool)
+
+    def __repr__(self) -> str:
+        return f"({self.child!r} IS NULL)"
+
+
+class In(Expr):
+    def __init__(self, child: Expr, values: List[Lit]):
+        self.child = child
+        self.values = values
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child, *self.values)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        v = self.child.eval(batch)
+        vals = [x.value for x in self.values]
+        return np.isin(v, vals)
+
+    def __repr__(self) -> str:
+        return f"({self.child!r} IN {[v.value for v in self.values]!r})"
+
+
+def _wrap(x: Any) -> Expr:
+    return x if isinstance(x, Expr) else Lit(x)
+
+
+def col(name: str) -> Col:
+    return Col(name)
+
+
+def lit(value: Any) -> Lit:
+    return Lit(value)
+
+
+def input_file_name() -> InputFileName:
+    return InputFileName()
+
+
+# --- analysis helpers used by optimizer rules ------------------------------
+
+def contains_input_file_name(e: Expr) -> bool:
+    """True if the expression references input_file_name(). Index rewrites
+    must bail out on such predicates: after the rewrite the function would
+    evaluate to *index* file paths, silently changing results."""
+    if isinstance(e, InputFileName):
+        return True
+    return any(contains_input_file_name(c) for c in e.children())
+
+
+def split_conjunctive(e: Expr) -> List[Expr]:
+    """Split a predicate on top-level ANDs (CNF split used by
+    FilterIndexRule/JoinIndexRule; ref: HS/index/covering/JoinIndexRule.scala:149-155)."""
+    if isinstance(e, BinaryOp) and e.op == "AND":
+        return split_conjunctive(e.left) + split_conjunctive(e.right)
+    return [e]
+
+
+def extract_equi_join_keys(e: Expr) -> Optional[List[tuple]]:
+    """If ``e`` is a conjunction of ``col = col`` terms, return the (left, right)
+    column-name pairs; else None (ref: JoinPlanNodeFilter's equi-join CNF check,
+    HS/index/covering/JoinIndexRule.scala:135-155)."""
+    pairs = []
+    for term in split_conjunctive(e):
+        if isinstance(term, BinaryOp) and term.op == "=" and isinstance(term.left, Col) and isinstance(term.right, Col):
+            pairs.append((term.left.name, term.right.name))
+        else:
+            return None
+    return pairs
+
+
+def extract_eq_literal(e: Expr) -> Optional[tuple]:
+    """If ``e`` is ``col = lit`` or ``lit = col``, return (col_name, value)."""
+    if isinstance(e, BinaryOp) and e.op == "=":
+        if isinstance(e.left, Col) and isinstance(e.right, Lit):
+            return (e.left.name, e.right.value)
+        if isinstance(e.right, Col) and isinstance(e.left, Lit):
+            return (e.right.name, e.left.value)
+    return None
+
+
+def rewrite_columns(e: Expr, mapping: Dict[str, str]) -> Expr:
+    """Return a copy of ``e`` with column names rewritten via ``mapping``."""
+    if isinstance(e, Col):
+        return Col(mapping.get(e.name, e.name))
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, rewrite_columns(e.left, mapping), rewrite_columns(e.right, mapping))
+    if isinstance(e, Not):
+        return Not(rewrite_columns(e.child, mapping))
+    if isinstance(e, IsNull):
+        return IsNull(rewrite_columns(e.child, mapping))
+    if isinstance(e, In):
+        return In(rewrite_columns(e.child, mapping), list(e.values))
+    return e

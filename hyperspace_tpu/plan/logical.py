@@ -1,0 +1,312 @@
+"""Logical plan IR.
+
+A minimal relational algebra — Scan / Filter / Project / Join / Union plus the
+index-specific nodes the optimizer rewrites plans into: ``IndexScan`` (replaces
+a source scan; ref: IndexHadoopFsRelation, HS/index/plans/logical/IndexHadoopFsRelation.scala:29-50),
+``Repartition`` (on-the-fly re-bucketing of appended data; ref:
+HS/index/covering/CoveringIndexRuleUtils.scala:357-417) and ``BucketUnion``
+(partition-preserving union; ref: HS/index/plans/logical/BucketUnion.scala:31-68).
+
+Scope is intentionally the slice of Catalyst the reference's rules accept:
+linear plans of Project→Filter→Scan and equi-joins of such
+(ref: HS/index/covering/JoinIndexRule.scala:135-155).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from hyperspace_tpu.plan.expr import Expr
+
+
+@dataclass(frozen=True)
+class BucketSpec:
+    """Hash-bucket layout of stored data: ``num_buckets`` buckets over
+    ``bucket_columns``, rows sorted by ``sort_columns`` within each bucket
+    (ref: Spark BucketSpec as used at HS/index/covering/CoveringIndex.scala:173-177)."""
+
+    num_buckets: int
+    bucket_columns: Tuple[str, ...]
+    sort_columns: Tuple[str, ...]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "numBuckets": self.num_buckets,
+            "bucketColumns": list(self.bucket_columns),
+            "sortColumns": list(self.sort_columns),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BucketSpec":
+        return cls(d["numBuckets"], tuple(d["bucketColumns"]), tuple(d["sortColumns"]))
+
+
+class LogicalPlan:
+    """Base plan node. Nodes are immutable-by-convention; rewrites build new trees."""
+
+    def children(self) -> Sequence["LogicalPlan"]:
+        return ()
+
+    @property
+    def output_columns(self) -> List[str]:
+        raise NotImplementedError
+
+    def with_children(self, children: Sequence["LogicalPlan"]) -> "LogicalPlan":
+        raise NotImplementedError
+
+    def pretty(self, indent: int = 0) -> str:
+        line = "  " * indent + self.describe()
+        return "\n".join([line] + [c.pretty(indent + 1) for c in self.children()])
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+    def __repr__(self) -> str:
+        return self.pretty()
+
+
+class Scan(LogicalPlan):
+    """Scan over a source relation (ref: Spark LogicalRelation over
+    HadoopFsRelation; SPI: HS/index/sources/interfaces.scala:43-158)."""
+
+    def __init__(self, relation: "FileBasedRelation"):  # noqa: F821
+        self.relation = relation
+
+    @property
+    def output_columns(self) -> List[str]:
+        return [f.name for f in self.relation.schema]
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Scan":
+        assert not children
+        return self
+
+    def describe(self) -> str:
+        return f"Scan({self.relation.name}, format={self.relation.file_format})"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, condition: Expr, child: LogicalPlan):
+        self.condition = condition
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.child.output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Filter":
+        (child,) = children
+        return Filter(self.condition, child)
+
+    def describe(self) -> str:
+        return f"Filter({self.condition!r})"
+
+
+class Project(LogicalPlan):
+    def __init__(self, columns: List[str], child: LogicalPlan):
+        self.columns = list(columns)
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Project":
+        (child,) = children
+        return Project(self.columns, child)
+
+    def describe(self) -> str:
+        return f"Project({self.columns})"
+
+
+class Join(LogicalPlan):
+    """Equi-join. ``condition`` must be a conjunction of col = col terms
+    (the only shape the reference's JoinIndexRule accepts,
+    ref: HS/index/covering/JoinIndexRule.scala:149-155)."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: Expr, how: str = "inner"):
+        self.left = left
+        self.right = right
+        self.condition = condition
+        self.how = how
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.left, self.right)
+
+    @property
+    def output_columns(self) -> List[str]:
+        # disambiguate duplicate names with l_/r_ prefix applied at execution
+        left_cols = self.left.output_columns
+        right_cols = self.right.output_columns
+        out = list(left_cols)
+        for c in right_cols:
+            out.append(c if c not in left_cols else f"{c}#r")
+        return out
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Join":
+        left, right = children
+        return Join(left, right, self.condition, self.how)
+
+    def describe(self) -> str:
+        return f"Join({self.condition!r}, how={self.how})"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children_: List[LogicalPlan]):
+        self._children = list(children_)
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return tuple(self._children)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self._children[0].output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Union":
+        return Union(list(children))
+
+
+# --- index-side nodes (appear only in rewritten plans) ----------------------
+
+
+class FileScan(LogicalPlan):
+    """Scan of an explicit file list (used for the appended-files side of
+    hybrid scan; ref: CoveringIndexRuleUtils' appended-data scan,
+    HS/index/covering/CoveringIndexRuleUtils.scala:206-243)."""
+
+    def __init__(self, files: List[str], file_format: str, columns: List[str]):
+        self.files = list(files)
+        self.file_format = file_format
+        self.columns = list(columns)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "FileScan":
+        assert not children
+        return self
+
+    def describe(self) -> str:
+        return f"FileScan({len(self.files)} files, format={self.file_format})"
+
+
+class IndexScan(LogicalPlan):
+    """Scan of covering-index data files instead of source files.
+
+    ``pruned_buckets`` — when bucket pruning applies (selective equality
+    predicate on the first indexed column), only those buckets' files are read
+    (ref: FilterIndexRule's useBucketSpec path,
+    HS/index/covering/FilterIndexRule.scala:162-167).
+    """
+
+    def __init__(
+        self,
+        entry: "IndexLogEntry",  # noqa: F821
+        columns: List[str],
+        bucket_spec: Optional[BucketSpec],
+        files: Optional[List[str]] = None,
+        pruned_buckets: Optional[List[int]] = None,
+    ):
+        self.entry = entry
+        self.columns = list(columns)
+        self.bucket_spec = bucket_spec
+        self.files = files if files is not None else entry.content.files
+        self.pruned_buckets = pruned_buckets
+
+    @property
+    def output_columns(self) -> List[str]:
+        return list(self.columns)
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "IndexScan":
+        assert not children
+        return self
+
+    def describe(self) -> str:
+        extra = f", prunedBuckets={self.pruned_buckets}" if self.pruned_buckets is not None else ""
+        n = self.bucket_spec.num_buckets if self.bucket_spec else None
+        return (
+            f"IndexScan(Hyperspace(Type: CI, Name: {self.entry.name}, "
+            f"LogVersion: {self.entry.id}), buckets={n}{extra})"
+        )
+
+
+class Repartition(LogicalPlan):
+    """Hash-repartition child rows into ``bucket_spec`` buckets — injected on
+    top of appended-data scans so hybrid scan can merge with index buckets.
+    On TPU this lowers to on-device hashing + all-to-all over ICI
+    (ref: RepartitionByExpression injection,
+    HS/index/covering/CoveringIndexRuleUtils.scala:357-417)."""
+
+    def __init__(self, bucket_spec: BucketSpec, child: LogicalPlan):
+        self.bucket_spec = bucket_spec
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.child.output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Repartition":
+        (child,) = children
+        return Repartition(self.bucket_spec, child)
+
+    def describe(self) -> str:
+        return f"Repartition(n={self.bucket_spec.num_buckets}, cols={list(self.bucket_spec.bucket_columns)})"
+
+
+class BucketUnion(LogicalPlan):
+    """Union preserving bucket layout: all children share the same
+    ``bucket_spec``; the i-th bucket of the output is the concatenation of the
+    i-th buckets of the children — no reshuffle
+    (ref: HS/index/plans/logical/BucketUnion.scala:31-68,
+    HS/index/execution/BucketUnionExec.scala:52-121)."""
+
+    def __init__(self, children_: List[LogicalPlan], bucket_spec: BucketSpec):
+        self._children = list(children_)
+        self.bucket_spec = bucket_spec
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return tuple(self._children)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self._children[0].output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "BucketUnion":
+        return BucketUnion(list(children), self.bucket_spec)
+
+    def describe(self) -> str:
+        return f"BucketUnion(n={self.bucket_spec.num_buckets})"
+
+
+# --- traversal helpers ------------------------------------------------------
+
+def collect(plan: LogicalPlan, predicate) -> List[LogicalPlan]:
+    out = []
+    if predicate(plan):
+        out.append(plan)
+    for c in plan.children():
+        out.extend(collect(c, predicate))
+    return out
+
+
+def transform_up(plan: LogicalPlan, fn) -> LogicalPlan:
+    new_children = [transform_up(c, fn) for c in plan.children()]
+    if list(new_children) != list(plan.children()):
+        plan = plan.with_children(new_children)
+    return fn(plan)
+
+
+def plan_key(plan: LogicalPlan) -> int:
+    """Stable per-process identity used for tagging (the reference tags plan
+    objects directly; ref: HS/index/IndexLogEntry.scala:519-571)."""
+    return id(plan)

@@ -1,0 +1,51 @@
+"""ApplyHyperspace — the optimizer entry point.
+
+Fetch ACTIVE indexes, collect per-scan candidates, run the score-based
+rewrite; swallow all exceptions so index application can never break a query
+(ref: HS/index/rules/ApplyHyperspace.scala:31-66).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from hyperspace_tpu.models import states
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.rules.candidate import collect_candidates
+from hyperspace_tpu.rules.context import RuleContext
+from hyperspace_tpu.rules.score import ScoreBasedIndexPlanOptimizer
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+
+logger = logging.getLogger(__name__)
+
+
+class ApplyHyperspace:
+    def __init__(self, session, analysis_enabled: bool = False):
+        self.session = session
+        self.ctx = RuleContext(session, analysis_enabled)
+
+    def apply(self, plan: L.LogicalPlan) -> L.LogicalPlan:
+        try:
+            new_plan, _score = self.apply_with_score(plan)
+            return new_plan
+        except Exception:  # never break a query (ref: ApplyHyperspace.scala:59-63)
+            logger.warning("Hyperspace rule application failed; falling back", exc_info=True)
+            return plan
+
+    def apply_with_score(self, plan: L.LogicalPlan):
+        indexes = self.session.index_manager.get_indexes([states.ACTIVE])
+        if not indexes:
+            return plan, 0
+        candidates = collect_candidates(self.ctx, plan, indexes)
+        if not candidates:
+            return plan, 0
+        new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(plan, candidates)
+        if score > 0:
+            used = sorted(
+                {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
+            )
+            get_event_logger(self.session).log_event(
+                HyperspaceIndexUsageEvent(index_names=used, plan_summary=new_plan.describe())
+            )
+        return new_plan, score

@@ -1,0 +1,111 @@
+"""Candidate index collection.
+
+Per source leaf (Scan), chain ``ColumnSchemaFilter`` then
+``FileSignatureFilter`` (ref: HS/index/rules/CandidateIndexCollector.scala:28-60,
+ColumnSchemaFilter.scala:28-45, FileSignatureFilter.scala:33-192).
+
+``FileSignatureFilter`` is where Hybrid Scan eligibility is decided: when
+exact signature match fails, compare file sets; appended/deleted byte ratios
+must stay under thresholds, and deletes require lineage.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from hyperspace_tpu.analysis import reasons as R
+from hyperspace_tpu.models.log_entry import FileInfo, IndexLogEntry
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.rules.context import RuleContext
+from hyperspace_tpu.sources.signatures import index_signature
+
+
+def _schema_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntry]) -> List[IndexLogEntry]:
+    """Index's referenced columns ⊆ relation output (ref: ColumnSchemaFilter.scala:29-44)."""
+    out = []
+    relation_cols = {c.lower() for c in scan.output_columns}
+    for entry in indexes:
+        props = entry.derived_dataset.properties
+        referenced = [str(c) for c in props.get("indexedColumns", [])] + [
+            str(c) for c in props.get("includedColumns", [])
+        ]
+        ok = all(c.lower() in relation_cols for c in referenced)
+        if ctx.tag_reason_if_failed(
+            ok, entry, scan, lambda: R.col_schema_mismatch(referenced, scan.output_columns)
+        ):
+            out.append(entry)
+    return out
+
+
+def _signature_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntry]) -> List[IndexLogEntry]:
+    """Signature equality, or Hybrid-Scan file-set comparison
+    (ref: FileSignatureFilter.scala:49-191)."""
+    conf = ctx.session.conf
+    current_sig = index_signature(scan)
+    current_files = {fi.key: fi for fi in scan.relation.all_file_infos()}
+    total_bytes = sum(fi.size for fi in current_files.values())
+
+    out = []
+    for e in indexes:
+        entry = scan.relation.closest_index(e)
+        if entry.signature.signatures and entry.signature.signatures[0].value == current_sig:
+            entry.set_tag(L.plan_key(scan), R.COMMON_SOURCE_SIZE_IN_BYTES, entry.source_files_size())
+            entry.set_tag(L.plan_key(scan), R.HYBRIDSCAN_REQUIRED, False)
+            out.append(entry)
+            continue
+
+        if not conf.hybrid_scan_enabled:
+            ctx.tag_reason_if_failed(False, entry, scan, R.source_data_changed)
+            continue
+
+        # Hybrid scan eligibility: file-level diff (ref: :108-191)
+        indexed_files = {fi.key: fi for fi in entry.source_file_infos()}
+        common_keys = current_files.keys() & indexed_files.keys()
+        appended = [current_files[k] for k in current_files.keys() - indexed_files.keys()]
+        deleted = [indexed_files[k] for k in indexed_files.keys() - current_files.keys()]
+        common_bytes = sum(indexed_files[k].size for k in common_keys)
+        if not common_keys:
+            ctx.tag_reason_if_failed(False, entry, scan, R.source_data_changed)
+            continue
+
+        appended_bytes = sum(f.size for f in appended)
+        deleted_bytes = sum(f.size for f in deleted)
+        if deleted:
+            if not entry.has_lineage_column():
+                ctx.tag_reason_if_failed(False, entry, scan, R.no_delete_support)
+                continue
+            deleted_ratio = deleted_bytes / max(1, entry.source_files_size())
+            if deleted_ratio > conf.hybrid_scan_deleted_ratio_threshold:
+                ctx.tag_reason_if_failed(
+                    False, entry, scan,
+                    lambda: R.too_many_deleted(deleted_ratio, conf.hybrid_scan_deleted_ratio_threshold),
+                )
+                continue
+        appended_ratio = appended_bytes / max(1, total_bytes)
+        if appended_ratio > conf.hybrid_scan_appended_ratio_threshold:
+            ctx.tag_reason_if_failed(
+                False, entry, scan,
+                lambda: R.too_many_appended(appended_ratio, conf.hybrid_scan_appended_ratio_threshold),
+            )
+            continue
+
+        key = L.plan_key(scan)
+        entry.set_tag(key, R.COMMON_SOURCE_SIZE_IN_BYTES, common_bytes)
+        entry.set_tag(key, R.HYBRIDSCAN_REQUIRED, True)
+        entry.set_tag(key, R.HYBRIDSCAN_APPENDED, [f.name for f in appended])
+        entry.set_tag(key, R.HYBRIDSCAN_DELETED, [f.name for f in deleted])
+        out.append(entry)
+    return out
+
+
+def collect_candidates(
+    ctx: RuleContext, plan: L.LogicalPlan, indexes: List[IndexLogEntry]
+) -> Dict[int, Tuple[L.Scan, List[IndexLogEntry]]]:
+    """Map each Scan leaf (by plan key) to its eligible index entries
+    (ref: CandidateIndexCollector.scala:49-59)."""
+    out: Dict[int, Tuple[L.Scan, List[IndexLogEntry]]] = {}
+    for scan in L.collect(plan, lambda p: isinstance(p, L.Scan)):
+        eligible = _signature_filter(ctx, scan, _schema_filter(ctx, scan, indexes))
+        if eligible:
+            out[L.plan_key(scan)] = (scan, eligible)
+    return out

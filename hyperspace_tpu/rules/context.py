@@ -1,0 +1,39 @@
+"""Per-optimization rule context: session + analysis mode + reason tagging
+(the reference uses thread-locals and entry tags;
+ref: HS/index/rules/IndexFilter.scala:25-110, JoinIndexRule.scala:632-636).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hyperspace_tpu.analysis import reasons as R
+from hyperspace_tpu.models.log_entry import IndexLogEntry
+from hyperspace_tpu.plan.logical import LogicalPlan, plan_key
+
+
+class RuleContext:
+    def __init__(self, session, analysis_enabled: bool = False):
+        self.session = session
+        self.analysis_enabled = analysis_enabled
+
+    def tag_reason_if_failed(
+        self, passed: bool, entry: IndexLogEntry, plan: LogicalPlan, reason_fn
+    ) -> bool:
+        """``withFilterReasonTag`` (ref: IndexFilter.scala:36-109): when
+        analysis is on and the check failed, append the reason to the entry's
+        FILTER_REASONS tag for this (sub)plan."""
+        if not passed and self.analysis_enabled:
+            key = plan_key(plan)
+            existing = entry.get_tag(key, R.FILTER_REASONS) or []
+            existing.append(reason_fn())
+            entry.set_tag(key, R.FILTER_REASONS, existing)
+        return passed
+
+    def tag_applicable_rule(self, entry: IndexLogEntry, plan: LogicalPlan, rule_name: str) -> None:
+        if self.analysis_enabled:
+            key = plan_key(plan)
+            existing = entry.get_tag(key, R.APPLICABLE_INDEX_RULES) or []
+            if rule_name not in existing:
+                existing.append(rule_name)
+            entry.set_tag(key, R.APPLICABLE_INDEX_RULES, existing)

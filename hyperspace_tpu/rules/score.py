@@ -1,0 +1,54 @@
+"""Score-based plan optimizer.
+
+Memoized recursion: at each node, the best of (a) applying a rule to the whole
+sub-tree rooted here, (b) keeping the node and optimizing children
+independently (the NoOpRule path)
+(ref: HS/index/rules/ScoreBasedIndexPlanOptimizer.scala:29-78; rules list =
+FilterIndexRule :: JoinIndexRule :: NoOpRule).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.rules.context import RuleContext
+from hyperspace_tpu.rules.filter_rule import apply_filter_index_rule
+from hyperspace_tpu.rules.join_rule import apply_join_index_rule
+
+RULES = (apply_filter_index_rule, apply_join_index_rule)
+
+
+class ScoreBasedIndexPlanOptimizer:
+    def __init__(self, ctx: RuleContext):
+        self.ctx = ctx
+        self._memo: Dict[int, Tuple[L.LogicalPlan, int]] = {}
+
+    def apply(self, plan: L.LogicalPlan, candidates) -> Tuple[L.LogicalPlan, int]:
+        return self._rec(plan, candidates)
+
+    def _rec(self, plan: L.LogicalPlan, candidates) -> Tuple[L.LogicalPlan, int]:
+        key = id(plan)
+        if key in self._memo:
+            return self._memo[key]
+
+        # NoOp path: optimize children independently (score = sum)
+        children = list(plan.children())
+        best_plan, best_score = plan, 0
+        if children:
+            new_children = []
+            child_score = 0
+            for c in children:
+                nc, s = self._rec(c, candidates)
+                new_children.append(nc)
+                child_score += s
+            if child_score > 0:
+                best_plan, best_score = plan.with_children(new_children), child_score
+
+        for rule in RULES:
+            transformed, score = rule(self.ctx, plan, candidates)
+            if score > best_score:
+                best_plan, best_score = transformed, score
+
+        self._memo[key] = (best_plan, best_score)
+        return best_plan, best_score

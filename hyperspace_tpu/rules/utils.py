@@ -1,0 +1,177 @@
+"""Plan-transformation utilities shared by the covering-index rules
+(ref: HS/index/covering/CoveringIndexRuleUtils.scala:55-288).
+
+Two rewrite shapes:
+
+  1. index-only scan — swap the source Scan for an IndexScan over the index's
+     bucket files, optionally bucket-pruned (ref: :98-130);
+  2. Hybrid Scan — index data + appended source files re-bucketed on the fly,
+     merged with BucketUnion; rows from deleted source files are filtered out
+     via the lineage column (ref: :146-288).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu import config as C
+from hyperspace_tpu.analysis import reasons as R
+from hyperspace_tpu.indexes.covering import CoveringIndex, bucket_of_file
+from hyperspace_tpu.models.log_entry import IndexLogEntry
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import (
+    Col,
+    Expr,
+    In,
+    Lit,
+    Not,
+    extract_eq_literal,
+    split_conjunctive,
+)
+from hyperspace_tpu.rules.context import RuleContext
+
+
+def destructure_linear(plan: L.LogicalPlan) -> Optional[Tuple[Optional[List[str]], Optional[Expr], L.Scan]]:
+    """Match [Project] -> [Filter] -> Scan; return (project_cols, condition, scan)
+    (the only sub-plan shape the rules accept;
+    ref: FilterPlanNodeFilter / JoinPlanNodeFilter linearity checks)."""
+    project_cols = None
+    condition = None
+    node = plan
+    if isinstance(node, L.Project):
+        project_cols = list(node.columns)
+        node = node.child
+    if isinstance(node, L.Filter):
+        condition = node.condition
+        node = node.child
+    if isinstance(node, L.Scan):
+        return project_cols, condition, node
+    return None
+
+
+def pruned_buckets_for_predicate(
+    condition: Optional[Expr], bucket_columns: Tuple[str, ...], num_buckets: int
+) -> Optional[List[int]]:
+    """Bucket pruning: an equality (or IN) conjunct on the single bucket
+    column narrows the scan to specific buckets
+    (ref: FilterIndexRule useBucketSpec, HS/index/covering/FilterIndexRule.scala:162-167)."""
+    from hyperspace_tpu.ops.hashing import bucket_of_literals
+
+    if condition is None or len(bucket_columns) != 1:
+        return None
+    key = bucket_columns[0].lower()
+    for term in split_conjunctive(condition):
+        eq = extract_eq_literal(term)
+        if eq is not None and eq[0].lower() == key:
+            return [bucket_of_literals([eq[1]], num_buckets)]
+        if isinstance(term, In) and isinstance(term.child, Col) and term.child.name.lower() == key:
+            return sorted({bucket_of_literals([v.value], num_buckets) for v in term.values})
+    return None
+
+
+def index_files_for_buckets(entry: IndexLogEntry, buckets: Optional[List[int]]) -> List[str]:
+    files = entry.content.files
+    if buckets is None:
+        return files
+    allowed = set(buckets)
+    return [f for f in files if bucket_of_file(f) in allowed]
+
+
+def transform_plan_to_use_index(
+    ctx: RuleContext,
+    entry: IndexLogEntry,
+    sub_plan: L.LogicalPlan,
+    use_bucket_spec: bool,
+) -> L.LogicalPlan:
+    """Rewrite a linear sub-plan to scan the covering index instead of the
+    source (ref: transformPlanToUseIndex, CoveringIndexRuleUtils.scala:55-83)."""
+    parts = destructure_linear(sub_plan)
+    assert parts is not None
+    project_cols, condition, scan = parts
+    required = project_cols if project_cols is not None else scan.output_columns
+    if condition is not None:
+        cond_refs = [c for c in condition.references()]
+        required_all = list(dict.fromkeys(list(required) + cond_refs))
+    else:
+        required_all = list(required)
+
+    index = CoveringIndex.from_derived_dataset(entry.derived_dataset)
+    bucket_spec = index.bucket_spec()
+    hybrid = bool(entry.get_tag(L.plan_key(scan), R.HYBRIDSCAN_REQUIRED))
+
+    if not hybrid:
+        buckets = (
+            pruned_buckets_for_predicate(condition, bucket_spec.bucket_columns, bucket_spec.num_buckets)
+            if use_bucket_spec
+            else None
+        )
+        new_scan: L.LogicalPlan = L.IndexScan(
+            entry,
+            columns=required_all,
+            bucket_spec=bucket_spec if use_bucket_spec else None,
+            files=index_files_for_buckets(entry, buckets),
+            pruned_buckets=buckets,
+        )
+    else:
+        new_scan = _hybrid_scan_plan(ctx, entry, scan, required_all, bucket_spec)
+
+    out: L.LogicalPlan = new_scan
+    if condition is not None:
+        out = L.Filter(condition, out)
+    if project_cols is not None or set(out.output_columns) != set(required):
+        out = L.Project(list(required), out)
+    return out
+
+
+def _hybrid_scan_plan(
+    ctx: RuleContext,
+    entry: IndexLogEntry,
+    scan: L.Scan,
+    required: List[str],
+    bucket_spec: L.BucketSpec,
+) -> L.LogicalPlan:
+    """Hybrid Scan: BucketUnion(index-minus-deleted, rebucketed-appended)
+    (ref: CoveringIndexRuleUtils.scala:146-288)."""
+    key = L.plan_key(scan)
+    appended: List[str] = entry.get_tag(key, R.HYBRIDSCAN_APPENDED) or []
+    deleted: List[str] = entry.get_tag(key, R.HYBRIDSCAN_DELETED) or []
+
+    index_cols = list(required)
+    if deleted and C.DATA_FILE_NAME_ID not in index_cols:
+        index_cols = index_cols + [C.DATA_FILE_NAME_ID]
+
+    index_side: L.LogicalPlan = L.IndexScan(entry, columns=index_cols, bucket_spec=bucket_spec)
+    if deleted:
+        tracker = entry.file_id_tracker()
+        deleted_infos = {fi.name: fi for fi in entry.source_file_infos()}
+        ids = []
+        for name in deleted:
+            fi = deleted_infos.get(name)
+            if fi is not None and fi.file_id != C.UNKNOWN_FILE_ID:
+                ids.append(fi.file_id)
+            else:
+                fid = next((v for k, v in tracker.file_to_id_map().items() if k[0] == name), None)
+                if fid is not None:
+                    ids.append(fid)
+        # Not(In(_data_file_id, deletedIds)) (ref: :244-253)
+        index_side = L.Filter(Not(In(Col(C.DATA_FILE_NAME_ID), [Lit(i) for i in ids])), index_side)
+        index_side = L.Project(list(required), index_side)
+
+    if not appended:
+        return index_side
+
+    appended_scan = L.FileScan(appended, scan.relation.physical_format, list(required))
+    rebucketed = L.Repartition(bucket_spec, appended_scan)
+    branches = [index_side, rebucketed]
+    return L.BucketUnion(branches, bucket_spec)
+
+
+def hybrid_coverage_fraction(entry: IndexLogEntry, scan: L.Scan) -> float:
+    """commonBytes / currentTotalBytes — scales rule scores under hybrid scan
+    (ref: FilterIndexRule score :170-193, JoinIndexRule score :674-704)."""
+    key = L.plan_key(scan)
+    if not entry.get_tag(key, R.HYBRIDSCAN_REQUIRED):
+        return 1.0
+    common = entry.get_tag(key, R.COMMON_SOURCE_SIZE_IN_BYTES) or 0
+    total = sum(fi.size for fi in scan.relation.all_file_infos())
+    return common / max(1, total)

@@ -1,0 +1,116 @@
+"""Session: configuration + source providers + the optimizer kill-switch.
+
+Plays the role of SparkSession in the reference: carries conf, hosts the
+provider manager and the (caching) index collection manager, and owns the
+"Hyperspace enabled" flag that installs the optimizer rule
+(ref: ``spark.enableHyperspace()``, HS/package.scala:29-69).
+
+Also owns the device mesh used by the TPU execution layer: bucket id ≡ device
+shard (SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional
+
+from hyperspace_tpu.config import HyperspaceConf
+from hyperspace_tpu.sources.manager import FileBasedSourceProviderManager
+
+
+class Session:
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        self.conf = HyperspaceConf(conf)
+        self.provider_manager = FileBasedSourceProviderManager(self)
+        self.hyperspace_enabled = False
+        self._index_manager = None
+        self._mesh = None
+
+    # --- reading data ------------------------------------------------------
+    def read(self, paths, file_format: str, **options) -> "DataFrame":  # noqa: F821
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.logical import Scan
+
+        if isinstance(paths, str):
+            paths = [paths]
+        relation = self.provider_manager.create_relation((list(paths), file_format, options))
+        return DataFrame(Scan(relation), self)
+
+    def read_parquet(self, *paths, **options) -> "DataFrame":  # noqa: F821
+        return self.read(list(paths), "parquet", **options)
+
+    def read_csv(self, *paths, **options) -> "DataFrame":  # noqa: F821
+        return self.read(list(paths), "csv", **options)
+
+    def read_json(self, *paths, **options) -> "DataFrame":  # noqa: F821
+        return self.read(list(paths), "json", **options)
+
+    def read_delta(self, path, version: Optional[int] = None) -> "DataFrame":  # noqa: F821
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.logical import Scan
+        from hyperspace_tpu.sources.delta import DeltaLakeRelation
+
+        return DataFrame(Scan(DeltaLakeRelation(path, version=version)), self)
+
+    # --- hyperspace toggle (ref: HS/package.scala:36-43) -------------------
+    def enable_hyperspace(self) -> "Session":
+        self.hyperspace_enabled = True
+        return self
+
+    def disable_hyperspace(self) -> "Session":
+        self.hyperspace_enabled = False
+        return self
+
+    def is_hyperspace_enabled(self) -> bool:
+        return self.hyperspace_enabled
+
+    @contextlib.contextmanager
+    def with_hyperspace_disabled(self):
+        prev = self.hyperspace_enabled
+        self.hyperspace_enabled = False
+        try:
+            yield
+        finally:
+            self.hyperspace_enabled = prev
+
+    # --- index manager ------------------------------------------------------
+    @property
+    def index_manager(self):
+        if self._index_manager is None:
+            from hyperspace_tpu.manager import CachingIndexCollectionManager
+
+            self._index_manager = CachingIndexCollectionManager(self)
+        return self._index_manager
+
+    # --- device mesh --------------------------------------------------------
+    @property
+    def mesh(self):
+        """Lazily created 1-D device mesh over all local devices; the axis name
+        comes from conf ``hyperspace.tpu.mesh.axis``."""
+        if self._mesh is None:
+            import jax
+            from jax.sharding import Mesh
+            import numpy as np
+
+            devices = np.array(jax.devices())
+            self._mesh = Mesh(devices, (self.conf.mesh_axis,))
+        return self._mesh
+
+    def set_mesh(self, mesh) -> "Session":
+        self._mesh = mesh
+        return self
+
+
+_current: Optional[Session] = None
+
+
+def get_session() -> Session:
+    global _current
+    if _current is None:
+        _current = Session()
+    return _current
+
+
+def set_session(session: Optional[Session]) -> None:
+    global _current
+    _current = session

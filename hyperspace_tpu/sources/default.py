@@ -1,0 +1,146 @@
+"""Default file-based source provider: Parquet (and CSV/JSON via pyarrow)
+datasets on local/fuse-mounted lake storage
+(ref: HS/index/sources/default/DefaultFileBasedSource.scala:37-124,
+DefaultFileBasedRelation.scala:38).
+"""
+
+from __future__ import annotations
+
+import glob as globlib
+import os
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+
+from hyperspace_tpu.models.log_entry import Content, FileInfo, Relation, Storage
+from hyperspace_tpu.sources import schema as schema_codec
+from hyperspace_tpu.sources.interfaces import (
+    FileBasedRelation,
+    FileBasedRelationMetadata,
+    FileBasedSourceProvider,
+)
+from hyperspace_tpu.sources.signatures import file_based_signature
+
+SUPPORTED_FORMATS = ("parquet", "csv", "json")
+
+_EXTENSIONS = {".parquet": "parquet", ".csv": "csv", ".json": "json"}
+
+
+def _list_data_files(root: str) -> List[str]:
+    out = []
+    for dirpath, _dirs, names in os.walk(root):
+        for n in sorted(names):
+            if n.startswith(".") or n.startswith("_"):
+                continue
+            out.append(os.path.join(dirpath, n))
+    return sorted(out)
+
+
+class DefaultFileBasedRelation(FileBasedRelation):
+    def __init__(self, root_paths: List[str], file_format: str, options: Optional[Dict[str, str]] = None,
+                 files: Optional[List[str]] = None):
+        self._root_paths = [os.path.abspath(p) for p in root_paths]
+        self._file_format = file_format
+        self._options = dict(options or {})
+        if files is not None:
+            self._files = sorted(os.path.abspath(f) for f in files)
+        else:
+            self._files = []
+            for p in self._root_paths:
+                if os.path.isdir(p):
+                    self._files.extend(_list_data_files(p))
+                elif globlib.has_magic(p):
+                    for m in sorted(globlib.glob(p)):
+                        if os.path.isdir(m):
+                            self._files.extend(_list_data_files(m))
+                        else:
+                            self._files.append(os.path.abspath(m))
+                else:
+                    self._files.append(p)
+        if not self._files:
+            raise FileNotFoundError(f"No data files under {root_paths!r}")
+        self._schema: Optional[pa.Schema] = None
+
+    @property
+    def name(self) -> str:
+        return ",".join(self._root_paths)
+
+    @property
+    def schema(self) -> pa.Schema:
+        if self._schema is None:
+            self._schema = self.arrow_dataset().schema
+        return self._schema
+
+    @property
+    def root_paths(self) -> List[str]:
+        return list(self._root_paths)
+
+    @property
+    def file_format(self) -> str:
+        return self._file_format
+
+    @property
+    def options(self) -> Dict[str, str]:
+        return dict(self._options)
+
+    def arrow_dataset(self, files: Optional[List[str]] = None) -> pads.Dataset:
+        return pads.dataset(files if files is not None else self._files, format=self._file_format)
+
+    def all_file_infos(self) -> List[FileInfo]:
+        return [FileInfo.from_path(f) for f in self._files]
+
+    def signature(self) -> str:
+        return file_based_signature(self.all_file_infos())
+
+    def create_relation_metadata(self, file_id_tracker) -> Relation:
+        infos = self.all_file_infos()
+        if file_id_tracker is not None:
+            file_id_tracker.add_files(infos)
+        return Relation(
+            root_paths=self.root_paths,
+            data=Storage(Content.from_leaf_files(infos)),
+            schema_json=schema_codec.schema_to_json(self.schema),
+            file_format=self._file_format,
+            options=self.options,
+        )
+
+
+class DefaultFileBasedRelationMetadata(FileBasedRelationMetadata):
+    """(ref: HS/index/sources/default/DefaultFileBasedRelationMetadata.scala:25)"""
+
+    def refresh(self) -> Relation:
+        fresh = DefaultFileBasedRelation(
+            self.relation.root_paths, self.relation.file_format, self.relation.options
+        )
+        return fresh.create_relation_metadata(None)
+
+    def to_relation_object(self) -> DefaultFileBasedRelation:
+        return DefaultFileBasedRelation(
+            self.relation.root_paths, self.relation.file_format, self.relation.options
+        )
+
+
+class DefaultFileBasedSource(FileBasedSourceProvider):
+    def create_relation(self, path_or_plan, session) -> Optional[FileBasedRelation]:
+        if isinstance(path_or_plan, DefaultFileBasedRelation):
+            return path_or_plan
+        if isinstance(path_or_plan, tuple):
+            paths, fmt, options = path_or_plan
+            if fmt not in SUPPORTED_FORMATS:
+                return None
+            return DefaultFileBasedRelation(list(paths), fmt, options)
+        return None
+
+    def create_relation_metadata(self, relation: Relation, session) -> Optional[FileBasedRelationMetadata]:
+        if relation.file_format in SUPPORTED_FORMATS:
+            return DefaultFileBasedRelationMetadata(relation)
+        return None
+
+
+class DefaultFileBasedSourceBuilder:
+    """Builder loaded from conf ``hyperspace.index.sources.fileBasedBuilders``
+    (ref: HS/index/sources/FileBasedSourceProviderManager.scala:38-174)."""
+
+    def build(self, session) -> FileBasedSourceProvider:
+        return DefaultFileBasedSource()

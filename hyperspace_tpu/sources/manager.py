@@ -1,0 +1,63 @@
+"""Source-provider manager.
+
+Loads comma-separated builder classes from conf and dispatches each SPI call,
+enforcing that exactly one provider answers
+(ref: HS/index/sources/FileBasedSourceProviderManager.scala:38-174).
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import List, Optional
+
+from hyperspace_tpu.models.log_entry import Relation
+from hyperspace_tpu.sources.interfaces import (
+    FileBasedRelation,
+    FileBasedRelationMetadata,
+    FileBasedSourceProvider,
+)
+
+
+class HyperspaceException(Exception):
+    pass
+
+
+def _load_class(dotted: str):
+    module_name, _, cls_name = dotted.rpartition(".")
+    return getattr(importlib.import_module(module_name), cls_name)
+
+
+class FileBasedSourceProviderManager:
+    def __init__(self, session):
+        self._session = session
+        self._providers: Optional[List[FileBasedSourceProvider]] = None
+        self._built_from: Optional[str] = None
+
+    def providers(self) -> List[FileBasedSourceProvider]:
+        raw = self._session.conf.source_builders
+        if self._providers is None or raw != self._built_from:
+            self._providers = [
+                _load_class(name.strip())().build(self._session)
+                for name in raw.split(",")
+                if name.strip()
+            ]
+            self._built_from = raw
+        return self._providers
+
+    def _run_single(self, fn_name: str, *args):
+        answers = []
+        for p in self.providers():
+            result = getattr(p, fn_name)(*args, self._session)
+            if result is not None:
+                answers.append(result)
+        if len(answers) != 1:
+            raise HyperspaceException(
+                f"Expected exactly one source provider to handle {fn_name}; got {len(answers)}."
+            )
+        return answers[0]
+
+    def create_relation(self, path_or_plan) -> FileBasedRelation:
+        return self._run_single("create_relation", path_or_plan)
+
+    def create_relation_metadata(self, relation: Relation) -> FileBasedRelationMetadata:
+        return self._run_single("create_relation_metadata", relation)

@@ -1,0 +1,71 @@
+"""Arrow-schema <-> JSON codec.
+
+The reference stores the source relation's Spark ``StructType`` JSON in the
+log entry (ref: HS/index/IndexLogEntry.scala:379-385, util/JsonUtils.scala).
+Here schemas are ``pyarrow.Schema`` serialized to a small JSON structure.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+import pyarrow as pa
+
+_STR_TO_TYPE = {
+    "int8": pa.int8(),
+    "int16": pa.int16(),
+    "int32": pa.int32(),
+    "int64": pa.int64(),
+    "uint8": pa.uint8(),
+    "uint16": pa.uint16(),
+    "uint32": pa.uint32(),
+    "uint64": pa.uint64(),
+    "float": pa.float32(),
+    "float32": pa.float32(),
+    "double": pa.float64(),
+    "float64": pa.float64(),
+    "bool": pa.bool_(),
+    "string": pa.string(),
+    "large_string": pa.large_string(),
+    "binary": pa.binary(),
+    "date32[day]": pa.date32(),
+    "date64[ms]": pa.date64(),
+    "timestamp[us]": pa.timestamp("us"),
+    "timestamp[ns]": pa.timestamp("ns"),
+    "timestamp[ms]": pa.timestamp("ms"),
+    "timestamp[s]": pa.timestamp("s"),
+}
+
+
+def _type_to_dict(t: pa.DataType) -> Dict:
+    if pa.types.is_struct(t):
+        return {"type": "struct", "fields": [{"name": t.field(i).name, **_type_to_dict(t.field(i).type)} for i in range(t.num_fields)]}
+    if pa.types.is_list(t):
+        return {"type": "list", "item": _type_to_dict(t.value_type)}
+    if pa.types.is_decimal(t):
+        return {"type": "decimal", "precision": t.precision, "scale": t.scale}
+    return {"type": str(t)}
+
+
+def _type_from_dict(d: Dict) -> pa.DataType:
+    t = d["type"]
+    if t == "struct":
+        return pa.struct([pa.field(f["name"], _type_from_dict(f)) for f in d["fields"]])
+    if t == "list":
+        return pa.list_(_type_from_dict(d["item"]))
+    if t == "decimal":
+        return pa.decimal128(d["precision"], d["scale"])
+    if t in _STR_TO_TYPE:
+        return _STR_TO_TYPE[t]
+    raise ValueError(f"Unsupported type string {t!r}")
+
+
+def schema_to_json(schema: pa.Schema) -> str:
+    fields: List[Dict] = [{"name": f.name, **_type_to_dict(f.type)} for f in schema]
+    return json.dumps({"fields": fields})
+
+
+def schema_from_json(text: str) -> pa.Schema:
+    d = json.loads(text)
+    return pa.schema([pa.field(f["name"], _type_from_dict(f)) for f in d["fields"]])

@@ -1,0 +1,50 @@
+"""Signature providers.
+
+``FileBasedSignatureProvider`` fingerprints a relation by folding each file's
+(mtime, length, path) and hashing (ref: HS/index/FileBasedSignatureProvider.scala:30-62).
+``IndexSignatureProvider`` adds a fingerprint of the plan structure on top
+(ref: HS/index/IndexSignatureProvider.scala).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from hyperspace_tpu.utils.hashing import md5_hex
+
+FILE_BASED_SIGNATURE_PROVIDER = "FileBasedSignatureProvider"
+INDEX_SIGNATURE_PROVIDER = "IndexSignatureProvider"
+
+
+def file_based_signature(file_infos) -> str:
+    parts = sorted(f"{fi.modified_time}:{fi.size}:{fi.name}" for fi in file_infos)
+    return md5_hex("\n".join(parts))
+
+
+def plan_structure_string(plan) -> str:
+    """A canonical string of the plan's node kinds + shapes (stands in for
+    Catalyst canonicalization; ref: HS/index/PlanSignatureProvider.scala)."""
+    from hyperspace_tpu.plan import logical as L
+
+    def walk(p) -> str:
+        if isinstance(p, L.Scan):
+            return f"Scan({','.join(sorted(p.relation.root_paths))})"
+        name = type(p).__name__
+        inner = ",".join(walk(c) for c in p.children())
+        if isinstance(p, L.Project):
+            name += f"[{','.join(c.lower() for c in p.columns)}]"
+        return f"{name}({inner})"
+
+    return walk(plan)
+
+
+def index_signature(plan) -> Optional[str]:
+    """Signature of the full source plan: plan structure + every relation's
+    file-based signature (ref: HS/index/IndexSignatureProvider.scala)."""
+    from hyperspace_tpu.plan import logical as L
+
+    scans = L.collect(plan, lambda p: isinstance(p, L.Scan))
+    if not scans:
+        return None
+    rel_sigs = sorted(s.relation.signature() for s in scans)
+    return md5_hex(plan_structure_string(plan) + "|" + "|".join(rel_sigs))

@@ -1,0 +1,33 @@
+"""Index statistics (ref: HS/index/IndexStatistics.scala:41-96)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from hyperspace_tpu.models.log_entry import IndexLogEntry
+
+
+def index_statistics(session, entry: IndexLogEntry, extended: bool = False) -> Dict[str, Any]:
+    infos = entry.content.file_infos()
+    row: Dict[str, Any] = {
+        "name": entry.name,
+        "indexedColumns": entry.derived_dataset.properties.get("indexedColumns", []),
+        "includedColumns": entry.derived_dataset.properties.get("includedColumns", []),
+        "numBuckets": entry.derived_dataset.properties.get("numBuckets"),
+        "schema": entry.derived_dataset.properties.get("schemaJson", ""),
+        "indexLocation": entry.content.root.name,
+        "state": entry.state,
+        "kind": entry.kind,
+    }
+    if extended:
+        row.update(
+            {
+                "numIndexFiles": len(infos),
+                "sizeInBytes": entry.content.total_size,
+                "logVersion": entry.id,
+                "appendedFiles": [f.name for f in entry.appended_files()],
+                "deletedFiles": [f.name for f in entry.deleted_files()],
+                "indexContentPaths": entry.content.files,
+            }
+        )
+    return row

@@ -1,0 +1,121 @@
+"""Telemetry: structured event taxonomy + pluggable sink.
+
+Events are emitted around every lifecycle action and on index usage
+(ref: HS/telemetry/HyperspaceEvent.scala:28-156); the sink class is loaded
+from conf ``hyperspace.eventLoggerClass`` with a NoOp default
+(ref: HS/telemetry/HyperspaceEventLogging.scala:30-68).
+"""
+
+from __future__ import annotations
+
+import importlib
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+@dataclass
+class HyperspaceEvent:
+    app_info: Dict[str, str] = field(default_factory=dict)
+    message: str = ""
+    timestamp: float = field(default_factory=time.time)
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+
+@dataclass
+class ActionEvent(HyperspaceEvent):
+    index_name: str = ""
+    state: str = ""  # "Started" / "Success" / "Failure"
+
+
+@dataclass
+class CreateActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class DeleteActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class RestoreActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class VacuumActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class RefreshActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class RefreshIncrementalActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class RefreshQuickActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class OptimizeActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class CancelActionEvent(ActionEvent):
+    pass
+
+
+@dataclass
+class HyperspaceIndexUsageEvent(HyperspaceEvent):
+    """Emitted when the optimizer applies indexes to a query
+    (ref: HS/telemetry/HyperspaceEvent.scala HyperspaceIndexUsageEvent)."""
+
+    index_names: List[str] = field(default_factory=list)
+    plan_summary: str = ""
+
+
+class EventLogger:
+    def log_event(self, event: HyperspaceEvent) -> None:
+        raise NotImplementedError
+
+
+class NoOpEventLogger(EventLogger):
+    def log_event(self, event: HyperspaceEvent) -> None:
+        pass
+
+
+class CollectingEventLogger(EventLogger):
+    """In-memory sink for tests (ref: MockEventLogger in TestUtils.scala:93-121)."""
+
+    def __init__(self) -> None:
+        self.events: List[HyperspaceEvent] = []
+
+    def log_event(self, event: HyperspaceEvent) -> None:
+        self.events.append(event)
+
+    def reset(self) -> None:
+        self.events = []
+
+
+_cached: Dict[str, EventLogger] = {}
+
+
+def get_event_logger(session) -> EventLogger:
+    cls_name: Optional[str] = session.conf.get("hyperspace.eventLoggerClass")
+    if not cls_name:
+        return _cached.setdefault("__noop__", NoOpEventLogger())
+    if cls_name not in _cached:
+        module_name, _, attr = cls_name.rpartition(".")
+        _cached[cls_name] = getattr(importlib.import_module(module_name), attr)()
+    return _cached[cls_name]

@@ -1,0 +1,52 @@
+"""Small caching helpers.
+
+``CacheWithTransform`` re-derives a parsed value only when the raw input
+changes (ref: HS/util/CacheWithTransform.scala:31-45). ``TTLCache`` backs the
+caching index collection manager (ref: HS/index/CachingIndexCollectionManager.scala:127-173).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Generic, Optional, Tuple, TypeVar
+
+R = TypeVar("R")
+T = TypeVar("T")
+
+
+class CacheWithTransform(Generic[R, T]):
+    def __init__(self, load_fn: Callable[[], R], transform_fn: Callable[[R], T]):
+        self._load_fn = load_fn
+        self._transform_fn = transform_fn
+        self._cached: Optional[Tuple[R, T]] = None
+
+    def load(self) -> T:
+        raw = self._load_fn()
+        if self._cached is not None and self._cached[0] == raw:
+            return self._cached[1]
+        value = self._transform_fn(raw)
+        self._cached = (raw, value)
+        return value
+
+
+class TTLCache(Generic[T]):
+    """Single-entry cache with creation-time-based expiry."""
+
+    def __init__(self, expiry_seconds_fn: Callable[[], float]):
+        self._expiry_seconds_fn = expiry_seconds_fn
+        self._entry: Optional[Tuple[float, T]] = None
+
+    def get(self) -> Optional[T]:
+        if self._entry is None:
+            return None
+        created, value = self._entry
+        if time.time() - created > self._expiry_seconds_fn():
+            self._entry = None
+            return None
+        return value
+
+    def set(self, value: T) -> None:
+        self._entry = (time.time(), value)
+
+    def clear(self) -> None:
+        self._entry = None

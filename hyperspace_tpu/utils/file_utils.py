@@ -1,0 +1,84 @@
+"""Filesystem helpers.
+
+The reference relies on the Hadoop FS API for atomic rename semantics
+(ref: HS/util/FileUtils.scala, HS/index/IndexLogManager.scala:178-194).
+Here we target POSIX local / fuse-mounted lake storage: the create-exclusive
+primitive is ``os.link`` (fails if the target exists), giving the same
+optimistic-concurrency guarantee.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Union
+
+PathLike = Union[str, Path]
+
+
+def write_atomic_exclusive(path: PathLike, data: bytes) -> bool:
+    """Atomically create ``path`` with ``data`` iff it does not already exist.
+
+    Returns True on success, False if the file already existed (another writer
+    won the race). Mirrors the temp-file + atomic-rename protocol of
+    HS/index/IndexLogManager.scala:178-194.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=f".{path.name}.", dir=str(path.parent))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        try:
+            os.link(tmp, str(path))  # atomic create-exclusive
+            return True
+        except FileExistsError:
+            return False
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def write_atomic(path: PathLike, data: bytes) -> None:
+    """Atomically (over)write ``path`` with ``data`` via temp + rename."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(prefix=f".{path.name}.", dir=str(path.parent))
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, str(path))
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def delete_recursively(path: PathLike) -> None:
+    path = Path(path)
+    if path.is_dir():
+        shutil.rmtree(path, ignore_errors=True)
+    elif path.exists():
+        path.unlink(missing_ok=True)
+
+
+def directory_size(path: PathLike) -> int:
+    """Total bytes of all files under ``path`` (ref: HS/util/FileUtils.scala)."""
+    total = 0
+    for root, _dirs, files in os.walk(str(path)):
+        for name in files:
+            try:
+                total += os.path.getsize(os.path.join(root, name))
+            except OSError:
+                pass
+    return total

@@ -1,0 +1,61 @@
+"""Test harness.
+
+Multi-device behavior is exercised on a virtual 8-device CPU mesh, standing in
+for the reference's ``local[4]`` in-process Spark
+(ref: src/test/scala/com/microsoft/hyperspace/SparkInvolvedSuite.scala:26-56;
+SURVEY.md §4 "Implication for the TPU build").
+
+Env vars must be set before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture()
+def tmp_system_path(tmp_path):
+    """Per-test index system path (ref: HyperspaceSuite's per-suite systemPath)."""
+    p = tmp_path / "indexes"
+    p.mkdir()
+    return str(p)
+
+
+@pytest.fixture()
+def sample_parquet(tmp_path):
+    """Small sample dataset (ref: test SampleData.scala)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rng = np.random.default_rng(42)
+    n = 1000
+    table = pa.table(
+        {
+            "c1": rng.integers(0, 100, n).astype(np.int64),
+            "c2": rng.integers(0, 1000, n).astype(np.int64),
+            "c3": rng.standard_normal(n),
+            "c4": np.array([f"name_{i % 37}" for i in range(n)]),
+        }
+    )
+    root = tmp_path / "sample_data"
+    root.mkdir()
+    # several files so file-level diffs are meaningful
+    for i in range(4):
+        pq.write_table(table.slice(i * 250, 250), root / f"part-{i:05d}.parquet")
+    return str(root)
+
+
+@pytest.fixture()
+def session(tmp_system_path):
+    import hyperspace_tpu as hst
+
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: tmp_system_path})
+    hst.set_session(sess)
+    yield sess
+    hst.set_session(None)

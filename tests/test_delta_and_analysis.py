@@ -1,0 +1,162 @@
+"""Delta Lake source + explain/whyNot tests
+(ref: src/test/scala/.../DeltaLakeIntegrationTest.scala (599),
+ExplainTest.scala (240), CandidateIndexAnalyzerTest)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.sources.delta import delete_delta_files, list_versions, write_delta_table
+
+from tests.test_e2e_rules import assert_batches_equal
+
+
+def make_table(seed=0, n=500):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.standard_normal(n),
+        }
+    )
+
+
+@pytest.fixture()
+def delta_root(tmp_path):
+    root = str(tmp_path / "delta_tbl")
+    write_delta_table(make_table(0), root)
+    write_delta_table(make_table(1), root)
+    return root
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+class TestDeltaSource:
+    def test_read_and_versions(self, session, delta_root):
+        df = session.read_delta(delta_root)
+        assert df.count() == 1000
+        assert list_versions(delta_root) == [0, 1]
+        df_v0 = session.read_delta(delta_root, version=0)
+        assert df_v0.count() == 500
+
+    def test_remove_action(self, session, delta_root):
+        rel = session.read_delta(delta_root).plan.relation
+        first = sorted(p for p in rel._adds)[0]
+        delete_delta_files(delta_root, [first])
+        assert session.read_delta(delta_root).count() == 500
+        # time travel still sees the removed file
+        assert session.read_delta(delta_root, version=1).count() == 1000
+
+    def test_index_on_delta_and_query(self, session, hs, delta_root):
+        df = session.read_delta(delta_root)
+        hs.create_index(df, hst.CoveringIndexConfig("deltaIdx", ["k"], ["v"]))
+        q = df.filter(hst.col("k") == 7).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_delta_version_change_invalidates_index(self, session, hs, delta_root):
+        df = session.read_delta(delta_root)
+        hs.create_index(df, hst.CoveringIndexConfig("deltaStale", ["k"], ["v"]))
+        write_delta_table(make_table(2), delta_root)
+        session.enable_hyperspace()
+        df2 = session.read_delta(delta_root)
+        plan = df2.filter(hst.col("k") == 7).select("v").optimized_plan()
+        assert not any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True))
+
+    def test_delta_hybrid_scan_over_new_version(self, session, hs, delta_root):
+        df = session.read_delta(delta_root)
+        hs.create_index(df, hst.CoveringIndexConfig("deltaHybrid", ["k"], ["v"]))
+        write_delta_table(make_table(2), delta_root)
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.9)
+        df2 = session.read_delta(delta_root)
+        q = df2.filter(hst.col("k") == 7).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.BucketUnion) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_refresh_delta_index(self, session, hs, delta_root):
+        df = session.read_delta(delta_root)
+        hs.create_index(df, hst.CoveringIndexConfig("deltaRef", ["k"], ["v"]))
+        write_delta_table(make_table(3), delta_root)
+        hs.refresh_index("deltaRef", "incremental")
+        session.enable_hyperspace()
+        df2 = session.read_delta(delta_root)
+        q = df2.filter(hst.col("k") == 7).select("v")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        assert_batches_equal(on, q.collect())
+
+
+class TestExplainWhyNot:
+    def test_explain_shows_index_and_diff(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("expIdx", ["c1"], ["c2"]))
+        q = df.filter(hst.col("c1") == 7).select("c2")
+        text = hs.explain(q, verbose=True)
+        assert "Plan with indexes" in text
+        assert "expIdx" in text
+        assert "IndexScan" in text
+        assert "Plan without indexes" in text
+
+    def test_why_not_reports_reasons(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("wnIdx", ["c1"], ["c2"]))
+        # query needs c3 -> index can't cover it
+        q = df.filter(hst.col("c1") == 7).select("c3")
+        text = hs.why_not(q)
+        assert "wnIdx" in text
+        assert "MISSING_REQUIRED_COL" in text
+
+    def test_why_not_applied_index(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("wnOk", ["c1"], ["c2"]))
+        q = df.filter(hst.col("c1") == 7).select("c2")
+        text = hs.why_not(q)
+        assert "(applied)" in text
+
+    def test_why_not_wrong_first_col(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("wnFirst", ["c1"], ["c2"]))
+        q = df.filter(hst.col("c2") == 7).select("c1")
+        text = hs.why_not(q, extended=True)
+        assert "NO_FIRST_INDEXED_COL_COND" in text
+
+
+class TestDataSkippingIndexBuild:
+    def test_create_and_stats(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        entry = hs.create_index(
+            df,
+            hst.DataSkippingIndexConfig("dsIdx", hst.MinMaxSketch("c1"), hst.BloomFilterSketch("c2")),
+        )
+        assert entry.state == "ACTIVE"
+        assert entry.kind == "DataSkippingIndex"
+
+        import pyarrow.dataset as pads
+
+        sketch_table = pads.dataset(entry.content.files, format="parquet").to_table()
+        assert sketch_table.num_rows == 4  # one row per source file
+        assert "MinMax_c1__min" in sketch_table.column_names
+        assert "BloomFilter_c2__bits" in sketch_table.column_names
+
+    def test_bloom_filter_membership(self):
+        sk = hst.BloomFilterSketch("x", fpp=0.01, expected_items=1000)
+        values = np.arange(0, 1000, 2)
+        (bits,) = sk.aggregate(values)
+        hits = sum(sk.might_contain(bits, v) for v in range(0, 1000, 2))
+        assert hits == 500  # no false negatives
+        misses = sum(sk.might_contain(bits, v) for v in range(1, 1000, 2))
+        assert misses < 50  # fpp ~ 1%

@@ -1,0 +1,157 @@
+"""Metadata-model unit tests (ref: src/test/scala/.../index/IndexLogEntryTest.scala,
+FileIdTrackerTest.scala)."""
+
+import os
+
+import pytest
+
+from hyperspace_tpu import config as C
+from hyperspace_tpu.models.log_entry import (
+    Content,
+    DerivedDataset,
+    Directory,
+    FileIdTracker,
+    FileInfo,
+    IndexLogEntry,
+    LogicalPlanFingerprint,
+    Relation,
+    Signature,
+    Source,
+    Storage,
+    Update,
+)
+
+
+def fi(name, size=10, mtime=100, fid=C.UNKNOWN_FILE_ID):
+    return FileInfo(name, size, mtime, fid)
+
+
+class TestFileInfo:
+    def test_equality_ignores_id(self):
+        assert fi("/a/b", 1, 2, 5) == fi("/a/b", 1, 2, 9)
+        assert hash(fi("/a/b", 1, 2, 5)) == hash(fi("/a/b", 1, 2, 9))
+        assert fi("/a/b", 1, 2) != fi("/a/b", 1, 3)
+
+    def test_roundtrip(self):
+        f = fi("/a/b/c.parquet", 123, 456, 7)
+        assert FileInfo.from_dict(f.to_dict()) == f
+        assert FileInfo.from_dict(f.to_dict()).file_id == 7
+
+
+class TestContentTree:
+    def test_from_leaf_files_reconstructs_paths(self):
+        files = [fi("/data/t/p1.parquet"), fi("/data/t/sub/p2.parquet"), fi("/data/u/p3.parquet")]
+        content = Content.from_leaf_files(files)
+        assert sorted(content.files) == sorted(os.path.abspath(f.name) for f in files)
+
+    def test_file_infos_preserve_metadata(self):
+        files = [fi("/data/t/p1.parquet", 11, 22, 3)]
+        out = Content.from_leaf_files(files).file_infos()
+        assert out == files
+        assert out[0].size == 11 and out[0].modified_time == 22 and out[0].file_id == 3
+
+    def test_merge_unions_files(self):
+        a = Content.from_leaf_files([fi("/d/x/1"), fi("/d/x/2")])
+        b = Content.from_leaf_files([fi("/d/x/2"), fi("/d/y/3")])
+        merged = a.merge(b)
+        assert sorted(merged.files) == ["/d/x/1", "/d/x/2", "/d/y/3"]
+
+    def test_merge_mismatched_roots_raises(self):
+        with pytest.raises(ValueError):
+            Directory("a").merge(Directory("b"))
+
+    def test_roundtrip(self):
+        c = Content.from_leaf_files([fi("/d/x/1", 5, 6, 7), fi("/d/y/z/2", 8, 9, 10)])
+        assert Content.from_dict(c.to_dict()).to_dict() == c.to_dict()
+
+    def test_total_size(self):
+        c = Content.from_leaf_files([fi("/d/1", 5), fi("/d/2", 8)])
+        assert c.total_size == 13
+
+    def test_from_directory_skips_hidden_and_meta(self, tmp_path):
+        (tmp_path / "a.parquet").write_bytes(b"xx")
+        (tmp_path / "_log").write_bytes(b"xx")
+        (tmp_path / ".hidden").write_bytes(b"xx")
+        tracker = FileIdTracker()
+        c = Content.from_directory(str(tmp_path), tracker)
+        assert [os.path.basename(p) for p in c.files] == ["a.parquet"]
+        assert all(f.file_id == 0 for f in c.file_infos())
+
+
+class TestFileIdTracker:
+    def test_monotonic_ids(self):
+        t = FileIdTracker()
+        assert t.add_file(fi("/a", 1, 1)) == 0
+        assert t.add_file(fi("/b", 1, 1)) == 1
+        assert t.add_file(fi("/a", 1, 1)) == 0  # stable
+        assert t.max_id == 1
+
+    def test_conflicting_known_id_raises(self):
+        t = FileIdTracker()
+        t.add_file(fi("/a", 1, 1))
+        with pytest.raises(ValueError):
+            t.add_file(fi("/a", 1, 1, fid=42))
+
+    def test_known_ids_are_honored(self):
+        t = FileIdTracker()
+        t.add_file(fi("/a", 1, 1, fid=10))
+        assert t.max_id == 10
+        assert t.add_file(fi("/b", 1, 1)) == 11
+
+
+def make_entry(name="idx1", state="ACTIVE", files=None):
+    files = files or [fi("/src/t/p1.parquet", 100, 1, 0), fi("/src/t/p2.parquet", 200, 2, 1)]
+    rel = Relation(
+        root_paths=["/src/t"],
+        data=Storage(Content.from_leaf_files(files)),
+        schema_json='{"fields": []}',
+        file_format="parquet",
+        options={},
+    )
+    return IndexLogEntry(
+        name=name,
+        derived_dataset=DerivedDataset("CoveringIndex", {"indexedColumns": ["c1"], "includedColumns": ["c2"]}),
+        content=Content.from_leaf_files([fi("/idx/v__=0/b0.parquet", 50, 3)]),
+        source=Source(rel, LogicalPlanFingerprint([Signature("FileBasedSignatureProvider", "abc123")])),
+        properties={},
+        state=state,
+    )
+
+
+class TestIndexLogEntry:
+    def test_json_roundtrip(self):
+        e = make_entry()
+        e2 = IndexLogEntry.from_json(e.to_json())
+        assert e2 == e
+        assert e2.kind == "CoveringIndex"
+        assert e2.signature.signatures[0].value == "abc123"
+        assert [f.name for f in e2.source_file_infos()] == ["/src/t/p1.parquet", "/src/t/p2.parquet"]
+        assert e2.source_files_size() == 300
+
+    def test_copy_with_update_records_hybrid_scan_delta(self):
+        e = make_entry()
+        appended = [fi("/src/t/p3.parquet", 300, 3)]
+        deleted = [fi("/src/t/p1.parquet", 100, 1, 0)]
+        e2 = e.copy_with_update(appended, deleted)
+        assert [f.name for f in e2.appended_files()] == ["/src/t/p3.parquet"]
+        assert [f.name for f in e2.deleted_files()] == ["/src/t/p1.parquet"]
+        # original untouched
+        assert e.appended_files() == []
+        # survives serialization
+        e3 = IndexLogEntry.from_json(e2.to_json())
+        assert [f.name for f in e3.deleted_files()] == ["/src/t/p1.parquet"]
+
+    def test_tags_are_transient(self):
+        e = make_entry()
+        e.set_tag("plan1", "FILTER_REASONS", ["x"])
+        assert e.get_tag("plan1", "FILTER_REASONS") == ["x"]
+        assert e.get_tag("plan2", "FILTER_REASONS") is None
+        e2 = IndexLogEntry.from_json(e.to_json())
+        assert e2.tags == {}
+
+    def test_file_id_tracker_reconstruction(self):
+        e = make_entry()
+        t = e.file_id_tracker()
+        assert t.get_file_id(("/src/t/p1.parquet", 100, 1)) == 0
+        assert t.get_file_id(("/src/t/p2.parquet", 200, 2)) == 1
+        assert t.max_id == 1

@@ -1,0 +1,112 @@
+"""Operation-log manager tests, incl. concurrent-writer races
+(ref: src/test/scala/.../index/IndexLogManagerImplTest.scala)."""
+
+import threading
+
+from hyperspace_tpu.models.log_manager import IndexLogManager
+from hyperspace_tpu.models.data_manager import IndexDataManager
+from hyperspace_tpu.models.path_resolver import PathResolver
+from hyperspace_tpu.config import HyperspaceConf, keys
+
+from tests.test_log_entry import make_entry
+
+
+class TestIndexLogManager:
+    def test_empty_log(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        assert m.get_latest_id() is None
+        assert m.get_latest_log() is None
+        assert m.get_latest_stable_log() is None
+
+    def test_write_and_read(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        e = make_entry(state="CREATING")
+        assert m.write_log(0, e)
+        got = m.get_log(0)
+        assert got is not None and got.state == "CREATING" and got.id == 0
+
+    def test_write_existing_id_fails(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        assert m.write_log(0, make_entry(state="CREATING"))
+        assert not m.write_log(0, make_entry(state="ACTIVE"))
+        assert m.get_log(0).state == "CREATING"  # first writer won
+
+    def test_concurrent_writers_single_winner(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        results = []
+        barrier = threading.Barrier(8)
+
+        def writer(i):
+            barrier.wait()
+            results.append((i, m.write_log(5, make_entry(name=f"idx{i}", state="ACTIVE"))))
+
+        threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        winners = [i for i, ok in results if ok]
+        assert len(winners) == 1
+        assert m.get_log(5).name == f"idx{winners[0]}"
+
+    def test_latest_stable_snapshot_and_scan(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, make_entry(state="CREATING"))
+        m.write_log(1, make_entry(state="ACTIVE"))
+        m.write_log(2, make_entry(state="REFRESHING"))
+        # no snapshot -> backward scan finds id 1
+        assert m.get_latest_stable_log().state == "ACTIVE"
+        assert m.create_latest_stable_log(1)
+        assert m.get_latest_stable_log().id == 1
+        # snapshot of unstable entry is refused
+        assert not m.create_latest_stable_log(2)
+
+    def test_get_index_versions(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, make_entry(state="CREATING"))
+        m.write_log(1, make_entry(state="ACTIVE"))
+        m.write_log(2, make_entry(state="REFRESHING"))
+        m.write_log(3, make_entry(state="ACTIVE"))
+        assert m.get_index_versions(["ACTIVE"]) == [3, 1]
+
+    def test_corrupt_log_is_skipped(self, tmp_path):
+        m = IndexLogManager(str(tmp_path / "idx"))
+        m.write_log(0, make_entry(state="ACTIVE"))
+        import os
+
+        os.makedirs(m.log_dir, exist_ok=True)
+        with open(m._path(1), "w") as f:
+            f.write("{not json")
+        assert m.get_latest_id() == 1
+        assert m.get_log(1) is None
+        assert m.get_latest_stable_log().id == 0
+
+
+class TestIndexDataManager:
+    def test_versions(self, tmp_path):
+        m = IndexDataManager(str(tmp_path / "idx"))
+        assert m.get_latest_version() is None
+        for v in (0, 1, 3):
+            import os
+
+            os.makedirs(m.version_path(v))
+        assert m.get_all_versions() == [0, 1, 3]
+        assert m.get_latest_version() == 3
+        m.delete_version(3)
+        assert m.get_latest_version() == 1
+
+
+class TestPathResolver:
+    def test_requires_system_path(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            PathResolver(HyperspaceConf()).system_path
+
+    def test_case_insensitive_lookup(self, tmp_path):
+        conf = HyperspaceConf({keys.SYSTEM_PATH: str(tmp_path)})
+        r = PathResolver(conf)
+        (tmp_path / "MyIndex").mkdir()
+        assert r.get_index_path("myindex") == str(tmp_path / "MyIndex")
+        assert r.get_index_path("other") == str(tmp_path / "other")
+        assert r.all_index_paths() == [str(tmp_path / "MyIndex")]

@@ -1,0 +1,223 @@
+"""Refresh + optimize + hybrid-scan tests
+(ref: src/test/scala/.../index/RefreshIndexTest.scala (494),
+HybridScanSuite.scala (743), actions/OptimizeActionTest)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.actions.base import HyperspaceActionException, NoChangesException
+from hyperspace_tpu.plan import logical as L
+
+from tests.test_e2e_rules import assert_batches_equal
+
+
+def write_part(root, idx, n=250, seed=0):
+    rng = np.random.default_rng(seed + idx)
+    t = pa.table(
+        {
+            "c1": rng.integers(0, 100, n).astype(np.int64),
+            "c2": rng.integers(0, 1000, n).astype(np.int64),
+        }
+    )
+    pq.write_table(t, os.path.join(root, f"part-{idx:05d}.parquet"))
+
+
+@pytest.fixture()
+def mutable_data(tmp_path):
+    root = tmp_path / "mutable"
+    root.mkdir()
+    for i in range(3):
+        write_part(str(root), i)
+    return str(root)
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+class TestRefresh:
+    def test_refresh_no_changes_raises(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("rIdx", ["c1"], ["c2"]))
+        with pytest.raises(NoChangesException):
+            hs.refresh_index("rIdx", "incremental")
+
+    def test_refresh_full_after_append(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("rFull", ["c1"], ["c2"]))
+        write_part(mutable_data, 3, seed=99)
+
+        entry = hs.refresh_index("rFull", "full")
+        assert entry.state == "ACTIVE"
+        # refreshed index must be applied to queries over the new data
+        df2 = session.read_parquet(mutable_data)
+        session.enable_hyperspace()
+        q = df2.filter(hst.col("c1") == 7).select("c2")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        session.disable_hyperspace()
+        assert_batches_equal(q.collect(), q.collect())
+
+    def test_refresh_incremental_appended_only(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("rInc", ["c1"], ["c2"]))
+        old_entry = hs._manager.get_index("rInc")
+        write_part(mutable_data, 3, seed=123)
+
+        entry = hs.refresh_index("rInc", "incremental")
+        # merge mode keeps old index files and adds delta files
+        assert set(old_entry.content.files) <= set(entry.content.files)
+        assert len(entry.content.files) > len(old_entry.content.files)
+
+        df2 = session.read_parquet(mutable_data)
+        session.enable_hyperspace()
+        q = df2.filter(hst.col("c1") == 7).select("c2")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True))
+        on = q.collect()
+        session.disable_hyperspace()
+        assert_batches_equal(on, q.collect())
+
+    def test_refresh_incremental_deletes_require_lineage(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("rDel", ["c1"], ["c2"]))
+        os.remove(os.path.join(mutable_data, "part-00002.parquet"))
+        with pytest.raises(HyperspaceActionException, match="lineage"):
+            hs.refresh_index("rDel", "incremental")
+
+    def test_refresh_incremental_with_deletes_and_lineage(self, session, hs, mutable_data):
+        session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("rDelL", ["c1"], ["c2"]))
+        os.remove(os.path.join(mutable_data, "part-00002.parquet"))
+        write_part(mutable_data, 3, seed=55)
+
+        hs.refresh_index("rDelL", "incremental")
+        df2 = session.read_parquet(mutable_data)
+        session.enable_hyperspace()
+        q = df2.filter(hst.col("c1") == 7).select("c2")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        assert_batches_equal(on, q.collect())
+
+    def test_refresh_quick_records_update(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("rQuick", ["c1"], ["c2"]))
+        write_part(mutable_data, 3, seed=77)
+        entry = hs.refresh_index("rQuick", "quick")
+        assert len(entry.appended_files()) == 1
+        assert entry.appended_files()[0].name.endswith("part-00003.parquet")
+
+
+class TestHybridScan:
+    def _enable_hybrid(self, session):
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.9)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_DELETED_RATIO, 0.9)
+
+    def test_hybrid_scan_appended(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("hIdx", ["c1"], ["c2"]))
+        write_part(mutable_data, 3, seed=31)
+
+        self._enable_hybrid(session)
+        df2 = session.read_parquet(mutable_data)
+        q = df2.filter(hst.col("c1") == 7).select("c2")
+        baseline = q.collect()
+
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        nodes = L.collect(plan, lambda p: True)
+        assert any(isinstance(p, L.BucketUnion) for p in nodes), plan.pretty()
+        assert any(isinstance(p, L.IndexScan) for p in nodes)
+        assert any(isinstance(p, L.Repartition) for p in nodes)
+        # the appended-file scan reads ONLY the appended file
+        fscans = [p for p in nodes if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+        assert fscans[0].files[0].endswith("part-00003.parquet")
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_hybrid_scan_deleted_rows_filtered(self, session, hs, mutable_data):
+        session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("hDel", ["c1"], ["c2"]))
+        os.remove(os.path.join(mutable_data, "part-00001.parquet"))
+
+        self._enable_hybrid(session)
+        df2 = session.read_parquet(mutable_data)
+        q = df2.filter(hst.col("c1") == 7).select("c2")
+        baseline = q.collect()
+
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        nodes = L.collect(plan, lambda p: True)
+        # deleted-row filtering: a NOT-IN filter over the lineage column
+        assert any(isinstance(p, L.IndexScan) and "_data_file_id" in p.columns for p in nodes), plan.pretty()
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_hybrid_scan_threshold_rejects(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("hThresh", ["c1"], ["c2"]))
+        # append as much data as existed -> ratio 0.5 > 0.3 default
+        for i in range(3, 6):
+            write_part(mutable_data, i, seed=i)
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        df2 = session.read_parquet(mutable_data)
+        session.enable_hyperspace()
+        plan = df2.filter(hst.col("c1") == 7).select("c2").optimized_plan()
+        assert not any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True))
+
+
+class TestOptimize:
+    def test_optimize_compacts_buckets(self, session, hs, mutable_data):
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("oIdx", ["c1"], ["c2"]))
+        write_part(mutable_data, 3, seed=13)
+        hs.refresh_index("oIdx", "incremental")
+        before = hs._manager.get_index("oIdx")
+        # incremental refresh -> multiple files per bucket
+        assert len(before.content.files) > 4
+
+        entry = hs.optimize_index("oIdx", "quick")
+        assert len(entry.content.files) <= 4
+
+        df2 = session.read_parquet(mutable_data)
+        session.enable_hyperspace()
+        q = df2.filter(hst.col("c1") == 7).select("c2")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True))
+        on = q.collect()
+        session.disable_hyperspace()
+        assert_batches_equal(on, q.collect())
+
+    def test_optimize_single_files_no_changes(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("oNc", ["c1"], ["c2"]))
+        with pytest.raises(NoChangesException):
+            hs.optimize_index("oNc", "quick")
+
+    def test_cancel_recovers_stuck_index(self, session, hs, mutable_data):
+        df = session.read_parquet(mutable_data)
+        hs.create_index(df, hst.CoveringIndexConfig("cIdx", ["c1"], ["c2"]))
+        # simulate a stuck REFRESHING state by writing a transient log
+        from hyperspace_tpu.models.log_manager import IndexLogManager
+        from hyperspace_tpu.models.path_resolver import PathResolver
+
+        path = PathResolver(session.conf).get_index_path("cIdx")
+        log_m = IndexLogManager(path)
+        stuck = log_m.get_latest_log()
+        stuck.state = "REFRESHING"
+        assert log_m.write_log(log_m.get_latest_id() + 1, stuck)
+        hs._manager.clear_cache()
+
+        hs.cancel("cIdx")
+        assert hs._manager.get_index("cIdx").state == "ACTIVE"
