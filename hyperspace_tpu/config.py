@@ -36,6 +36,7 @@ class keys:
     TPU_ROWS_PER_SHARD_CAPACITY_FACTOR = "hyperspace.tpu.rebucket.capacityFactor"
     TPU_MESH_AXIS = "hyperspace.tpu.mesh.axis"
     TPU_BUILD_BATCH_ROWS = "hyperspace.tpu.build.batchRows"
+    TPU_QUERY_DEVICE_EXECUTION = "hyperspace.tpu.query.deviceExecution"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -65,6 +66,7 @@ DEFAULTS: Dict[str, Any] = {
     keys.TPU_ROWS_PER_SHARD_CAPACITY_FACTOR: 2.0,
     keys.TPU_MESH_AXIS: "buckets",
     keys.TPU_BUILD_BATCH_ROWS: 1 << 22,
+    keys.TPU_QUERY_DEVICE_EXECUTION: True,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -196,6 +198,10 @@ class HyperspaceConf:
     @property
     def build_batch_rows(self) -> int:
         return int(self.get(keys.TPU_BUILD_BATCH_ROWS))
+
+    @property
+    def device_execution_enabled(self) -> bool:
+        return bool(self.get(keys.TPU_QUERY_DEVICE_EXECUTION))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

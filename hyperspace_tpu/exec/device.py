@@ -1,0 +1,524 @@
+"""TPU device execution path.
+
+The two accelerated physical patterns (the ones the optimizer rewrites plans
+into — SURVEY.md §3.2):
+
+  1. ``Filter`` over an ``IndexScan``/``FileScan`` — the predicate tree is
+     compiled to a jitted jnp program evaluated over encoded device columns,
+     sharded row-wise over the session mesh (replaces Spark's
+     per-bucket-parquet-scan + codegen'd filter; ref:
+     HS/index/covering/FilterIndexRule.scala:144-194).
+  2. Bucketed equi-``Join`` of two compatible ``IndexScan``s — both sides are
+     pre-bucketed and pre-sorted on the join keys, so the join runs per-bucket
+     with **no collectives**: a shard_map over the bucket axis where each
+     device merge-joins its co-located buckets via two vmapped searchsorted
+     passes (replaces Spark's exchange-free sort-merge join; ref:
+     HS/index/covering/JoinIndexRule.scala:604-618).
+
+Strings are dictionary-encoded host-side (exec/batch.py docstring); predicate
+literals are translated into code-space via the sorted dictionary, so <, <=,
+=, >=, > on strings all lower to integer compares on device.
+
+Anything the device path cannot express raises ``DeviceUnsupported`` and the
+host executor (exec/executor.py) runs the plan instead — mirroring how
+``ApplyHyperspace`` never fails a query (ref: HS/index/rules/ApplyHyperspace.scala:59-63).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+
+# int64 keys/sentinels require x64 even in query-only processes that never
+# import the build-path modules (ops/sort.py sets it for builds)
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+
+from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import (
+    BinaryOp,
+    Col,
+    Expr,
+    In,
+    InputFileName,
+    IsNull,
+    Lit,
+    Not,
+    extract_equi_join_keys,
+)
+
+
+class DeviceUnsupported(Exception):
+    """Raised when an expression/plan shape cannot run on the device path."""
+
+
+# --------------------------------------------------------------------------
+# column encoding
+# --------------------------------------------------------------------------
+
+
+class ColumnCodec:
+    """How one host column was encoded for the device.
+
+    kind:
+      - "numeric":  device array is the column itself (int64/float64/bool)
+      - "datetime": device array is the int64 epoch view; ``unit`` remembers
+                    the datetime64 unit for literal conversion
+      - "string":   device array is int32 codes into ``uniques`` (sorted);
+                    code -1 encodes null
+    """
+
+    def __init__(self, kind: str, uniques: Optional[np.ndarray] = None, unit: Optional[str] = None):
+        self.kind = kind
+        self.uniques = uniques
+        self.unit = unit
+
+
+def encode_column(arr: np.ndarray) -> Tuple[np.ndarray, ColumnCodec]:
+    kind = arr.dtype.kind
+    if kind in ("i", "u", "b"):
+        return arr.astype(np.int64), ColumnCodec("numeric")
+    if kind == "f":
+        return arr.astype(np.float64), ColumnCodec("numeric")
+    if kind == "M":
+        unit = np.datetime_data(arr.dtype)[0]
+        return arr.view("int64").astype(np.int64), ColumnCodec("datetime", unit=unit)
+    if kind in ("U", "S", "O"):
+        from hyperspace_tpu.ops.encode import factorize_strings
+
+        codes, uniques, _ = factorize_strings(arr)
+        return codes.astype(np.int32), ColumnCodec("string", uniques=uniques)
+    raise DeviceUnsupported(f"unsupported column dtype {arr.dtype}")
+
+
+def _literal_bounds(codec: ColumnCodec, value) -> Tuple[int, int]:
+    """(lo, hi) code bounds of a literal in a string dictionary:
+    col == lit ⇔ lo <= code < hi;  col < lit ⇔ code < lo;  col <= lit ⇔ code < hi."""
+    lo = int(np.searchsorted(codec.uniques, str(value), side="left"))
+    hi = int(np.searchsorted(codec.uniques, str(value), side="right"))
+    return lo, hi
+
+
+def _literal_numeric(codec: ColumnCodec, value):
+    if codec.kind == "datetime":
+        return int(np.datetime64(value, codec.unit).view("int64"))
+    if isinstance(value, (bool, np.bool_)):
+        return int(value)
+    return value
+
+
+# --------------------------------------------------------------------------
+# predicate compiler: Expr tree -> jnp program over encoded columns
+# --------------------------------------------------------------------------
+
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+
+
+def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
+    """Compile ``expr`` into ``f(cols: dict[str, jnp.ndarray]) -> bool mask``.
+
+    Raises DeviceUnsupported for shapes outside the device language (string
+    arithmetic, input_file_name(), col-vs-col string compares, ...).
+    """
+    import jax.numpy as jnp
+
+    def is_string_col(e: Expr) -> bool:
+        return isinstance(e, Col) and codecs[e.name].kind == "string"
+
+    def build_num(e: Expr):
+        """Numeric-valued subexpression -> device fn."""
+        if isinstance(e, Col):
+            codec = codecs[e.name]
+            if codec.kind == "string":
+                raise DeviceUnsupported("string column used in numeric context")
+            name = e.name
+            return lambda cols: cols[name]
+        if isinstance(e, Lit):
+            v = e.value
+            if isinstance(v, str):
+                raise DeviceUnsupported("string literal in numeric context")
+            if isinstance(v, np.datetime64):
+                v = int(v.view("int64"))
+            return lambda cols, v=v: v
+        if isinstance(e, BinaryOp) and e.op in ("+", "-", "*", "/", "%"):
+            lf, rf = build_num(e.left), build_num(e.right)
+            op = e.op
+            def f(cols):
+                l, r = lf(cols), rf(cols)
+                if op == "+":
+                    return l + r
+                if op == "-":
+                    return l - r
+                if op == "*":
+                    return l * r
+                if op == "/":
+                    return l / r
+                return l % r
+            return f
+        raise DeviceUnsupported(f"unsupported numeric expr {type(e).__name__}")
+
+    def string_compare(col: Col, op: str, lit_value) -> "callable":
+        codec = codecs[col.name]
+        if codec.kind != "string" or not isinstance(lit_value, str):
+            # mixed-type compares have host-defined semantics; don't guess
+            raise DeviceUnsupported("string compare requires string column and string literal")
+        lo, hi = _literal_bounds(codec, lit_value)
+        name = col.name
+        if op == "=":
+            return lambda cols: (cols[name] >= lo) & (cols[name] < hi)
+        if op == "!=":
+            # null codes (-1) satisfy != like the host's elementwise None != "x"
+            return lambda cols: (cols[name] < lo) | (cols[name] >= hi)
+        if op == "<":
+            return lambda cols: (cols[name] < lo) & (cols[name] >= 0)
+        if op == "<=":
+            return lambda cols: (cols[name] < hi) & (cols[name] >= 0)
+        if op == ">":
+            return lambda cols: cols[name] >= hi
+        if op == ">=":
+            return lambda cols: cols[name] >= lo
+        raise DeviceUnsupported(f"unsupported string compare {op}")
+
+    def build_bool(e: Expr):
+        if isinstance(e, BinaryOp) and e.op in ("AND", "OR"):
+            lf, rf = build_bool(e.left), build_bool(e.right)
+            if e.op == "AND":
+                return lambda cols: lf(cols) & rf(cols)
+            return lambda cols: lf(cols) | rf(cols)
+        if isinstance(e, Not):
+            cf = build_bool(e.child)
+            return lambda cols: ~cf(cols)
+        if isinstance(e, IsNull):
+            c = e.child
+            if isinstance(c, Col):
+                codec = codecs[c.name]
+                name = c.name
+                if codec.kind == "string":
+                    return lambda cols: cols[name] < 0
+                if codec.kind == "numeric":
+                    return lambda cols: jnp.isnan(cols[name]) if cols[name].dtype == jnp.float64 else jnp.zeros(cols[name].shape, bool)
+                return lambda cols: jnp.zeros(cols[name].shape, bool)
+            raise DeviceUnsupported("IS NULL on non-column")
+        if isinstance(e, In):
+            child = e.child
+            if not isinstance(child, Col):
+                raise DeviceUnsupported("IN on non-column")
+            values = [v.value for v in e.values]
+            if not values:
+                raise DeviceUnsupported("empty IN list")
+            if is_string_col(child):
+                if not all(isinstance(v, str) for v in values):
+                    raise DeviceUnsupported("mixed-type IN on string column")
+            elif any(isinstance(v, str) for v in values):
+                raise DeviceUnsupported("string IN value on non-string column")
+            terms = []
+            for val in values:
+                if is_string_col(child):
+                    terms.append(string_compare(child, "=", val))
+                else:
+                    cf = build_num(child)
+                    num = _literal_numeric(codecs[child.name], val)
+                    terms.append(lambda cols, cf=cf, num=num: cf(cols) == num)
+            def f(cols):
+                m = terms[0](cols)
+                for t in terms[1:]:
+                    m = m | t(cols)
+                return m
+            return f
+        if isinstance(e, BinaryOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
+            left, right, op = e.left, e.right, e.op
+            # normalize: Col OP Lit
+            if isinstance(right, Col) and isinstance(left, Lit):
+                left, right, op = right, left, _FLIP[op]
+            if isinstance(left, Col) and isinstance(right, Lit):
+                codec = codecs[left.name]
+                if codec.kind == "string" or isinstance(right.value, str):
+                    if codec.kind != "string":
+                        raise DeviceUnsupported("string literal vs non-string column")
+                    return string_compare(left, op, right.value)
+                lf = build_num(left)
+                val = _literal_numeric(codec, right.value)
+                return _compare(lf, lambda cols, val=val: val, op)
+            # general numeric compare (col-vs-col, arithmetic)
+            return _compare(build_num(left), build_num(right), op)
+        if isinstance(e, InputFileName):
+            raise DeviceUnsupported("input_file_name() is host-only")
+        raise DeviceUnsupported(f"unsupported boolean expr {type(e).__name__}")
+
+    def _compare(lf, rf, op: str):
+        if op == "=":
+            return lambda cols: lf(cols) == rf(cols)
+        if op == "!=":
+            return lambda cols: lf(cols) != rf(cols)
+        if op == "<":
+            return lambda cols: lf(cols) < rf(cols)
+        if op == "<=":
+            return lambda cols: lf(cols) <= rf(cols)
+        if op == ">":
+            return lambda cols: lf(cols) > rf(cols)
+        return lambda cols: lf(cols) >= rf(cols)
+
+    return build_bool(expr)
+
+
+# --------------------------------------------------------------------------
+# device filter
+# --------------------------------------------------------------------------
+
+
+def _pad_to_multiple(arr: np.ndarray, m: int, fill) -> np.ndarray:
+    n = arr.shape[0]
+    rem = (-n) % m
+    if rem == 0:
+        return arr
+    pad = np.full((rem,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def device_filter_mask(session, batch: B.Batch, condition: Expr) -> np.ndarray:
+    """Evaluate ``condition`` on device over the referenced columns of
+    ``batch``; returns the host bool mask. Raises DeviceUnsupported when the
+    predicate is outside the device language."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    refs = sorted(condition.references())
+    for r in refs:
+        if r not in batch:
+            raise DeviceUnsupported(f"referenced column {r!r} missing from batch")
+    n = B.num_rows(batch)
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+
+    encoded: Dict[str, np.ndarray] = {}
+    codecs: Dict[str, ColumnCodec] = {}
+    for r in refs:
+        encoded[r], codecs[r] = encode_column(batch[r])
+    fn = compile_predicate(condition, codecs)
+
+    mesh = session.mesh
+    n_dev = mesh.devices.size
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+    dev_cols = {
+        k: jax.device_put(_pad_to_multiple(v, n_dev, 0 if v.dtype != np.float64 else np.nan), sharding)
+        for k, v in encoded.items()
+    }
+
+    mask = jax.jit(fn)(dev_cols)
+    return np.asarray(mask)[:n]
+
+
+# --------------------------------------------------------------------------
+# bucketed shuffle-free merge join
+# --------------------------------------------------------------------------
+
+
+def _strip_projects(plan: L.LogicalPlan) -> Tuple[L.LogicalPlan, Optional[List[str]]]:
+    cols = None
+    while isinstance(plan, L.Project):
+        cols = list(plan.columns) if cols is None else cols
+        plan = plan.child
+    return plan, cols
+
+
+def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.IndexScan, L.IndexScan, List[str], List[str]]]:
+    """If both join children are (projected) IndexScans bucketed on exactly the
+    join keys with equal bucket counts, return (left_scan, right_scan, lkeys,
+    rkeys); else None (ref: JoinIndexRanker's equal-bucket preference,
+    HS/index/covering/JoinIndexRanker.scala:52-92)."""
+    pairs = extract_equi_join_keys(plan.condition)
+    if not pairs:
+        return None
+    lchild, _ = _strip_projects(plan.left)
+    rchild, _ = _strip_projects(plan.right)
+    if not isinstance(lchild, L.IndexScan) or not isinstance(rchild, L.IndexScan):
+        return None
+    lspec, rspec = lchild.bucket_spec, rchild.bucket_spec
+    if lspec is None or rspec is None or lspec.num_buckets != rspec.num_buckets:
+        return None
+    lcols = set(lchild.columns)
+    rcols = set(rchild.columns)
+    lkeys, rkeys = [], []
+    for a, b in pairs:
+        if a in lcols and b in rcols:
+            lkeys.append(a)
+            rkeys.append(b)
+        elif b in lcols and a in rcols:
+            lkeys.append(b)
+            rkeys.append(a)
+        else:
+            return None
+    if list(lspec.bucket_columns) != lkeys or list(rspec.bucket_columns) != rkeys:
+        return None
+    return lchild, rchild, lkeys, rkeys
+
+
+def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str] = None) -> Dict[int, B.Batch]:
+    """Read an IndexScan's files grouped per bucket id (file name carries the
+    bucket; ref layout: part-<bucket>.parquet, indexes/covering.py).
+
+    Only ``columns`` are decoded. When ``sort_key`` is given, each bucket is
+    re-sorted on it if needed: a bucket holding several files (incremental
+    refresh merges delta files into existing buckets, UpdateMode.Merge —
+    ref: actions/RefreshIncrementalAction.scala:115-128) is only piecewise
+    sorted after concatenation."""
+    import pyarrow.dataset as pads
+
+    from hyperspace_tpu.indexes.covering import bucket_of_file
+
+    per_bucket: Dict[int, List[str]] = {}
+    for f in scan.files:
+        b = bucket_of_file(f)
+        if b is None:
+            raise DeviceUnsupported(f"index file {f!r} has no bucket id")
+        per_bucket.setdefault(b, []).append(f)
+    out: Dict[int, B.Batch] = {}
+    for b, files in per_bucket.items():
+        t = pads.dataset(files, format="parquet").to_table(columns=columns)
+        batch = B.table_to_batch(t)
+        if sort_key is not None and len(files) > 1:
+            k = batch[sort_key]
+            if k.size > 1 and np.any(k[1:] < k[:-1]):
+                batch = B.take(batch, np.argsort(k, kind="stable"))
+        out[b] = batch
+    return out
+
+
+def device_bucketed_join(session, plan: L.Join) -> B.Batch:
+    """Execute a compatible bucketed equi-join on device.
+
+    Per-bucket sorted runs of both sides are padded to rectangles, sharded over
+    the mesh's bucket axis, and each device computes, for every left row, the
+    [lo, hi) span of matching right rows via two vmapped ``searchsorted``
+    passes — no collective is emitted (the reference's no-exchange SMJ,
+    HS/index/covering/JoinIndexRule.scala:604-618). Pair expansion and column
+    gathering happen host-side (variable-size output).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from hyperspace_tpu.parallel.mesh import get_shard_map
+
+    shard_map = get_shard_map()
+
+    compat = join_sides_compatible(plan)
+    if compat is None:
+        raise DeviceUnsupported("join sides are not compatible bucketed index scans")
+    lscan, rscan, lkeys, rkeys = compat
+    if len(lkeys) != 1:
+        raise DeviceUnsupported("device join supports single-key equi-joins (multi-key -> host)")
+    lkey, rkey = lkeys[0], rkeys[0]
+    if plan.how != "inner":
+        raise DeviceUnsupported("device join handles inner joins (outer -> host)")
+
+    # key dtype check from parquet metadata BEFORE any data is decoded — an
+    # unsupported key must not cost a full read on both sides
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for scan, key in ((lscan, lkey), (rscan, rkey)):
+        if not scan.files:
+            raise DeviceUnsupported("empty index scan")
+        field = pq.read_schema(scan.files[0]).field(key)
+        if not (pa.types.is_integer(field.type) or pa.types.is_temporal(field.type) or pa.types.is_boolean(field.type)):
+            raise DeviceUnsupported(f"device join requires integer/datetime keys; got {field.type}")
+
+    # decode only the columns the join output (plus keys) needs
+    needed = set(plan.output_columns) | {n[:-2] for n in plan.output_columns if n.endswith("#r")}
+    lcols_needed = [c for c in lscan.columns if c in needed or c == lkey]
+    rcols_needed = [c for c in rscan.columns if c in needed or c == rkey]
+    lbuckets = _read_buckets(lscan, lcols_needed, sort_key=lkey)
+    rbuckets = _read_buckets(rscan, rcols_needed, sort_key=rkey)
+    nb = lscan.bucket_spec.num_buckets
+
+    # Encode keys; only identity-ordered encodings are cross-side comparable.
+    def key_of(batch: B.Batch, key: str) -> np.ndarray:
+        arr = batch[key]
+        if arr.dtype.kind in ("i", "u", "b"):
+            return arr.astype(np.int64)
+        if arr.dtype.kind == "M":
+            return arr.view("int64").astype(np.int64)
+        raise DeviceUnsupported(f"device join requires integer/datetime keys; got {arr.dtype}")
+
+    SENTINEL = np.int64(2**62)
+    mesh = session.mesh
+    n_dev = mesh.devices.size
+    axis = mesh.axis_names[0]
+    nb_padded = nb + ((-nb) % n_dev)
+
+    def stack_side(buckets: Dict[int, B.Batch], key: str):
+        lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
+        width = max(max(lens), 1)
+        keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
+        for b in range(nb_padded):
+            if lens[b]:
+                keys_mat[b, : lens[b]] = key_of(buckets[b], key)
+        return keys_mat, np.asarray(lens, dtype=np.int64)
+
+    lmat, llens = stack_side(lbuckets, lkey)
+    rmat, rlens = stack_side(rbuckets, rkey)
+
+    sharding = NamedSharding(mesh, P(axis))
+
+    @jax.jit
+    def spans(lm, rm):
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)))
+        def per_shard(lm_, rm_):
+            lo = jax.vmap(lambda lk, rk: jnp.searchsorted(rk, lk, side="left"))(lm_, rm_)
+            hi = jax.vmap(lambda lk, rk: jnp.searchsorted(rk, lk, side="right"))(lm_, rm_)
+            return lo, hi
+        return per_shard(lm, rm)
+
+    lo, hi = spans(jax.device_put(lmat, sharding), jax.device_put(rmat, sharding))
+    lo = np.asarray(lo)
+    hi = np.asarray(hi)
+
+    # host-side pair expansion (variable-size output) + column gather
+    out_batches: List[B.Batch] = []
+    out_cols = plan.output_columns
+    lout = list(lcols_needed)
+    rout = list(rcols_needed)
+    for b in range(nb):
+        ll = int(llens[b])
+        if ll == 0 or int(rlens[b]) == 0:
+            continue
+        counts = (hi[b, :ll] - lo[b, :ll]).astype(np.int64)
+        total = int(counts.sum())
+        if total == 0:
+            continue
+        lidx = np.repeat(np.arange(ll), counts)
+        # right indices: for row i, lo[i] .. hi[i]-1
+        offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        ridx = np.arange(total) - np.repeat(offsets, counts) + np.repeat(lo[b, :ll], counts)
+        lb, rb = lbuckets[b], rbuckets[b]
+        out: B.Batch = {}
+        for name in out_cols:
+            if name in lout:
+                out[name] = lb[name][lidx]
+            elif name.endswith("#r") and name[:-2] in rout:
+                out[name] = rb[name[:-2]][ridx]
+            elif name in rout:
+                out[name] = rb[name][ridx]
+            else:
+                raise DeviceUnsupported(f"join output column {name!r} not found on either side")
+        out_batches.append(out)
+    if not out_batches:
+        # preserve real column dtypes in the empty result
+        def empty_like(name: str) -> np.ndarray:
+            if name in lout:
+                src, col = lbuckets, name
+            else:
+                src, col = rbuckets, name[:-2] if name.endswith("#r") else name
+            for b in src.values():
+                if col in b:
+                    return np.empty(0, dtype=b[col].dtype)
+            raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
+
+        return {name: empty_like(name) for name in out_cols}
+    return B.concat(out_batches)

@@ -72,7 +72,7 @@ class Executor:
 
         if isinstance(plan, L.Filter):
             child = self._exec(plan.child, with_file_names)
-            mask = np.asarray(plan.condition.eval(child), dtype=bool)
+            mask = self._filter_mask(plan, child)
             return B.mask_rows(child, mask)
 
         if isinstance(plan, L.Project):
@@ -94,8 +94,30 @@ class Executor:
 
         raise NotImplementedError(f"Cannot execute {type(plan).__name__}")
 
+    def _filter_mask(self, plan: L.Filter, child: B.Batch) -> np.ndarray:
+        """Predicate evaluation: device path over index/file scans when the
+        session mesh is available, host numpy otherwise."""
+        if self.session.conf.device_execution_enabled and isinstance(
+            plan.child, (L.IndexScan, L.FileScan)
+        ):
+            from hyperspace_tpu.exec import device as D
+
+            try:
+                return D.device_filter_mask(self.session, child, plan.condition)
+            except D.DeviceUnsupported:
+                pass
+        return np.asarray(plan.condition.eval(child), dtype=bool)
+
     def _exec_join(self, plan: L.Join, with_file_names: bool) -> B.Batch:
         import pandas as pd
+
+        if self.session.conf.device_execution_enabled and not with_file_names:
+            from hyperspace_tpu.exec import device as D
+
+            try:
+                return D.device_bucketed_join(self.session, plan)
+            except D.DeviceUnsupported:
+                pass
 
         pairs = extract_equi_join_keys(plan.condition)
         if pairs is None:

@@ -23,7 +23,9 @@ import jax
 jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
-from jax.experimental.shard_map import shard_map  # noqa: E402
+from hyperspace_tpu.parallel.mesh import get_shard_map  # noqa: E402
+
+shard_map = get_shard_map()
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 

@@ -18,6 +18,23 @@ import numpy as np
 from hyperspace_tpu.ops import hashing
 
 
+def factorize_strings(arr: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Null-aware string factorization — THE one implementation shared by
+    build-time sort keys, bucket hashing, and query-time device encoding (so
+    the three encodings can never diverge).
+
+    Returns ``(codes, uniques, null_mask)``: ``codes`` is int64 ranks into the
+    sorted ``uniques`` with -1 for nulls.
+    """
+    obj = arr.astype(object)
+    null_mask = np.array([x is None for x in obj], dtype=bool)
+    filled = np.where(null_mask, "", obj).astype(str)
+    uniques, inverse = np.unique(filled, return_inverse=True)
+    codes = inverse.astype(np.int64)
+    codes[null_mask] = -1
+    return codes, uniques, null_mask
+
+
 def sort_key_int64(arr: np.ndarray) -> np.ndarray:
     """Order-preserving int64 key for any supported column dtype."""
     kind = arr.dtype.kind
@@ -30,8 +47,8 @@ def sort_key_int64(arr: np.ndarray) -> np.ndarray:
         # IEEE-754 total order: flip sign bit for positives, all bits for negatives
         return np.where(bits >= 0, bits ^ np.int64(-0x8000000000000000), ~bits)
     if kind in ("U", "S", "O"):
-        uniques, inverse = np.unique(arr.astype(object), return_inverse=True)
-        return inverse.astype(np.int64)
+        codes, _, _ = factorize_strings(arr)  # nulls (-1) sort first
+        return codes
     raise TypeError(f"Unsupported column dtype for sorting: {arr.dtype}")
 
 

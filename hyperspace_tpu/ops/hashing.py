@@ -77,11 +77,19 @@ def string_hash32(value: str) -> np.uint32:
     return np.uint32(int.from_bytes(digest[:4], "little"))
 
 
+_NULL_STRING_SENTINEL = "\x00__hs_null__"
+
+
 def string_hash32_array(values: np.ndarray) -> np.ndarray:
-    """Vectorized over uniques: factorize, hash each unique once, gather."""
-    uniques, inverse = np.unique(values.astype(object), return_inverse=True)
+    """Vectorized over uniques: factorize, hash each unique once, gather.
+    Nulls hash via a fixed sentinel so build-time and query-time bucket
+    assignment agree."""
+    from hyperspace_tpu.ops.encode import factorize_strings
+
+    codes, uniques, null_mask = factorize_strings(values)
     table = np.array([string_hash32(u) for u in uniques], dtype=np.uint32)
-    return table[inverse]
+    out = np.where(null_mask, string_hash32(_NULL_STRING_SENTINEL), table[np.clip(codes, 0, None)])
+    return out.astype(np.uint32)
 
 
 def numeric_hash32(arr: np.ndarray) -> np.ndarray:

@@ -10,10 +10,18 @@ Env vars must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force the 8-device virtual CPU mesh. Env vars alone are NOT enough here: the
+# axon sitecustomize imports jax at interpreter startup (before conftest), so
+# JAX_PLATFORMS was already read from the environment as "axon". Updating the
+# config object works any time before backend initialization.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,302 @@
+"""Device execution path tests on the virtual 8-device CPU mesh.
+
+The device path must agree bit-for-bit with the host executor on every
+supported pattern, and silently fall back for anything else — the same
+"never break a query" contract as ApplyHyperspace
+(ref: HS/index/rules/ApplyHyperspace.scala:59-63).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.exec import device as D
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import col, lit
+
+
+def sort_batch(batch):
+    order = np.lexsort(
+        [np.asarray(v).astype("U64") if v.dtype == object else v for v in reversed(list(batch.values()))]
+    )
+    return {k: v[order] for k, v in batch.items()}
+
+
+def assert_batches_equal(a, b):
+    assert sorted(a.keys()) == sorted(b.keys())
+    assert B.num_rows(a) == B.num_rows(b)
+    a, b = sort_batch(a), sort_batch(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"column {k}")
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+def run_both(session, query):
+    """Collect with device execution on and off; both must agree."""
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    dev = query.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    host = query.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    assert_batches_equal(dev, host)
+    return dev
+
+
+class TestDeviceFilter:
+    def test_numeric_predicates(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("devIdx", ["c1"], ["c2", "c3"]))
+        session.enable_hyperspace()
+        for cond in [
+            col("c1") == 7,
+            (col("c1") > 20) & (col("c1") <= 60),
+            (col("c1") == 3) | (col("c2") < 100),
+            col("c1").isin(1, 5, 9),
+            ~(col("c1") == 7),
+            (col("c1") + col("c2")) % 7 == 0,
+        ]:
+            q = df.filter(cond).select("c2")
+            out = run_both(session, q)
+            assert B.num_rows(out) > 0
+
+    def test_string_predicates(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("strIdx", ["c4"], ["c1"]))
+        session.enable_hyperspace()
+        for cond in [
+            col("c4") == "name_5",
+            col("c4") < "name_2",
+            col("c4") >= "name_30",
+            col("c4").isin("name_1", "name_36", "does_not_exist"),
+            col("c4") != "name_0",
+        ]:
+            q = df.filter(cond).select("c1")
+            run_both(session, q)
+
+    def test_absent_string_literal_matches_nothing(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("strIdx2", ["c4"], ["c1"]))
+        session.enable_hyperspace()
+        q = df.filter(col("c4") == "zzz_not_there").select("c1")
+        out = run_both(session, q)
+        assert B.num_rows(out) == 0
+
+    def test_mixed_type_predicates_fall_back_to_host(self, session, hs, sample_parquet):
+        # string column vs int literal, and mixed-type IN: host-defined
+        # semantics — device path must decline (not crash, not diverge)
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("mixIdx", ["c4"], ["c1"]))
+        session.enable_hyperspace()
+        codecs = {"c4": D.ColumnCodec("string", uniques=np.array(["a"])), "c1": D.ColumnCodec("numeric")}
+        with pytest.raises(D.DeviceUnsupported):
+            D.compile_predicate(col("c4") == lit(5), codecs)
+        with pytest.raises(D.DeviceUnsupported):
+            D.compile_predicate(col("c4").isin("a", 5), codecs)
+        with pytest.raises(D.DeviceUnsupported):
+            D.compile_predicate(col("c1").isin("a", 5), codecs)
+        # end-to-end: query still succeeds via host fallback
+        q = df.filter(col("c4").isin("name_1", 5)).select("c1")
+        run_both(session, q)
+
+    def test_string_ne_with_nulls_matches_host(self, session, hs, tmp_path):
+        root = tmp_path / "nulls"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"s": pa.array(["a", None, "b", "a"], type=pa.string()), "v": np.arange(4, dtype=np.int64)}),
+            root / "p.parquet",
+        )
+        df = session.read_parquet(str(root))
+        hs = hst.Hyperspace(session)
+        hs.create_index(df, hst.CoveringIndexConfig("nullIdx", ["s"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(col("s") != "a").select("v")
+        out = run_both(session, q)
+        # host semantics: None != "a" is True, so the null row is kept
+        assert set(out["v"].tolist()) == {1, 2}
+
+    def test_predicate_compiler_rejects_host_only(self, session):
+        from hyperspace_tpu.plan.expr import input_file_name
+
+        codecs = {"a": D.ColumnCodec("numeric")}
+        with pytest.raises(D.DeviceUnsupported):
+            D.compile_predicate(input_file_name() == "x", codecs)
+
+    def test_datetime_predicates(self, session, hs, tmp_path):
+        root = tmp_path / "dates"
+        root.mkdir()
+        base = np.datetime64("2020-01-01")
+        n = 500
+        rng = np.random.default_rng(0)
+        table = pa.table(
+            {
+                "d": base + rng.integers(0, 365, n).astype("timedelta64[D]"),
+                "v": rng.integers(0, 100, n).astype(np.int64),
+            }
+        )
+        pq.write_table(table, root / "part-00000.parquet")
+        df = session.read_parquet(str(root))
+        hs = hst.Hyperspace(session)
+        hs.create_index(df, hst.CoveringIndexConfig("dateIdx", ["d"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter((col("d") >= lit(np.datetime64("2020-06-01"))) & (col("d") < lit(np.datetime64("2020-07-01")))).select("v")
+        run_both(session, q)
+
+
+class TestDeviceJoin:
+    @pytest.fixture()
+    def two_tables(self, tmp_path):
+        rng = np.random.default_rng(7)
+        n1, n2 = 3000, 1000
+        left = pa.table(
+            {
+                "k": rng.integers(0, 400, n1).astype(np.int64),
+                "lv": rng.standard_normal(n1),
+            }
+        )
+        right = pa.table(
+            {
+                "k": rng.integers(0, 400, n2).astype(np.int64),
+                "rv": rng.integers(0, 10, n2).astype(np.int64),
+            }
+        )
+        lroot, rroot = tmp_path / "left", tmp_path / "right"
+        lroot.mkdir()
+        rroot.mkdir()
+        for i in range(3):
+            pq.write_table(left.slice(i * 1000, 1000), lroot / f"part-{i:05d}.parquet")
+        pq.write_table(right, rroot / "part-00000.parquet")
+        return str(lroot), str(rroot)
+
+    def test_bucketed_join_device_equals_host(self, session, hs, two_tables):
+        lpath, rpath = two_tables
+        session.conf.set(hst.keys.NUM_BUCKETS, 16)
+        ldf = session.read_parquet(lpath)
+        rdf = session.read_parquet(rpath)
+        hs.create_index(ldf, hst.CoveringIndexConfig("lIdx", ["k"], ["lv"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("rIdx", ["k"], ["rv"]))
+        session.enable_hyperspace()
+
+        q = ldf.join(rdf, on="k").select("k", "lv", "rv")
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert len(scans) == 2, plan.pretty()
+        # joined result identical with device exec on/off, and vs no index at all
+        dev = run_both(session, q)
+        session.disable_hyperspace()
+        baseline = q.collect()
+        assert_batches_equal(dev, baseline)
+        assert B.num_rows(dev) > 0
+
+    def test_join_with_duplicate_keys_both_sides(self, session, hs, tmp_path):
+        # many-to-many expansion must match pandas merge exactly
+        lroot, rroot = tmp_path / "l2", tmp_path / "r2"
+        lroot.mkdir()
+        rroot.mkdir()
+        pq.write_table(
+            pa.table({"k": np.array([1, 1, 2, 3, 3, 3], dtype=np.int64), "a": np.arange(6, dtype=np.int64)}),
+            lroot / "part-00000.parquet",
+        )
+        pq.write_table(
+            pa.table({"k": np.array([1, 1, 3, 4], dtype=np.int64), "b": np.arange(4, dtype=np.int64)}),
+            rroot / "part-00000.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf = session.read_parquet(str(lroot))
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("dupL", ["k"], ["a"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("dupR", ["k"], ["b"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on="k").select("k", "a", "b")
+        out = run_both(session, q)
+        # 1 matches 2 rows ×2 left rows, 3 matches 1 row ×3 left rows = 7
+        assert B.num_rows(out) == 2 * 2 + 3 * 1
+
+    def test_join_after_incremental_refresh_resorts_buckets(self, session, hs, tmp_path):
+        # incremental refresh merges delta files into existing buckets
+        # (UpdateMode.Merge) leaving them only piecewise sorted; the device
+        # join must re-sort before searchsorted
+        lroot, rroot = tmp_path / "l4", tmp_path / "r4"
+        lroot.mkdir()
+        rroot.mkdir()
+        rng = np.random.default_rng(3)
+        pq.write_table(
+            pa.table({"k": rng.integers(0, 50, 400).astype(np.int64), "a": np.arange(400, dtype=np.int64)}),
+            lroot / "part-00000.parquet",
+        )
+        pq.write_table(
+            pa.table({"k": np.arange(50, dtype=np.int64), "b": np.arange(50, dtype=np.int64)}),
+            rroot / "part-00000.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf = session.read_parquet(str(lroot))
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("incL", ["k"], ["a"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("incR", ["k"], ["b"]))
+        # append more rows and refresh incrementally -> multi-file buckets
+        pq.write_table(
+            pa.table({"k": rng.integers(0, 50, 400).astype(np.int64), "a": np.arange(400, 800, dtype=np.int64)}),
+            lroot / "part-00001.parquet",
+        )
+        hs.refresh_index("incL", "incremental")
+        session.enable_hyperspace()
+        # re-read: relations snapshot their file list at construction (as
+        # Spark's InMemoryFileIndex does), so the post-append source needs a
+        # fresh scan for signatures to line up with the refreshed index
+        ldf = session.read_parquet(str(lroot))
+        q = ldf.join(rdf, on="k").select("k", "a", "b")
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert len(scans) == 2, plan.pretty()
+        assert any(len(s.files) > 4 for s in scans)  # merged buckets have >1 file
+        out = run_both(session, q)
+        session.disable_hyperspace()
+        assert_batches_equal(out, q.collect())
+
+    def test_empty_join_result_preserves_dtypes(self, session, hs, tmp_path):
+        lroot, rroot = tmp_path / "l5", tmp_path / "r5"
+        lroot.mkdir()
+        rroot.mkdir()
+        pq.write_table(
+            pa.table({"k": np.array([1, 2], dtype=np.int64), "a": np.array([10, 20], dtype=np.int64)}),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table({"k": np.array([5, 6], dtype=np.int64), "b": np.array([1.5, 2.5])}),
+            rroot / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        ldf = session.read_parquet(str(lroot))
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("eL", ["k"], ["a"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("eR", ["k"], ["b"]))
+        session.enable_hyperspace()
+        out = ldf.join(rdf, on="k").select("k", "a", "b").collect()
+        assert B.num_rows(out) == 0
+        assert out["k"].dtype == np.int64
+        assert out["a"].dtype == np.int64
+        assert out["b"].dtype == np.float64
+
+    def test_string_key_join_falls_back_to_host(self, session, hs, tmp_path):
+        lroot, rroot = tmp_path / "l3", tmp_path / "r3"
+        lroot.mkdir()
+        rroot.mkdir()
+        keys_l = np.array(["a", "b", "c", "a"], dtype=object)
+        keys_r = np.array(["a", "c"], dtype=object)
+        pq.write_table(pa.table({"k": keys_l.astype(str), "a": np.arange(4, dtype=np.int64)}), lroot / "p.parquet")
+        pq.write_table(pa.table({"k": keys_r.astype(str), "b": np.arange(2, dtype=np.int64)}), rroot / "p.parquet")
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        ldf = session.read_parquet(str(lroot))
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("sL", ["k"], ["a"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("sR", ["k"], ["b"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on="k").select("k", "a", "b")
+        out = run_both(session, q)
+        assert B.num_rows(out) == 3  # a×2 matches + c×1
