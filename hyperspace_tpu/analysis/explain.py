@@ -11,7 +11,13 @@ from hyperspace_tpu.plan import logical as L
 
 
 def _used_indexes(plan: L.LogicalPlan) -> List[str]:
-    return sorted({s.entry.name for s in L.collect(plan, lambda p: isinstance(p, L.IndexScan))})
+    used = {s.entry.name for s in L.collect(plan, lambda p: isinstance(p, L.IndexScan))}
+    used |= {
+        s.via_index
+        for s in L.collect(plan, lambda p: isinstance(p, L.FileScan))
+        if s.via_index
+    }
+    return sorted(used)
 
 
 def _bucket_summary(plan: L.LogicalPlan) -> List[str]:

@@ -57,7 +57,9 @@ class Sketch:
         for sk in (MinMaxSketch, BloomFilterSketch, ValueListSketch, PartitionSketch):
             if sk.kind == kind:
                 if kind == "BloomFilter":
-                    return BloomFilterSketch(d["expr"], d.get("fpp", 0.01), d.get("expectedItems", 10000))
+                    return BloomFilterSketch(
+                        d["expr"], d.get("fpp", 0.01), d.get("expectedItems", 10000), d.get("valueDtype")
+                    )
                 return sk(d["expr"])
         raise ValueError(f"Unknown sketch kind {kind!r}")
 
@@ -108,10 +110,14 @@ class BloomFilterSketch(Sketch):
 
     kind = "BloomFilter"
 
-    def __init__(self, expr: str, fpp: float = 0.01, expected_items: int = 10000):
+    def __init__(self, expr: str, fpp: float = 0.01, expected_items: int = 10000, value_dtype: Optional[str] = None):
         super().__init__(expr)
         self.fpp = float(fpp)
         self.expected_items = int(expected_items)
+        # hashing is dtype-sensitive (float64 5.0 and int64 5 have different
+        # bit patterns); the build-time column dtype is recorded so query
+        # literals can be coerced before membership tests
+        self.value_dtype = value_dtype
         m = max(64, int(-expected_items * math.log(fpp) / (math.log(2) ** 2)))
         self.num_bits = 1 << max(6, (m - 1).bit_length())  # power of two
         self.num_hashes = max(1, int(round(self.num_bits / expected_items * math.log(2))))
@@ -128,18 +134,30 @@ class BloomFilterSketch(Sketch):
         return ((h1[:, None] + ks[None, :] * h2[:, None]) % np.uint64(self.num_bits)).astype(np.int64)
 
     def aggregate(self, values: np.ndarray) -> List[Any]:
+        self.value_dtype = str(values.dtype)
         bits = np.zeros(self.num_bits // 64, dtype=np.uint64)
         pos = self._positions(values).reshape(-1)
         np.bitwise_or.at(bits, pos // 64, np.uint64(1) << (pos % np.uint64(64)).astype(np.uint64))
         return [bits.view(np.int64).tolist()]
 
     def might_contain(self, bits_words: List[int], value) -> bool:
+        """Raises on a literal that cannot be coerced to the build dtype —
+        callers treat that as unprunable."""
+        arr = np.asarray([value])
+        if self.value_dtype is not None and self.value_dtype != "object":
+            arr = arr.astype(np.dtype(self.value_dtype))
         bits = np.asarray(bits_words, dtype=np.int64).view(np.uint64)
-        pos = self._positions(np.asarray([value])).reshape(-1)
+        pos = self._positions(arr).reshape(-1)
         return bool(np.all((bits[pos // 64] >> (pos % np.uint64(64)).astype(np.uint64)) & np.uint64(1)))
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"kind": self.kind, "expr": self.expr, "fpp": self.fpp, "expectedItems": self.expected_items}
+        return {
+            "kind": self.kind,
+            "expr": self.expr,
+            "fpp": self.fpp,
+            "expectedItems": self.expected_items,
+            "valueDtype": self.value_dtype,
+        }
 
 
 class PartitionSketch(Sketch):

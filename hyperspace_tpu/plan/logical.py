@@ -180,10 +180,13 @@ class FileScan(LogicalPlan):
     hybrid scan; ref: CoveringIndexRuleUtils' appended-data scan,
     HS/index/covering/CoveringIndexRuleUtils.scala:206-243)."""
 
-    def __init__(self, files: List[str], file_format: str, columns: List[str]):
+    def __init__(self, files: List[str], file_format: str, columns: List[str], via_index: Optional[str] = None):
         self.files = list(files)
         self.file_format = file_format
         self.columns = list(columns)
+        # name of the index whose rewrite produced this scan (e.g. a
+        # data-skipping prune), for explain/whyNot reporting
+        self.via_index = via_index
 
     @property
     def output_columns(self) -> List[str]:
@@ -194,7 +197,8 @@ class FileScan(LogicalPlan):
         return self
 
     def describe(self) -> str:
-        return f"FileScan({len(self.files)} files, format={self.file_format})"
+        via = f", Hyperspace(Type: DS, Name: {self.via_index})" if self.via_index else ""
+        return f"FileScan({len(self.files)} files, format={self.file_format}{via})"
 
 
 class IndexScan(LogicalPlan):

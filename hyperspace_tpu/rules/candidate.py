@@ -20,15 +20,26 @@ from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.sources.signatures import index_signature
 
 
+def _referenced_columns(entry: IndexLogEntry) -> List[str]:
+    """Kind-polymorphic referenced columns via the index registry (covering:
+    indexed+included; data-skipping: sketched columns)."""
+    from hyperspace_tpu.indexes import registry
+
+    try:
+        return [str(c) for c in registry.index_of_entry(entry).referenced_columns]
+    except Exception:
+        props = entry.derived_dataset.properties
+        return [str(c) for c in props.get("indexedColumns", [])] + [
+            str(c) for c in props.get("includedColumns", [])
+        ]
+
+
 def _schema_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntry]) -> List[IndexLogEntry]:
     """Index's referenced columns ⊆ relation output (ref: ColumnSchemaFilter.scala:29-44)."""
     out = []
     relation_cols = {c.lower() for c in scan.output_columns}
     for entry in indexes:
-        props = entry.derived_dataset.properties
-        referenced = [str(c) for c in props.get("indexedColumns", [])] + [
-            str(c) for c in props.get("includedColumns", [])
-        ]
+        referenced = _referenced_columns(entry)
         ok = all(c.lower() in relation_cols for c in referenced)
         if ctx.tag_reason_if_failed(
             ok, entry, scan, lambda: R.col_schema_mismatch(referenced, scan.output_columns)
@@ -71,7 +82,12 @@ def _signature_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntr
         appended_bytes = sum(f.size for f in appended)
         deleted_bytes = sum(f.size for f in deleted)
         if deleted:
-            if not entry.has_lineage_column():
+            # kind-polymorphic: covering indexes need the lineage column to
+            # filter deleted rows; data-skipping handles deletes naturally
+            # (it prunes over *current* files)
+            from hyperspace_tpu.indexes import registry
+
+            if not registry.index_of_entry(entry).can_handle_deleted_files():
                 ctx.tag_reason_if_failed(False, entry, scan, R.no_delete_support)
                 continue
             deleted_ratio = deleted_bytes / max(1, entry.source_files_size())

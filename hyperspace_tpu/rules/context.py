@@ -16,6 +16,9 @@ class RuleContext:
     def __init__(self, session, analysis_enabled: bool = False):
         self.session = session
         self.analysis_enabled = analysis_enabled
+        # per-query scratch for rules that cache expensive work across the
+        # optimizer's repeated visits (e.g. data-skipping prune results)
+        self.scratch = {}
 
     def tag_reason_if_failed(
         self, passed: bool, entry: IndexLogEntry, plan: LogicalPlan, reason_fn

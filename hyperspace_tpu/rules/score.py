@@ -13,10 +13,11 @@ from typing import Dict, Tuple
 
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.rules.context import RuleContext
+from hyperspace_tpu.rules.dataskipping_rule import apply_data_skipping_rule
 from hyperspace_tpu.rules.filter_rule import apply_filter_index_rule
 from hyperspace_tpu.rules.join_rule import apply_join_index_rule
 
-RULES = (apply_filter_index_rule, apply_join_index_rule)
+RULES = (apply_filter_index_rule, apply_join_index_rule, apply_data_skipping_rule)
 
 
 class ScoreBasedIndexPlanOptimizer:

@@ -1,0 +1,339 @@
+"""DataSkippingIndexRule tests — the query-side pruning rule the reference
+never finished (its rule list is Filter/Join/NoOp only; ref:
+HS/index/rules/ScoreBasedIndexPlanOptimizer.scala:30, groundwork in
+HS/index/dataskipping/util/extractors.scala:42-199).
+
+Pruning must never change results: every test checks results with hyperspace
+on == off, plus which files the plan scans.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.indexes.dataskipping import (
+    BloomFilterSketch,
+    DataSkippingIndexConfig,
+    MinMaxSketch,
+    PartitionSketch,
+    ValueListSketch,
+)
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import col
+
+
+def scanned_files(plan):
+    files = []
+    for node in L.collect(plan, lambda p: True):
+        if isinstance(node, (L.FileScan, L.IndexScan)):
+            files.extend(node.files)
+        elif isinstance(node, L.Scan):
+            files.extend(fi.name for fi in node.relation.all_file_infos())
+    return files
+
+
+def sort_batch(batch):
+    order = np.lexsort(
+        [np.asarray(v).astype("U64") if v.dtype == object else v for v in reversed(list(batch.values()))]
+    )
+    return {k: v[order] for k, v in batch.items()}
+
+
+def assert_batches_equal(a, b):
+    assert sorted(a.keys()) == sorted(b.keys())
+    a, b = sort_batch(a), sort_batch(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"column {k}")
+
+
+@pytest.fixture()
+def ranged_parquet(tmp_path):
+    """4 files with disjoint ranges of k: [0,100), [100,200), [200,300), [300,400)."""
+    root = tmp_path / "ranged"
+    root.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        n = 250
+        t = pa.table(
+            {
+                "k": (i * 100 + rng.integers(0, 100, n)).astype(np.int64),
+                "v": rng.standard_normal(n),
+                "tag": np.array([f"file{i}_val{j % 5}" for j in range(n)]),
+            }
+        )
+        pq.write_table(t, root / f"part-{i:05d}.parquet")
+    return str(root)
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+class TestMinMaxPruning:
+    def test_range_filter_prunes_files(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsMinMax", MinMaxSketch("k")))
+        q = df.filter(col("k") < 150).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1, plan.pretty()
+        assert len(fscans[0].files) == 2  # files 0 and 1 overlap k<150
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_equality_filter_prunes_to_one_file(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsEq", MinMaxSketch("k")))
+        session.enable_hyperspace()
+        q = df.filter(col("k") == 250).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+        session.disable_hyperspace()
+        assert_batches_equal(q.collect(), q.collect())
+
+    def test_conjunction_intersects_masks(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsAnd", MinMaxSketch("k")))
+        session.enable_hyperspace()
+        q = df.filter((col("k") >= 120) & (col("k") < 180)).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans[0].files) == 1  # only file 1 ([100,200))
+        baseline_sess_off = None
+        session.disable_hyperspace()
+        baseline_sess_off = q.collect()
+        session.enable_hyperspace()
+        assert_batches_equal(q.collect(), baseline_sess_off)
+
+    def test_unprunable_or_keeps_plan(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsOr", MinMaxSketch("k")))
+        session.enable_hyperspace()
+        # v has no sketch -> OR side unprunable -> no rewrite at all
+        q = df.filter((col("k") < 150) | (col("v") > 0)).select("v")
+        plan = q.optimized_plan()
+        assert not any(isinstance(p, L.FileScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+
+    def test_isin_unions_masks(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsIn", MinMaxSketch("k")))
+        session.enable_hyperspace()
+        q = df.filter(col("k").isin(50, 350)).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans[0].files) == 2  # files 0 and 3
+        session.disable_hyperspace()
+        assert_batches_equal(q.collect(), q.collect())
+
+
+class TestOtherSketches:
+    def test_value_list_equality(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsVL", ValueListSketch("tag")))
+        session.enable_hyperspace()
+        q = df.filter(col("tag") == "file2_val3").select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+        session.disable_hyperspace()
+        assert_batches_equal(q.collect(), q.collect())
+
+    def test_bloom_filter_equality(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(
+            df, DataSkippingIndexConfig("dsBloom", BloomFilterSketch("tag", 0.001, 2000))
+        )
+        session.enable_hyperspace()
+        q = df.filter(col("tag") == "file1_val0").select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1
+        assert len(fscans[0].files) <= 2  # exact with fpp=0.001, allow 1 false positive
+        assert any("part-00001" in f for f in fscans[0].files)
+        session.disable_hyperspace()
+        assert_batches_equal(q.collect(), q.collect())
+
+    def test_combined_sketches_and_ranking(self, session, hs, ranged_parquet):
+        # MinMax on k AND Bloom on tag in one index; both conjuncts prune
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(
+            df,
+            DataSkippingIndexConfig(
+                "dsBoth", MinMaxSketch("k"), BloomFilterSketch("tag", 0.001, 2000)
+            ),
+        )
+        session.enable_hyperspace()
+        q = df.filter((col("k") < 150) & (col("tag") == "file0_val1")).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+
+    def test_partition_sketch(self, session, hs, tmp_path):
+        root = tmp_path / "parts"
+        root.mkdir()
+        for i, region in enumerate(["east", "west", "north"]):
+            t = pa.table(
+                {
+                    "region": np.array([region] * 100),
+                    "v": np.arange(100, dtype=np.int64),
+                }
+            )
+            pq.write_table(t, root / f"part-{i:05d}.parquet")
+        df = session.read_parquet(str(root))
+        hs.create_index(df, DataSkippingIndexConfig("dsPart", PartitionSketch("region")))
+        session.enable_hyperspace()
+        q = df.filter(col("region") == "west").select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+        session.disable_hyperspace()
+        assert_batches_equal(q.collect(), q.collect())
+
+
+class TestInteractionWithCoveringIndex:
+    def test_covering_index_outranks_data_skipping(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsLow", MinMaxSketch("k")))
+        hs.create_index(df, hst.CoveringIndexConfig("ciHigh", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(col("k") < 150).select("v")
+        plan = q.optimized_plan()
+        kinds = [type(p).__name__ for p in L.collect(plan, lambda p: True)]
+        assert "IndexScan" in kinds and "FileScan" not in kinds, plan.pretty()
+
+    def test_data_skipping_applies_when_covering_cannot(self, session, hs, ranged_parquet):
+        # covering index lacks column v in output -> only data skipping fits
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsOnly", MinMaxSketch("k")))
+        hs.create_index(df, hst.CoveringIndexConfig("ciNarrow", ["k"], ["tag"]))
+        session.enable_hyperspace()
+        q = df.filter(col("k") < 150).select("v")
+        plan = q.optimized_plan()
+        kinds = [type(p).__name__ for p in L.collect(plan, lambda p: True)]
+        assert "FileScan" in kinds and "IndexScan" not in kinds, plan.pretty()
+
+
+class TestDtypeSafety:
+    def test_bloom_int_literal_on_float_column_does_not_misprune(self, session, hs, tmp_path):
+        # build hashes float64 bit patterns; querying x = 5 (int) must coerce
+        # to 5.0 before the membership test, not silently prune the file
+        root = tmp_path / "floats"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"x": np.array([5.0, 7.5, 9.25]), "v": np.arange(3, dtype=np.int64)}),
+            root / "p0.parquet",
+        )
+        pq.write_table(
+            pa.table({"x": np.array([100.0, 200.0]), "v": np.arange(2, dtype=np.int64)}),
+            root / "p1.parquet",
+        )
+        df = session.read_parquet(str(root))
+        hs.create_index(df, DataSkippingIndexConfig("dsFloat", BloomFilterSketch("x", 0.001, 100)))
+        session.enable_hyperspace()
+        q = df.filter(col("x") == 5).select("v")
+        session.disable_hyperspace()
+        baseline = q.collect()
+        session.enable_hyperspace()
+        out = q.collect()
+        assert_batches_equal(out, baseline)
+        assert len(out["v"]) == 1
+
+    def test_incomparable_literal_does_not_break_other_rewrites(self, session, hs, ranged_parquet):
+        # float column vs string literal: the sketch evaluator must treat it
+        # as unprunable — not raise and cancel the covering-index rewrite
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsSafe", MinMaxSketch("v")))
+        hs.create_index(df, hst.CoveringIndexConfig("ciSafe", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter((col("k") == 5) & (col("v") != "not_a_number")).select("v")
+        plan = q.optimized_plan()
+        kinds = [type(p).__name__ for p in L.collect(plan, lambda p: True)]
+        assert "IndexScan" in kinds, plan.pretty()
+
+    def test_schema_filter_checks_sketch_columns(self, session, hs, ranged_parquet, tmp_path):
+        # a DS index is not a candidate for a relation lacking its sketched column
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsCol", MinMaxSketch("k")))
+        other = tmp_path / "other"
+        other.mkdir()
+        pq.write_table(pa.table({"z": np.arange(10, dtype=np.int64)}), other / "p.parquet")
+        odf = session.read_parquet(str(other))
+        session.enable_hyperspace()
+        plan = odf.filter(col("z") < 5).select("z").optimized_plan()
+        assert not any(isinstance(p, L.FileScan) for p in L.collect(plan, lambda p: True))
+
+
+class TestHybridAndRefresh:
+    def test_deleted_file_does_not_disqualify_ds_index(self, session, hs, ranged_parquet):
+        import os
+
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsDel", MinMaxSketch("k")))
+        os.remove(os.path.join(ranged_parquet, "part-00003.parquet"))
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_DELETED_RATIO, 0.9)
+        df2 = session.read_parquet(ranged_parquet)
+        q = df2.filter(col("k") < 150).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        # DS index has no lineage column but handles deletes naturally
+        assert len(fscans) == 1 and len(fscans[0].files) == 2, plan.pretty()
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_appended_files_kept_under_hybrid_scan(self, session, hs, ranged_parquet):
+        import os
+
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsApp", MinMaxSketch("k")))
+        # append a file with k in [0, 100) — unknown to the sketch table
+        rng = np.random.default_rng(9)
+        t = pa.table(
+            {
+                "k": rng.integers(0, 100, 50).astype(np.int64),
+                "v": rng.standard_normal(50),
+                "tag": np.array(["appended"] * 50),
+            }
+        )
+        pq.write_table(t, os.path.join(ranged_parquet, "part-00099.parquet"))
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.9)
+        df2 = session.read_parquet(ranged_parquet)
+        q = df2.filter(col("k") > 320).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1
+        # file 3 (range) + appended file are kept; 0..2 pruned
+        assert len(fscans[0].files) == 2
+        assert any("part-00099" in f for f in fscans[0].files)
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_refresh_full_rebuilds_sketches(self, session, hs, ranged_parquet):
+        import os
+
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsRef", MinMaxSketch("k")))
+        t = pa.table(
+            {
+                "k": np.full(50, 1000, dtype=np.int64),
+                "v": np.zeros(50),
+                "tag": np.array(["new"] * 50),
+            }
+        )
+        pq.write_table(t, os.path.join(ranged_parquet, "part-00050.parquet"))
+        hs.refresh_index("dsRef", "full")
+        session.enable_hyperspace()
+        df2 = session.read_parquet(ranged_parquet)
+        q = df2.filter(col("k") == 1000).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+        assert len(q.collect()["v"]) == 50
