@@ -148,11 +148,18 @@ class RefreshIncrementalAction(_RefreshActionBase):
 
     def validate(self) -> None:
         super().validate()
-        if self._deleted and not self._entry.has_lineage_column():
-            raise HyperspaceActionException(
-                "Index refresh (incremental) is only supported for deleted files "
-                "when lineage is enabled; use refresh mode 'full' instead."
-            )
+        if self._deleted:
+            # kind-polymorphic, matching the query-path candidate gate: a
+            # covering index needs lineage to drop deleted files' rows; other
+            # kinds (data-skipping) handle deletes by rebuilding over current
+            # data in op()
+            from hyperspace_tpu.indexes import registry
+
+            if not registry.index_of_entry(self._entry).can_handle_deleted_files():
+                raise HyperspaceActionException(
+                    "Index refresh (incremental) is only supported for deleted files "
+                    "when lineage is enabled; use refresh mode 'full' instead."
+                )
 
     def op(self) -> None:
         import numpy as np

@@ -55,7 +55,8 @@ DEFAULTS: Dict[str, Any] = {
     keys.OPTIMIZE_FILE_SIZE_THRESHOLD: 256 * 1024 * 1024,
     keys.SOURCE_BUILDERS: (
         "hyperspace_tpu.sources.default.DefaultFileBasedSourceBuilder,"
-        "hyperspace_tpu.sources.delta.DeltaLakeSourceBuilder"
+        "hyperspace_tpu.sources.delta.DeltaLakeSourceBuilder,"
+        "hyperspace_tpu.sources.iceberg.IcebergSourceBuilder"
     ),
     keys.GLOBBING_PATTERN: None,
     keys.DATASKIPPING_TARGET_FILE_SIZE: 256 * 1024 * 1024,

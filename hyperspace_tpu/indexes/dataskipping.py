@@ -125,6 +125,22 @@ class BloomFilterSketch(Sketch):
     def output_names(self) -> List[str]:
         return [f"BloomFilter_{self.expr}__bits"]
 
+    @staticmethod
+    def _canonicalize(values: np.ndarray) -> tuple:
+        """Hashing is dtype-sensitive, and the same column can surface with
+        different numpy dtypes per file (int64 vs float64 when one file holds
+        a null, varying '<U{n}' widths). Canonicalize before hashing so every
+        file — and every query literal — hashes identically:
+        numerics → float64 (precision loss maps build and query the same way,
+        so it can only add false *positives*, which are safe), datetimes →
+        datetime64[ns], strings → object."""
+        kind = values.dtype.kind
+        if kind in ("i", "u", "b", "f"):
+            return values.astype(np.float64), "float64"
+        if kind == "M":
+            return values.astype("datetime64[ns]"), "datetime64[ns]"
+        return values.astype(object), "object"
+
     def _positions(self, values: np.ndarray) -> np.ndarray:
         from hyperspace_tpu.ops.encode import hash_input_uint32
 
@@ -134,7 +150,8 @@ class BloomFilterSketch(Sketch):
         return ((h1[:, None] + ks[None, :] * h2[:, None]) % np.uint64(self.num_bits)).astype(np.int64)
 
     def aggregate(self, values: np.ndarray) -> List[Any]:
-        self.value_dtype = str(values.dtype)
+        values, dtype = self._canonicalize(values)
+        self.value_dtype = dtype
         bits = np.zeros(self.num_bits // 64, dtype=np.uint64)
         pos = self._positions(values).reshape(-1)
         np.bitwise_or.at(bits, pos // 64, np.uint64(1) << (pos % np.uint64(64)).astype(np.uint64))
@@ -143,9 +160,12 @@ class BloomFilterSketch(Sketch):
     def might_contain(self, bits_words: List[int], value) -> bool:
         """Raises on a literal that cannot be coerced to the build dtype —
         callers treat that as unprunable."""
-        arr = np.asarray([value])
-        if self.value_dtype is not None and self.value_dtype != "object":
-            arr = arr.astype(np.dtype(self.value_dtype))
+        if self.value_dtype == "object":
+            arr = np.asarray([str(value)], dtype=object)
+        elif self.value_dtype == "datetime64[ns]":
+            arr = np.asarray([np.datetime64(value)]).astype("datetime64[ns]")
+        else:
+            arr = np.asarray([value]).astype(np.float64)
         bits = np.asarray(bits_words, dtype=np.int64).view(np.uint64)
         pos = self._positions(arr).reshape(-1)
         return bool(np.all((bits[pos // 64] >> (pos % np.uint64(64)).astype(np.uint64)) & np.uint64(1)))

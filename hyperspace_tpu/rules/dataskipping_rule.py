@@ -288,7 +288,13 @@ def apply_data_skipping_rule(
         if cache_key in ctx.scratch:
             pruned = ctx.scratch[cache_key]
         else:
-            pruned = prune_files(entry, condition, scan.relation.all_file_infos())
+            # missing/corrupt sketch data means "this entry can't prune" —
+            # never an exception reaching ApplyHyperspace, which would cancel
+            # unrelated rewrites for the whole query
+            try:
+                pruned = prune_files(entry, condition, scan.relation.all_file_infos())
+            except Exception:
+                pruned = None
             ctx.scratch[cache_key] = pruned
         if pruned is None:
             ctx.tag_reason_if_failed(
@@ -323,4 +329,10 @@ def apply_data_skipping_rule(
         new_plan = L.Project(project_cols, new_plan)
 
     fraction_pruned = 1.0 - surviving_bytes / max(1, total_bytes)
-    return new_plan, max(1, int(40 * fraction_pruned))
+    score = max(1, int(40 * fraction_pruned))
+    # the optimizer keeps the NoOp-children path on score ties; the Project-
+    # node rewrite must strictly beat the Filter-node rewrite it contains so
+    # its column narrowing (read only predicate+projection columns) wins
+    if project_cols is not None and len(needed) < len(scan.output_columns):
+        score += 1
+    return new_plan, score

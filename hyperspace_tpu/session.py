@@ -52,6 +52,13 @@ class Session:
 
         return DataFrame(Scan(DeltaLakeRelation(path, version=version)), self)
 
+    def read_iceberg(self, path, snapshot_id: Optional[int] = None) -> "DataFrame":  # noqa: F821
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.logical import Scan
+        from hyperspace_tpu.sources.iceberg import IcebergRelation
+
+        return DataFrame(Scan(IcebergRelation(path, snapshot_id=snapshot_id)), self)
+
     # --- hyperspace toggle (ref: HS/package.scala:36-43) -------------------
     def enable_hyperspace(self) -> "Session":
         self.hyperspace_enabled = True

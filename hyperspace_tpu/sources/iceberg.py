@@ -1,0 +1,347 @@
+"""Apache Iceberg source provider.
+
+Reads the Iceberg table format natively — no Spark, no iceberg-core:
+``metadata/v<N>.metadata.json`` (+ ``version-hint.text``) → snapshots →
+manifest-list Avro → manifest Avro → live data files. The Avro codec is the
+framework's own (utils/avro.py), schema-driven, so manifests written by real
+engines parse.
+
+Parity with the reference Iceberg source
+(ref: HS/index/sources/iceberg/IcebergRelation.scala:65-67 signature =
+snapshotId + location; :72-74 files via table.newScan().planFiles();
+IcebergFileBasedSource.scala derived hasParquetAsSourceFormat=true), plus
+snapshot time travel via the ``snapshotId`` option.
+
+Also ships a minimal writer (``write_iceberg_table``) so tests and local
+pipelines can produce real Iceberg tables (v1 layout, Avro manifests).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.models.log_entry import Content, FileInfo, IndexLogEntry, Relation, Storage
+from hyperspace_tpu.sources import schema as schema_codec
+from hyperspace_tpu.sources.interfaces import (
+    FileBasedRelation,
+    FileBasedRelationMetadata,
+    FileBasedSourceProvider,
+)
+from hyperspace_tpu.utils import avro
+from hyperspace_tpu.utils.hashing import md5_hex
+
+METADATA_DIR = "metadata"
+VERSION_HINT = "version-hint.text"
+
+
+def _metadata_dir(root: str) -> str:
+    return os.path.join(root, METADATA_DIR)
+
+
+def _resolve_path(root: str, path: str) -> str:
+    """Manifest/data paths may be absolute, file:// URIs, or table-relative."""
+    if path.startswith("file://"):
+        return path[len("file://"):]
+    if os.path.isabs(path):
+        return path
+    return os.path.join(root, path)
+
+
+def current_metadata_path(root: str) -> Optional[str]:
+    md = _metadata_dir(root)
+    hint = os.path.join(md, VERSION_HINT)
+    if os.path.exists(hint):
+        with open(hint) as f:
+            v = f.read().strip()
+        cand = os.path.join(md, f"v{v}.metadata.json")
+        if os.path.exists(cand):
+            return cand
+    try:
+        versions = sorted(
+            (n for n in os.listdir(md) if n.endswith(".metadata.json")),
+            key=lambda n: os.path.getmtime(os.path.join(md, n)),
+        )
+    except OSError:
+        return None
+    return os.path.join(md, versions[-1]) if versions else None
+
+
+def load_table_metadata(root: str) -> Dict[str, Any]:
+    path = current_metadata_path(root)
+    if path is None:
+        raise FileNotFoundError(f"No Iceberg table found at {root!r} (missing {METADATA_DIR}/)")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _snapshot(meta: Dict[str, Any], snapshot_id: Optional[int]) -> Dict[str, Any]:
+    snaps = meta.get("snapshots", [])
+    if not snaps:
+        raise FileNotFoundError("Iceberg table has no snapshots")
+    if snapshot_id is None:
+        current = meta.get("current-snapshot-id")
+        for s in snaps:
+            if s["snapshot-id"] == current:
+                return s
+        return snaps[-1]
+    for s in snaps:
+        if s["snapshot-id"] == snapshot_id:
+            return s
+    raise ValueError(f"Snapshot {snapshot_id} not found")
+
+
+def plan_files(root: str, snapshot: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Live data files of a snapshot: manifest-list → manifests → entries with
+    status != DELETED (2) (the reference delegates this walk to
+    table.newScan().planFiles(); ref: IcebergRelation.scala:72-74)."""
+    out: List[Dict[str, Any]] = []
+    manifest_list = _resolve_path(root, snapshot["manifest-list"])
+    _, manifests = avro.read_container(manifest_list)
+    for m in manifests:
+        manifest_path = _resolve_path(root, m["manifest_path"])
+        _, entries = avro.read_container(manifest_path)
+        for e in entries:
+            if e.get("status") == 2:  # DELETED
+                continue
+            df = e.get("data_file") or {}
+            if df.get("file_path"):
+                out.append(df)
+    return out
+
+
+class IcebergRelation(FileBasedRelation):
+    def __init__(self, root: str, snapshot_id: Optional[int] = None):
+        self._root = os.path.abspath(root)
+        self._meta = load_table_metadata(self._root)
+        self._snap = _snapshot(self._meta, snapshot_id)
+        self._data_files = plan_files(self._root, self._snap)
+        if not self._data_files:
+            raise FileNotFoundError(f"Iceberg table at {root!r} has no data files in snapshot {self._snap['snapshot-id']}")
+        self._schema: Optional[pa.Schema] = None
+
+    @property
+    def name(self) -> str:
+        return self._root
+
+    @property
+    def snapshot_id(self) -> int:
+        return int(self._snap["snapshot-id"])
+
+    @property
+    def schema(self) -> pa.Schema:
+        if self._schema is None:
+            self._schema = self.arrow_dataset().schema
+        return self._schema
+
+    @property
+    def root_paths(self) -> List[str]:
+        return [self._root]
+
+    @property
+    def file_format(self) -> str:
+        return "iceberg"
+
+    @property
+    def options(self) -> Dict[str, str]:
+        return {"snapshotId": str(self.snapshot_id)}
+
+    def _abs_files(self) -> List[str]:
+        return sorted(_resolve_path(self._root, df["file_path"]) for df in self._data_files)
+
+    def arrow_dataset(self, files: Optional[List[str]] = None) -> pads.Dataset:
+        return pads.dataset(files if files is not None else self._abs_files(), format="parquet")
+
+    def all_file_infos(self) -> List[FileInfo]:
+        out = []
+        for df in sorted(self._data_files, key=lambda d: d["file_path"]):
+            path = _resolve_path(self._root, df["file_path"])
+            size = int(df.get("file_size_in_bytes") or 0)
+            if size == 0 and os.path.exists(path):
+                size = os.stat(path).st_size
+            mtime = int(os.stat(path).st_mtime_ns) if os.path.exists(path) else 0
+            out.append(FileInfo(path, size, mtime))
+        return out
+
+    def signature(self) -> str:
+        """Iceberg signature = snapshot id + table location
+        (ref: IcebergRelation.scala:65-67)."""
+        return md5_hex(f"iceberg:{self._root}:{self.snapshot_id}")
+
+    def has_parquet_as_source_format(self) -> bool:
+        return True  # (ref: IcebergFileBasedSource derived property)
+
+    def create_relation_metadata(self, file_id_tracker) -> Relation:
+        infos = self.all_file_infos()
+        if file_id_tracker is not None:
+            file_id_tracker.add_files(infos)
+        return Relation(
+            root_paths=self.root_paths,
+            data=Storage(Content.from_leaf_files(infos)),
+            schema_json=schema_codec.schema_to_json(self.schema),
+            file_format="iceberg",
+            options=self.options,
+        )
+
+
+class IcebergRelationMetadata(FileBasedRelationMetadata):
+    def refresh(self) -> Relation:
+        return self.to_relation_object().create_relation_metadata(None)
+
+    def to_relation_object(self) -> IcebergRelation:
+        return IcebergRelation(self.relation.root_paths[0])  # current snapshot
+
+    def internal_file_format_name(self) -> str:
+        return "parquet"
+
+    def enrich_index_properties(self, properties: Dict[str, str]) -> Dict[str, str]:
+        return properties
+
+
+class IcebergFileBasedSource(FileBasedSourceProvider):
+    def create_relation(self, path_or_plan, session) -> Optional[FileBasedRelation]:
+        if isinstance(path_or_plan, IcebergRelation):
+            return path_or_plan
+        if isinstance(path_or_plan, tuple):
+            paths, fmt, options = path_or_plan
+            if fmt == "iceberg":
+                sid = options.get("snapshotId")
+                return IcebergRelation(list(paths)[0], None if sid is None else int(sid))
+        return None
+
+    def create_relation_metadata(self, relation: Relation, session) -> Optional[FileBasedRelationMetadata]:
+        if relation.file_format == "iceberg":
+            return IcebergRelationMetadata(relation)
+        return None
+
+
+class IcebergSourceBuilder:
+    def build(self, session) -> FileBasedSourceProvider:
+        return IcebergFileBasedSource()
+
+
+# --------------------------------------------------------------------------
+# minimal writer (tests / local pipelines) — v1 table layout, Avro manifests
+# --------------------------------------------------------------------------
+
+_MANIFEST_ENTRY_SCHEMA = {
+    "type": "record",
+    "name": "manifest_entry",
+    "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "snapshot_id", "type": ["null", "long"], "default": None},
+        {
+            "name": "data_file",
+            "type": {
+                "type": "record",
+                "name": "r2",
+                "fields": [
+                    {"name": "file_path", "type": "string"},
+                    {"name": "file_format", "type": "string"},
+                    {"name": "record_count", "type": "long"},
+                    {"name": "file_size_in_bytes", "type": "long"},
+                ],
+            },
+        },
+    ],
+}
+
+_MANIFEST_FILE_SCHEMA = {
+    "type": "record",
+    "name": "manifest_file",
+    "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+        {"name": "partition_spec_id", "type": "int"},
+        {"name": "added_snapshot_id", "type": ["null", "long"], "default": None},
+    ],
+}
+
+
+def write_iceberg_table(table: pa.Table, root: str, mode: str = "append") -> int:
+    """Write one parquet data file + manifest + manifest list + a new
+    metadata.json snapshot. Returns the new snapshot id."""
+    root = os.path.abspath(root)
+    data_dir = os.path.join(root, "data")
+    md = _metadata_dir(root)
+    os.makedirs(data_dir, exist_ok=True)
+    os.makedirs(md, exist_ok=True)
+
+    prior_meta: Optional[Dict[str, Any]] = None
+    if current_metadata_path(root):
+        prior_meta = load_table_metadata(root)
+
+    snapshot_id = int(time.time() * 1000) * 1000 + len((prior_meta or {}).get("snapshots", []))
+    part = f"data/part-{uuid.uuid4().hex[:12]}.parquet"
+    abs_part = os.path.join(root, part)
+    pq.write_table(table, abs_part)
+    st = os.stat(abs_part)
+
+    manifest_name = f"manifest-{uuid.uuid4().hex[:12]}.avro"
+    manifest_path = os.path.join(md, manifest_name)
+    avro.write_container(
+        manifest_path,
+        _MANIFEST_ENTRY_SCHEMA,
+        [
+            {
+                "status": 1,  # ADDED
+                "snapshot_id": snapshot_id,
+                "data_file": {
+                    "file_path": part,
+                    "file_format": "PARQUET",
+                    "record_count": table.num_rows,
+                    "file_size_in_bytes": st.st_size,
+                },
+            }
+        ],
+    )
+
+    manifests = [
+        {
+            "manifest_path": os.path.join(METADATA_DIR, manifest_name),
+            "manifest_length": os.stat(manifest_path).st_size,
+            "partition_spec_id": 0,
+            "added_snapshot_id": snapshot_id,
+        }
+    ]
+    if mode == "append" and prior_meta is not None and prior_meta.get("snapshots"):
+        prev_snap = _snapshot(prior_meta, None)
+        prev_list = _resolve_path(root, prev_snap["manifest-list"])
+        _, prev_manifests = avro.read_container(prev_list)
+        manifests = prev_manifests + manifests
+
+    list_name = f"snap-{snapshot_id}-{uuid.uuid4().hex[:8]}.avro"
+    list_path = os.path.join(md, list_name)
+    avro.write_container(list_path, _MANIFEST_FILE_SCHEMA, manifests)
+
+    version = 1 if prior_meta is None else int(prior_meta.get("_version", 0)) + 1
+    snapshots = list((prior_meta or {}).get("snapshots", []))
+    snapshots.append(
+        {
+            "snapshot-id": snapshot_id,
+            "timestamp-ms": int(time.time() * 1000),
+            "manifest-list": os.path.join(METADATA_DIR, list_name),
+            "summary": {"operation": "append" if mode == "append" else "overwrite"},
+        }
+    )
+    meta = {
+        "format-version": 1,
+        "table-uuid": (prior_meta or {}).get("table-uuid", str(uuid.uuid4())),
+        "location": root,
+        "last-updated-ms": int(time.time() * 1000),
+        "current-snapshot-id": snapshot_id,
+        "snapshots": snapshots,
+        "_version": version,
+    }
+    with open(os.path.join(md, f"v{version}.metadata.json"), "w") as f:
+        json.dump(meta, f)
+    with open(os.path.join(md, VERSION_HINT), "w") as f:
+        f.write(str(version))
+    return snapshot_id

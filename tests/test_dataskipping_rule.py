@@ -268,7 +268,74 @@ class TestDtypeSafety:
         assert not any(isinstance(p, L.FileScan) for p in L.collect(plan, lambda p: True))
 
 
+class TestDtypeSafety2:
+    def test_bloom_consistent_across_files_with_mixed_dtypes(self, session, hs, tmp_path):
+        # an int64 column where one file holds a null surfaces as float64 for
+        # that file; hashing must be canonicalized so earlier files don't get
+        # mispruned by a coerced literal
+        root = tmp_path / "mixed"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"x": np.array([5, 6, 7], dtype=np.int64), "v": np.arange(3, dtype=np.int64)}),
+            root / "p0.parquet",
+        )
+        pq.write_table(
+            pa.table({"x": pa.array([100, None, 200], type=pa.int64()), "v": np.arange(3, dtype=np.int64)}),
+            root / "p1.parquet",
+        )
+        df = session.read_parquet(str(root))
+        hs.create_index(df, DataSkippingIndexConfig("dsMixed", BloomFilterSketch("x", 0.001, 100)))
+        session.enable_hyperspace()
+        q = df.filter(col("x") == 5).select("v")
+        session.disable_hyperspace()
+        baseline = q.collect()
+        session.enable_hyperspace()
+        out = q.collect()
+        assert_batches_equal(out, baseline)
+        assert len(out["v"]) == 1
+
+    def test_corrupt_sketch_data_does_not_break_other_rewrites(self, session, hs, ranged_parquet):
+        import os
+
+        df = session.read_parquet(ranged_parquet)
+        entry = hs.create_index(df, DataSkippingIndexConfig("dsCorrupt", MinMaxSketch("k")))
+        hs.create_index(df, hst.CoveringIndexConfig("ciAlive", ["k"], ["v"]))
+        for f in entry.content.files:
+            with open(f, "wb") as fh:
+                fh.write(b"not parquet")
+        session.enable_hyperspace()
+        q = df.filter(col("k") < 150).select("v")
+        plan = q.optimized_plan()
+        kinds = [type(p).__name__ for p in L.collect(plan, lambda p: True)]
+        assert "IndexScan" in kinds, plan.pretty()
+
+    def test_project_narrows_filescan_columns(self, session, hs, ranged_parquet):
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsNarrow", MinMaxSketch("k")))
+        session.enable_hyperspace()
+        q = df.filter(col("k") < 150).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1
+        assert sorted(fscans[0].columns) == ["k", "v"]  # tag not read
+
+
 class TestHybridAndRefresh:
+    def test_incremental_refresh_of_ds_index_with_deletes(self, session, hs, ranged_parquet):
+        import os
+
+        df = session.read_parquet(ranged_parquet)
+        hs.create_index(df, DataSkippingIndexConfig("dsIncDel", MinMaxSketch("k")))
+        os.remove(os.path.join(ranged_parquet, "part-00000.parquet"))
+        entry = hs.refresh_index("dsIncDel", "incremental")  # must not raise
+        assert entry.state == "ACTIVE"
+        session.enable_hyperspace()
+        df2 = session.read_parquet(ranged_parquet)
+        q = df2.filter(col("k") == 250).select("v")
+        plan = q.optimized_plan()
+        fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
+        assert len(fscans) == 1 and len(fscans[0].files) == 1
+
     def test_deleted_file_does_not_disqualify_ds_index(self, session, hs, ranged_parquet):
         import os
 

@@ -1,0 +1,180 @@
+"""Iceberg source provider tests
+(ref: src/test/scala/.../IcebergIntegrationTest.scala — index on an Iceberg
+table, snapshot-based signatures, hybrid scan over a new snapshot).
+
+Also covers the framework's own Avro container codec round-trip, since
+Iceberg manifests depend on it.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import col
+from hyperspace_tpu.sources.iceberg import IcebergRelation, write_iceberg_table
+from hyperspace_tpu.utils import avro
+
+
+def make_table(seed: int, n: int = 500) -> pa.Table:
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "k": rng.integers(0, 100, n).astype(np.int64),
+            "v": rng.standard_normal(n),
+        }
+    )
+
+
+@pytest.fixture()
+def iceberg_root(tmp_path):
+    root = str(tmp_path / "iceberg_tbl")
+    write_iceberg_table(make_table(1), root)
+    return root
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+class TestAvroCodec:
+    def test_round_trip_all_types(self, tmp_path):
+        schema = {
+            "type": "record",
+            "name": "t",
+            "fields": [
+                {"name": "s", "type": "string"},
+                {"name": "i", "type": "int"},
+                {"name": "l", "type": "long"},
+                {"name": "d", "type": "double"},
+                {"name": "b", "type": "boolean"},
+                {"name": "by", "type": "bytes"},
+                {"name": "opt", "type": ["null", "long"]},
+                {"name": "arr", "type": {"type": "array", "items": "long"}},
+                {"name": "m", "type": {"type": "map", "values": "string"}},
+                {
+                    "name": "nested",
+                    "type": {
+                        "type": "record",
+                        "name": "inner",
+                        "fields": [{"name": "x", "type": "long"}],
+                    },
+                },
+            ],
+        }
+        records = [
+            {
+                "s": "hello",
+                "i": -42,
+                "l": 1 << 40,
+                "d": 3.5,
+                "b": True,
+                "by": b"\x00\x01",
+                "opt": None,
+                "arr": [1, 2, 3],
+                "m": {"a": "x"},
+                "nested": {"x": 7},
+            },
+            {
+                "s": "",
+                "i": 0,
+                "l": -(1 << 40),
+                "d": -0.25,
+                "b": False,
+                "by": b"",
+                "opt": 5,
+                "arr": [],
+                "m": {},
+                "nested": {"x": -1},
+            },
+        ]
+        path = str(tmp_path / "t.avro")
+        avro.write_container(path, schema, records)
+        rschema, rrecords = avro.read_container(path)
+        assert rschema == schema
+        assert rrecords == records
+
+    def test_zigzag_varint_edge_values(self, tmp_path):
+        schema = {"type": "record", "name": "t", "fields": [{"name": "x", "type": "long"}]}
+        values = [0, -1, 1, 63, 64, -64, -65, (1 << 62), -(1 << 62)]
+        path = str(tmp_path / "z.avro")
+        avro.write_container(path, schema, [{"x": v} for v in values])
+        _, records = avro.read_container(path)
+        assert [r["x"] for r in records] == values
+
+
+class TestIcebergRelation:
+    def test_read_and_snapshots(self, session, iceberg_root):
+        df = session.read_iceberg(iceberg_root)
+        out = df.collect()
+        assert len(out["k"]) == 500
+        rel = df.plan.relation
+        assert isinstance(rel, IcebergRelation)
+        assert rel.has_parquet_as_source_format()
+        sig1 = rel.signature()
+
+        write_iceberg_table(make_table(2), iceberg_root)
+        rel2 = session.read_iceberg(iceberg_root).plan.relation
+        assert rel2.signature() != sig1  # snapshot id changed
+        assert len(session.read_iceberg(iceberg_root).collect()["k"]) == 1000
+
+    def test_snapshot_time_travel(self, session, iceberg_root):
+        first_rel = session.read_iceberg(iceberg_root).plan.relation
+        first_snap = first_rel.snapshot_id
+        write_iceberg_table(make_table(2), iceberg_root)
+        old_df = session.read_iceberg(iceberg_root, snapshot_id=first_snap)
+        assert len(old_df.collect()["k"]) == 500
+        assert old_df.plan.relation.signature() == first_rel.signature()
+
+    def test_index_on_iceberg_and_query(self, session, hs, iceberg_root):
+        df = session.read_iceberg(iceberg_root)
+        hs.create_index(df, hst.CoveringIndexConfig("iceIdx", ["k"], ["v"]))
+        q = df.filter(col("k") == 7).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        out = q.collect()
+        np.testing.assert_allclose(np.sort(out["v"]), np.sort(baseline["v"]))
+
+    def test_new_snapshot_invalidates_index(self, session, hs, iceberg_root):
+        df = session.read_iceberg(iceberg_root)
+        hs.create_index(df, hst.CoveringIndexConfig("iceStale", ["k"], ["v"]))
+        write_iceberg_table(make_table(2), iceberg_root)
+        session.enable_hyperspace()
+        df2 = session.read_iceberg(iceberg_root)
+        plan = df2.filter(col("k") == 7).select("v").optimized_plan()
+        assert not any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True))
+
+    def test_hybrid_scan_over_new_snapshot(self, session, hs, iceberg_root):
+        df = session.read_iceberg(iceberg_root)
+        hs.create_index(df, hst.CoveringIndexConfig("iceHybrid", ["k"], ["v"]))
+        write_iceberg_table(make_table(2), iceberg_root)
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.9)
+        df2 = session.read_iceberg(iceberg_root)
+        q = df2.filter(col("k") == 7).select("v")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.BucketUnion) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        out = q.collect()
+        np.testing.assert_allclose(np.sort(out["v"]), np.sort(baseline["v"]))
+
+    def test_refresh_incremental_on_iceberg(self, session, hs, iceberg_root):
+        df = session.read_iceberg(iceberg_root)
+        hs.create_index(df, hst.CoveringIndexConfig("iceRef", ["k"], ["v"]))
+        write_iceberg_table(make_table(3), iceberg_root)
+        entry = hs.refresh_index("iceRef", "incremental")
+        assert entry.state == "ACTIVE"
+        session.enable_hyperspace()
+        df2 = session.read_iceberg(iceberg_root)
+        q = df2.filter(col("k") == 7).select("v")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        session.disable_hyperspace()
+        baseline = q.collect()
+        session.enable_hyperspace()
+        np.testing.assert_allclose(np.sort(q.collect()["v"]), np.sort(baseline["v"]))
