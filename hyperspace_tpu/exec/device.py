@@ -368,8 +368,6 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str]
     refresh merges delta files into existing buckets, UpdateMode.Merge —
     ref: actions/RefreshIncrementalAction.scala:115-128) is only piecewise
     sorted after concatenation."""
-    import pyarrow.dataset as pads
-
     from hyperspace_tpu.indexes.covering import bucket_of_file
 
     per_bucket: Dict[int, List[str]] = {}
@@ -378,10 +376,11 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str]
         if b is None:
             raise DeviceUnsupported(f"index file {f!r} has no bucket id")
         per_bucket.setdefault(b, []).append(f)
+    from hyperspace_tpu.exec.io import read_parquet_batch
+
     out: Dict[int, B.Batch] = {}
     for b, files in per_bucket.items():
-        t = pads.dataset(files, format="parquet").to_table(columns=columns)
-        batch = B.table_to_batch(t)
+        batch = read_parquet_batch(files, columns)
         if sort_key is not None and len(files) > 1:
             k = batch[sort_key]
             if k.size > 1 and np.any(k[1:] < k[:-1]):

@@ -33,14 +33,20 @@ def _plan_needs_file_names(plan: L.LogicalPlan) -> bool:
 
 
 def _read_files(files: List[str], file_format: str, columns: Optional[List[str]], with_file_names: bool) -> B.Batch:
+    from hyperspace_tpu.exec.io import read_parquet_batch
+
     if with_file_names:
         batches = []
         for f in files:
-            t = pads.dataset([f], format=file_format).to_table(columns=columns)
-            b = B.table_to_batch(t)
-            b[INPUT_FILE_NAME] = np.full(t.num_rows, f, dtype=object)
+            if file_format == "parquet":
+                b = read_parquet_batch([f], columns)
+            else:
+                b = B.table_to_batch(pads.dataset([f], format=file_format).to_table(columns=columns))
+            b[INPUT_FILE_NAME] = np.full(B.num_rows(b), f, dtype=object)
             batches.append(b)
         return B.concat(batches)
+    if file_format == "parquet":
+        return read_parquet_batch(list(files), columns)
     t = pads.dataset(files, format=file_format).to_table(columns=columns)
     return B.table_to_batch(t)
 

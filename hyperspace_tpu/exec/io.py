@@ -1,0 +1,92 @@
+"""Scan-side IO: parquet files -> columnar batches.
+
+Index files (written uncompressed PLAIN/dictionary by the bucketed writer —
+indexes/covering.py) decode through the native C++ path
+(hyperspace_tpu.native): mmap -> column-chunk decode straight into numpy
+buffers, no JVM and no pyarrow table materialization in the hot loop
+(SURVEY.md §7 design stance (c)). Files outside the native dialect
+(compressed, nested, unsupported encodings) fall back to pyarrow per file.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+
+from hyperspace_tpu.exec import batch as B
+
+
+def _dtype_hints(schema: pa.Schema, columns: List[str]) -> Optional[Dict[str, np.dtype]]:
+    """Numpy dtypes for native INT64-backed logical types (timestamps/dates).
+
+    Returns None when any requested column's arrow type has no faithful
+    numpy/native mapping (decimal, nested, ...) — the caller then uses pyarrow.
+    """
+    hints: Dict[str, np.dtype] = {}
+    for c in columns:
+        t = schema.field(c).type
+        if pa.types.is_timestamp(t):
+            hints[c] = np.dtype(f"datetime64[{t.unit}]")
+        elif (
+            pa.types.is_date(t)       # INT32-backed date: pyarrow keeps datetime64[D]
+            or pa.types.is_time(t)    # time32/time64 surface as datetime.time objects
+            or pa.types.is_duration(t)
+            or pa.types.is_decimal(t)
+            or pa.types.is_nested(t)
+            or pa.types.is_dictionary(t)
+        ):
+            return None
+    return hints
+
+
+def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batch:
+    """Read ``columns`` of ``files`` into one concatenated batch, native-first.
+
+    Schema-evolved datasets (a file missing a requested column, or differing
+    per-file schemas when ``columns`` is None) go through a single
+    dataset-level pyarrow read, which unifies schemas and null-fills — the
+    per-file native path requires every file to carry every column.
+    """
+    from hyperspace_tpu import native
+
+    def _dataset_read() -> B.Batch:
+        t = pads.dataset(files, format="parquet").to_table(columns=columns)
+        return B.table_to_batch(t)
+
+    # pre-scan schemas; any inconsistency -> unified dataset read
+    try:
+        schemas = [pq.read_schema(f) for f in files]
+    except OSError:
+        return _dataset_read()
+    if columns is None:
+        names0 = list(schemas[0].names)
+        if any(list(s.names) != names0 for s in schemas[1:]):
+            return _dataset_read()
+    else:
+        for s in schemas:
+            if any(c not in s.names for c in columns):
+                return _dataset_read()
+
+    batches: List[B.Batch] = []
+    for f, schema in zip(files, schemas):
+        got = None
+        try:
+            cols = list(columns) if columns is not None else list(schema.names)
+            hints = _dtype_hints(schema, cols)
+            if hints is not None:
+                got = native.read_columns(f, cols, hints)
+        except (native.NativeUnsupported, OSError, KeyError):
+            got = None
+        if got is None:  # preserve file order on fallback (bucket sortedness)
+            t = pads.dataset([f], format="parquet").to_table(columns=columns)
+            got = B.table_to_batch(t)
+        batches.append(got)
+    if not batches:
+        return _dataset_read()
+    if len(batches) == 1:
+        return batches[0]
+    return B.concat(batches)

@@ -207,7 +207,10 @@ def write_bucketed(table: pa.Table, bucket_sort_columns: List[str], num_buckets:
         if hi <= lo:
             continue
         path = os.path.join(out_dir, _bucket_file_name(b))
-        pq.write_table(permuted.slice(lo, hi - lo), path)
+        # uncompressed PLAIN is the index-file dialect: the native decoder
+        # (hyperspace_tpu/native) mmaps these and memcpys column chunks into
+        # device-feedable buffers with zero decompression work
+        pq.write_table(permuted.slice(lo, hi - lo), path, use_dictionary=False, compression="NONE")
         written.append(path)
     return written
 

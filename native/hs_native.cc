@@ -1,0 +1,754 @@
+// libhs_native — native Parquet column-chunk decoder.
+//
+// The TPU framework's one ground-up native component (SURVEY.md §7 design
+// stance: "a C++ Parquet column-chunk decode path into device-feedable
+// buffers"; the reference is 100% JVM and delegates scans to Spark executors,
+// SURVEY.md §0). Decodes flat Parquet columns — PLAIN or RLE_DICTIONARY
+// encoded, UNCOMPRESSED — from an mmap'd file straight into caller-allocated
+// buffers (numpy arrays on the Python side) with zero copies in between, so
+// index scans feed jax.device_put without pyarrow/JVM row pivoting.
+//
+// Scope is deliberately the framework's own index-file dialect (the bucketed
+// index writer emits uncompressed PLAIN/dictionary pages precisely so this
+// decoder stays simple and fast); anything outside it returns an error and the
+// Python caller falls back to pyarrow.
+//
+// Build: make -C native  (g++ -O3 -shared -fPIC)
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "thrift_compact.h"
+
+namespace hsn {
+
+// ---------------------------------------------------------------------------
+// parquet footer model (subset of parquet.thrift)
+// ---------------------------------------------------------------------------
+
+enum PhysicalType : int32_t {
+  T_BOOLEAN = 0,
+  T_INT32 = 1,
+  T_INT64 = 2,
+  T_INT96 = 3,
+  T_FLOAT = 4,
+  T_DOUBLE = 5,
+  T_BYTE_ARRAY = 6,
+  T_FIXED_LEN_BYTE_ARRAY = 7,
+};
+
+enum Encoding : int32_t {
+  E_PLAIN = 0,
+  E_PLAIN_DICTIONARY = 2,
+  E_RLE = 3,
+  E_RLE_DICTIONARY = 8,
+};
+
+enum PageType : int32_t {
+  P_DATA_PAGE = 0,
+  P_INDEX_PAGE = 1,
+  P_DICTIONARY_PAGE = 2,
+  P_DATA_PAGE_V2 = 3,
+};
+
+struct SchemaElement {
+  std::string name;
+  int32_t type = -1;             // PhysicalType; -1 for group nodes
+  int32_t repetition = 0;        // 0=REQUIRED 1=OPTIONAL 2=REPEATED
+  int32_t num_children = 0;
+  int32_t type_length = 0;
+};
+
+struct ColumnMeta {
+  int32_t type = -1;
+  std::vector<std::string> path;
+  int32_t codec = -1;            // 0 = UNCOMPRESSED
+  int64_t num_values = 0;
+  int64_t data_page_offset = -1;
+  int64_t dictionary_page_offset = -1;
+  int64_t total_compressed_size = 0;
+};
+
+struct RowGroup {
+  std::vector<ColumnMeta> columns;
+  int64_t num_rows = 0;
+};
+
+struct FileMeta {
+  int64_t num_rows = 0;
+  std::vector<SchemaElement> schema;
+  std::vector<RowGroup> row_groups;
+};
+
+static SchemaElement parse_schema_element(Reader& r) {
+  SchemaElement e;
+  int16_t last = 0;
+  Reader::FieldHeader f;
+  while (r.read_field(last, f)) {
+    switch (f.id) {
+      case 1: e.type = static_cast<int32_t>(r.zigzag()); break;
+      case 2: e.type_length = static_cast<int32_t>(r.zigzag()); break;
+      case 3: e.repetition = static_cast<int32_t>(r.zigzag()); break;
+      case 4: e.name = r.binary(); break;
+      case 5: e.num_children = static_cast<int32_t>(r.zigzag()); break;
+      default: r.skip(f.type);
+    }
+  }
+  return e;
+}
+
+static ColumnMeta parse_column_meta(Reader& r) {
+  ColumnMeta m;
+  int16_t last = 0;
+  Reader::FieldHeader f;
+  while (r.read_field(last, f)) {
+    switch (f.id) {
+      case 1: m.type = static_cast<int32_t>(r.zigzag()); break;
+      case 3: {
+        auto lh = r.read_list();
+        for (uint32_t i = 0; i < lh.size; i++) m.path.push_back(r.binary());
+        break;
+      }
+      case 4: m.codec = static_cast<int32_t>(r.zigzag()); break;
+      case 5: m.num_values = r.zigzag(); break;
+      case 9: m.data_page_offset = r.zigzag(); break;
+      case 11: m.dictionary_page_offset = r.zigzag(); break;
+      case 7: m.total_compressed_size = r.zigzag(); break;
+      default: r.skip(f.type);
+    }
+  }
+  return m;
+}
+
+static RowGroup parse_row_group(Reader& r) {
+  RowGroup g;
+  int16_t last = 0;
+  Reader::FieldHeader f;
+  while (r.read_field(last, f)) {
+    switch (f.id) {
+      case 1: {  // columns: list<ColumnChunk>
+        auto lh = r.read_list();
+        for (uint32_t i = 0; i < lh.size; i++) {
+          // ColumnChunk struct
+          int16_t cl = 0;
+          Reader::FieldHeader cf;
+          ColumnMeta m;
+          bool have_meta = false;
+          while (r.read_field(cl, cf)) {
+            if (cf.id == 3 && cf.type == CType::STRUCT) {
+              m = parse_column_meta(r);
+              have_meta = true;
+            } else {
+              r.skip(cf.type);
+            }
+          }
+          if (!have_meta) throw ThriftError("column chunk without metadata");
+          g.columns.push_back(std::move(m));
+        }
+        break;
+      }
+      case 3: g.num_rows = r.zigzag(); break;
+      default: r.skip(f.type);
+    }
+  }
+  return g;
+}
+
+static FileMeta parse_file_meta(const uint8_t* buf, size_t len) {
+  Reader r(buf, len);
+  FileMeta fm;
+  int16_t last = 0;
+  Reader::FieldHeader f;
+  while (r.read_field(last, f)) {
+    switch (f.id) {
+      case 2: {
+        auto lh = r.read_list();
+        for (uint32_t i = 0; i < lh.size; i++) fm.schema.push_back(parse_schema_element(r));
+        break;
+      }
+      case 3: fm.num_rows = r.zigzag(); break;
+      case 4: {
+        auto lh = r.read_list();
+        for (uint32_t i = 0; i < lh.size; i++) fm.row_groups.push_back(parse_row_group(r));
+        break;
+      }
+      default: r.skip(f.type);
+    }
+  }
+  return fm;
+}
+
+// ---------------------------------------------------------------------------
+// page headers
+// ---------------------------------------------------------------------------
+
+struct PageHeader {
+  int32_t type = -1;
+  int32_t uncompressed_size = 0;
+  int32_t compressed_size = 0;
+  // v1
+  int32_t num_values = 0;
+  int32_t encoding = -1;
+  int32_t def_encoding = -1;
+  int32_t rep_encoding = -1;
+  // v2
+  int32_t num_nulls = 0;
+  int32_t num_rows = 0;
+  int32_t def_bytes = 0;
+  int32_t rep_bytes = 0;
+  // dictionary
+  int32_t dict_num_values = 0;
+  int32_t dict_encoding = -1;
+};
+
+// Parses the header and advances *pos past it.
+static PageHeader parse_page_header(const uint8_t* base, size_t file_len, size_t* pos) {
+  Reader r(base + *pos, file_len - *pos);
+  PageHeader h;
+  int16_t last = 0;
+  Reader::FieldHeader f;
+  while (r.read_field(last, f)) {
+    switch (f.id) {
+      case 1: h.type = static_cast<int32_t>(r.zigzag()); break;
+      case 2: h.uncompressed_size = static_cast<int32_t>(r.zigzag()); break;
+      case 3: h.compressed_size = static_cast<int32_t>(r.zigzag()); break;
+      case 5: {  // DataPageHeader
+        int16_t l2 = 0;
+        Reader::FieldHeader f2;
+        while (r.read_field(l2, f2)) {
+          switch (f2.id) {
+            case 1: h.num_values = static_cast<int32_t>(r.zigzag()); break;
+            case 2: h.encoding = static_cast<int32_t>(r.zigzag()); break;
+            case 3: h.def_encoding = static_cast<int32_t>(r.zigzag()); break;
+            case 4: h.rep_encoding = static_cast<int32_t>(r.zigzag()); break;
+            default: r.skip(f2.type);
+          }
+        }
+        break;
+      }
+      case 7: {  // DictionaryPageHeader
+        int16_t l2 = 0;
+        Reader::FieldHeader f2;
+        while (r.read_field(l2, f2)) {
+          switch (f2.id) {
+            case 1: h.dict_num_values = static_cast<int32_t>(r.zigzag()); break;
+            case 2: h.dict_encoding = static_cast<int32_t>(r.zigzag()); break;
+            default: r.skip(f2.type);
+          }
+        }
+        break;
+      }
+      case 8: {  // DataPageHeaderV2
+        int16_t l2 = 0;
+        Reader::FieldHeader f2;
+        while (r.read_field(l2, f2)) {
+          switch (f2.id) {
+            case 1: h.num_values = static_cast<int32_t>(r.zigzag()); break;
+            case 2: h.num_nulls = static_cast<int32_t>(r.zigzag()); break;
+            case 3: h.num_rows = static_cast<int32_t>(r.zigzag()); break;
+            case 4: h.encoding = static_cast<int32_t>(r.zigzag()); break;
+            case 5: h.def_bytes = static_cast<int32_t>(r.zigzag()); break;
+            case 6: h.rep_bytes = static_cast<int32_t>(r.zigzag()); break;
+            default: r.skip(f2.type);
+          }
+        }
+        break;
+      }
+      default: r.skip(f.type);
+    }
+  }
+  *pos += r.pos(base + *pos);
+  return h;
+}
+
+// ---------------------------------------------------------------------------
+// RLE / bit-packed hybrid (definition levels, dictionary indices)
+// ---------------------------------------------------------------------------
+
+static void decode_rle_hybrid(const uint8_t* p, const uint8_t* end, int bit_width,
+                              int64_t n, int32_t* out) {
+  if (bit_width == 0) {
+    std::memset(out, 0, n * sizeof(int32_t));
+    return;
+  }
+  int64_t i = 0;
+  const int byte_width = (bit_width + 7) / 8;
+  const uint32_t mask = bit_width == 32 ? 0xFFFFFFFFu : ((1u << bit_width) - 1);
+  while (i < n) {
+    if (p >= end) throw ThriftError("rle: unexpected end of data");
+    // varint header
+    uint64_t header = 0;
+    int shift = 0;
+    while (true) {
+      if (p >= end) throw ThriftError("rle: truncated header");
+      uint8_t b = *p++;
+      header |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    if ((header & 1) == 0) {
+      // RLE run
+      int64_t run = static_cast<int64_t>(header >> 1);
+      if (end - p < byte_width) throw ThriftError("rle: truncated run value");
+      uint32_t v = 0;
+      for (int b = 0; b < byte_width; b++) v |= static_cast<uint32_t>(p[b]) << (8 * b);
+      p += byte_width;
+      v &= mask;
+      int64_t take = std::min(run, n - i);
+      for (int64_t k = 0; k < take; k++) out[i + k] = static_cast<int32_t>(v);
+      i += take;
+    } else {
+      // bit-packed run: groups of 8 values
+      int64_t groups = static_cast<int64_t>(header >> 1);
+      int64_t vals = groups * 8;
+      int64_t bytes = groups * bit_width;
+      if (end - p < bytes) throw ThriftError("rle: truncated bit-packed run");
+      int64_t take = std::min(vals, n - i);
+      uint64_t bitpos = 0;
+      for (int64_t k = 0; k < take; k++) {
+        uint64_t byte_idx = bitpos >> 3;
+        int bit_off = static_cast<int>(bitpos & 7);
+        uint64_t word = 0;
+        int avail = static_cast<int>(std::min<int64_t>(8, bytes - static_cast<int64_t>(byte_idx)));
+        std::memcpy(&word, p + byte_idx, avail);
+        out[i + k] = static_cast<int32_t>((word >> bit_off) & mask);
+        bitpos += bit_width;
+      }
+      p += bytes;
+      i += take;
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// reader handle
+// ---------------------------------------------------------------------------
+
+struct Handle {
+  const uint8_t* map = nullptr;
+  size_t len = 0;
+  int fd = -1;
+  FileMeta meta;
+  std::vector<int> leaf_schema_idx;  // schema index of each leaf column
+  std::string error;
+
+  ~Handle() {
+    if (map) munmap(const_cast<uint8_t*>(map), len);
+    if (fd >= 0) close(fd);
+  }
+};
+
+static bool build_leaves(Handle* h) {
+  // flat files only: root at schema[0] with N children, each a leaf
+  auto& s = h->meta.schema;
+  if (s.empty()) { h->error = "empty schema"; return false; }
+  size_t idx = 1;
+  for (int32_t c = 0; c < s[0].num_children; c++) {
+    if (idx >= s.size()) { h->error = "truncated schema"; return false; }
+    if (s[idx].num_children > 0) { h->error = "nested schema unsupported"; return false; }
+    if (s[idx].repetition == 2) { h->error = "repeated field unsupported"; return false; }
+    h->leaf_schema_idx.push_back(static_cast<int>(idx));
+    idx++;
+  }
+  return true;
+}
+
+// Per-chunk decode state shared by fixed-width and byte-array paths.
+struct ChunkCursor {
+  const Handle* h;
+  const ColumnMeta* cm;
+  size_t pos;        // current byte offset in file
+  size_t end;        // end of chunk region
+  // dictionary (raw PLAIN-encoded dictionary page payload)
+  const uint8_t* dict = nullptr;
+  int64_t dict_count = 0;
+  bool optional;
+
+  ChunkCursor(const Handle* h_, const ColumnMeta* cm_, bool opt) : h(h_), cm(cm_), optional(opt) {
+    int64_t start = cm->data_page_offset;
+    if (cm->dictionary_page_offset > 0 && cm->dictionary_page_offset < start)
+      start = cm->dictionary_page_offset;
+    pos = static_cast<size_t>(start);
+    end = pos + static_cast<size_t>(cm->total_compressed_size);
+    if (end > h->len) throw ThriftError("column chunk extends past EOF");
+  }
+};
+
+struct PageData {
+  const uint8_t* values;     // start of encoded values
+  size_t values_len;
+  int32_t num_values;        // rows in page (incl nulls)
+  int32_t encoding;
+  std::vector<int32_t> defs; // empty if required
+};
+
+// Reads the next data page (resolving any dictionary page first); returns
+// false at end of chunk.
+static bool next_data_page(ChunkCursor& c, PageData& out) {
+  while (c.pos < c.end) {
+    size_t pos = c.pos;
+    PageHeader ph = parse_page_header(c.h->map, c.h->len, &pos);
+    const uint8_t* body = c.h->map + pos;
+    if (pos + static_cast<size_t>(ph.compressed_size) > c.h->len)
+      throw ThriftError("page body extends past EOF");
+    c.pos = pos + static_cast<size_t>(ph.compressed_size);
+    if (ph.compressed_size != ph.uncompressed_size)
+      throw ThriftError("compressed pages unsupported (codec mismatch)");
+
+    if (ph.type == P_DICTIONARY_PAGE) {
+      if (ph.dict_encoding != E_PLAIN && ph.dict_encoding != E_PLAIN_DICTIONARY)
+        throw ThriftError("non-PLAIN dictionary page");
+      c.dict = body;
+      c.dict_count = ph.dict_num_values;
+      continue;
+    }
+    if (ph.type == P_INDEX_PAGE) continue;
+
+    if (ph.type == P_DATA_PAGE) {
+      const uint8_t* p = body;
+      const uint8_t* bend = body + ph.compressed_size;
+      out.defs.clear();
+      if (c.optional) {
+        if (ph.def_encoding != E_RLE) throw ThriftError("non-RLE definition levels");
+        if (bend - p < 4) throw ThriftError("truncated def level block");
+        uint32_t dlen;
+        std::memcpy(&dlen, p, 4);
+        p += 4;
+        if (static_cast<size_t>(bend - p) < dlen) throw ThriftError("truncated def levels");
+        out.defs.resize(ph.num_values);
+        decode_rle_hybrid(p, p + dlen, 1, ph.num_values, out.defs.data());
+        p += dlen;
+      }
+      out.values = p;
+      out.values_len = static_cast<size_t>(bend - p);
+      out.num_values = ph.num_values;
+      out.encoding = ph.encoding;
+      return true;
+    }
+    if (ph.type == P_DATA_PAGE_V2) {
+      const uint8_t* p = body;
+      const uint8_t* bend = body + ph.compressed_size;
+      if (ph.rep_bytes > 0) throw ThriftError("repetition levels unsupported");
+      out.defs.clear();
+      if (c.optional) {
+        out.defs.resize(ph.num_values);
+        decode_rle_hybrid(p, p + ph.def_bytes, 1, ph.num_values, out.defs.data());
+      }
+      p += ph.def_bytes;
+      out.values = p;
+      out.values_len = static_cast<size_t>(bend - p);
+      out.num_values = ph.num_values;
+      out.encoding = ph.encoding;
+      return true;
+    }
+    throw ThriftError("unknown page type " + std::to_string(ph.type));
+  }
+  return false;
+}
+
+static int physical_width(int32_t t, int32_t type_length) {
+  switch (t) {
+    case T_INT32: return 4;
+    case T_INT64: return 8;
+    case T_FLOAT: return 4;
+    case T_DOUBLE: return 8;
+    case T_INT96: return 12;
+    case T_FIXED_LEN_BYTE_ARRAY: return type_length;
+    default: return -1;
+  }
+}
+
+}  // namespace hsn
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+using namespace hsn;
+
+extern "C" {
+
+void* hsn_open(const char* path) {
+  auto h = std::make_unique<Handle>();
+  h->fd = open(path, O_RDONLY);
+  if (h->fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(h->fd, &st) != 0 || st.st_size < 12) return nullptr;
+  h->len = static_cast<size_t>(st.st_size);
+  void* m = mmap(nullptr, h->len, PROT_READ, MAP_PRIVATE, h->fd, 0);
+  if (m == MAP_FAILED) return nullptr;
+  h->map = static_cast<const uint8_t*>(m);
+  if (std::memcmp(h->map + h->len - 4, "PAR1", 4) != 0) return nullptr;
+  uint32_t flen;
+  std::memcpy(&flen, h->map + h->len - 8, 4);
+  if (flen + 8 > h->len) return nullptr;
+  try {
+    h->meta = parse_file_meta(h->map + h->len - 8 - flen, flen);
+    if (!build_leaves(h.get())) {
+      // keep the handle alive so the caller can read the error
+      return h.release();
+    }
+  } catch (const std::exception& e) {
+    return nullptr;
+  }
+  return h.release();
+}
+
+void hsn_close(void* hp) { delete static_cast<Handle*>(hp); }
+
+const char* hsn_error(void* hp) {
+  auto* h = static_cast<Handle*>(hp);
+  return h->error.empty() ? nullptr : h->error.c_str();
+}
+
+int64_t hsn_num_rows(void* hp) { return static_cast<Handle*>(hp)->meta.num_rows; }
+
+int32_t hsn_num_columns(void* hp) {
+  return static_cast<int32_t>(static_cast<Handle*>(hp)->leaf_schema_idx.size());
+}
+
+const char* hsn_column_name(void* hp, int32_t i) {
+  auto* h = static_cast<Handle*>(hp);
+  if (i < 0 || i >= (int32_t)h->leaf_schema_idx.size()) return nullptr;
+  return h->meta.schema[h->leaf_schema_idx[i]].name.c_str();
+}
+
+int32_t hsn_column_type(void* hp, int32_t i) {
+  auto* h = static_cast<Handle*>(hp);
+  if (i < 0 || i >= (int32_t)h->leaf_schema_idx.size()) return -1;
+  return h->meta.schema[h->leaf_schema_idx[i]].type;
+}
+
+int32_t hsn_column_optional(void* hp, int32_t i) {
+  auto* h = static_cast<Handle*>(hp);
+  if (i < 0 || i >= (int32_t)h->leaf_schema_idx.size()) return -1;
+  return h->meta.schema[h->leaf_schema_idx[i]].repetition == 1 ? 1 : 0;
+}
+
+// Decode a fixed-width column (INT32/INT64/FLOAT/DOUBLE/BOOLEAN) across all
+// row groups into `out` (num_rows elements of the physical width; BOOLEAN
+// decodes to one byte per value). `validity` (nullable) receives 1/0 per row.
+// Null slots in `out` are zero-filled. Returns rows decoded, or -1 (see
+// hsn_error).
+int64_t hsn_read_fixed(void* hp, int32_t col, void* out, uint8_t* validity) {
+  auto* h = static_cast<Handle*>(hp);
+  if (col < 0 || col >= (int32_t)h->leaf_schema_idx.size()) {
+    h->error = "column index out of range";
+    return -1;
+  }
+  const auto& se = h->meta.schema[h->leaf_schema_idx[col]];
+  const bool optional = se.repetition == 1;
+  const int width = se.type == T_BOOLEAN ? 1 : physical_width(se.type, se.type_length);
+  if (width <= 0) {
+    h->error = "not a fixed-width column";
+    return -1;
+  }
+  uint8_t* dst = static_cast<uint8_t*>(out);
+  int64_t row = 0;
+  try {
+    for (const auto& rg : h->meta.row_groups) {
+      if (col >= (int32_t)rg.columns.size()) throw ThriftError("row group missing column");
+      const ColumnMeta& cm = rg.columns[col];
+      if (cm.codec != 0) throw ThriftError("compressed chunks unsupported");
+      ChunkCursor cur(h, &cm, optional);
+      PageData pd;
+      std::vector<int32_t> idx;
+      while (next_data_page(cur, pd)) {
+        const int64_t n = pd.num_values;
+        int64_t present = n;
+        if (!pd.defs.empty()) {
+          present = 0;
+          for (int32_t d : pd.defs) present += (d != 0);
+        }
+        if (pd.encoding == E_PLAIN) {
+          if (se.type == T_BOOLEAN) {
+            // bit-packed LSB-first
+            std::vector<uint8_t> vals(present);
+            if (pd.values_len * 8 < static_cast<size_t>(present))
+              throw ThriftError("truncated boolean page");
+            for (int64_t k = 0; k < present; k++)
+              vals[k] = (pd.values[k >> 3] >> (k & 7)) & 1;
+            if (pd.defs.empty()) {
+              std::memcpy(dst + row * width, vals.data(), present);
+              if (validity) std::memset(validity + row, 1, n);
+            } else {
+              int64_t vi = 0;
+              for (int64_t k = 0; k < n; k++) {
+                bool v = pd.defs[k] != 0;
+                dst[(row + k)] = v ? vals[vi++] : 0;
+                if (validity) validity[row + k] = v;
+              }
+            }
+            row += n;
+            continue;
+          }
+          if (pd.values_len < static_cast<size_t>(present) * width)
+            throw ThriftError("truncated PLAIN page");
+          if (pd.defs.empty()) {
+            std::memcpy(dst + row * width, pd.values, static_cast<size_t>(n) * width);
+            if (validity) std::memset(validity + row, 1, n);
+          } else {
+            int64_t vi = 0;
+            for (int64_t k = 0; k < n; k++) {
+              if (pd.defs[k] != 0) {
+                std::memcpy(dst + (row + k) * width, pd.values + vi * width, width);
+                vi++;
+              } else {
+                std::memset(dst + (row + k) * width, 0, width);
+              }
+              if (validity) validity[row + k] = pd.defs[k] != 0;
+            }
+          }
+          row += n;
+        } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
+          if (!cur.dict) throw ThriftError("dictionary page missing");
+          if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
+          int bw = pd.values[0];
+          if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
+          idx.assign(present, 0);
+          decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
+          int64_t vi = 0;
+          for (int64_t k = 0; k < n; k++) {
+            bool v = pd.defs.empty() || pd.defs[k] != 0;
+            if (v) {
+              int32_t di = idx[vi++];
+              if (di < 0 || di >= cur.dict_count) throw ThriftError("dictionary index out of range");
+              std::memcpy(dst + (row + k) * width, cur.dict + static_cast<int64_t>(di) * width, width);
+            } else {
+              std::memset(dst + (row + k) * width, 0, width);
+            }
+            if (validity) validity[row + k] = v;
+          }
+          row += n;
+        } else {
+          throw ThriftError("unsupported encoding " + std::to_string(pd.encoding));
+        }
+      }
+    }
+  } catch (const std::exception& e) {
+    h->error = e.what();
+    return -1;
+  }
+  return row;
+}
+
+// BYTE_ARRAY decode. offsets must hold num_rows+1 int64s. If data == NULL the
+// function only fills offsets (so the caller can allocate exactly); otherwise
+// data must hold offsets[num_rows] bytes. Null rows get empty spans.
+// Returns rows decoded or -1.
+int64_t hsn_read_binary(void* hp, int32_t col, int64_t* offsets, uint8_t* data,
+                        uint8_t* validity) {
+  auto* h = static_cast<Handle*>(hp);
+  if (col < 0 || col >= (int32_t)h->leaf_schema_idx.size()) {
+    h->error = "column index out of range";
+    return -1;
+  }
+  const auto& se = h->meta.schema[h->leaf_schema_idx[col]];
+  if (se.type != T_BYTE_ARRAY) {
+    h->error = "not a BYTE_ARRAY column";
+    return -1;
+  }
+  const bool optional = se.repetition == 1;
+  int64_t row = 0;
+  int64_t nbytes = 0;
+  offsets[0] = 0;
+  try {
+    for (const auto& rg : h->meta.row_groups) {
+      if (col >= (int32_t)rg.columns.size()) throw ThriftError("row group missing column");
+      const ColumnMeta& cm = rg.columns[col];
+      if (cm.codec != 0) throw ThriftError("compressed chunks unsupported");
+      ChunkCursor cur(h, &cm, optional);
+      PageData pd;
+      std::vector<int32_t> idx;
+      // dictionary spans: resolved lazily per chunk
+      std::vector<std::pair<const uint8_t*, uint32_t>> dict_spans;
+      bool dict_resolved = false;
+      while (next_data_page(cur, pd)) {
+        const int64_t n = pd.num_values;
+        int64_t present = n;
+        if (!pd.defs.empty()) {
+          present = 0;
+          for (int32_t d : pd.defs) present += (d != 0);
+        }
+        if (pd.encoding == E_PLAIN) {
+          const uint8_t* p = pd.values;
+          const uint8_t* bend = pd.values + pd.values_len;
+          int64_t vi = 0;
+          for (int64_t k = 0; k < n; k++) {
+            bool v = pd.defs.empty() || pd.defs[k] != 0;
+            uint32_t len = 0;
+            if (v) {
+              if (bend - p < 4) throw ThriftError("truncated byte array length");
+              std::memcpy(&len, p, 4);
+              p += 4;
+              if (static_cast<size_t>(bend - p) < len) throw ThriftError("truncated byte array");
+              if (data) std::memcpy(data + nbytes, p, len);
+              p += len;
+              vi++;
+            }
+            nbytes += len;
+            offsets[row + k + 1] = nbytes;
+            if (validity) validity[row + k] = v;
+          }
+          row += n;
+        } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
+          if (!cur.dict) throw ThriftError("dictionary page missing");
+          if (!dict_resolved) {
+            dict_spans.clear();
+            const uint8_t* p = cur.dict;
+            // dictionary page payload is PLAIN byte arrays; bound by chunk end
+            const uint8_t* dend = h->map + cur.end;
+            for (int64_t d = 0; d < cur.dict_count; d++) {
+              if (dend - p < 4) throw ThriftError("truncated dictionary");
+              uint32_t len;
+              std::memcpy(&len, p, 4);
+              p += 4;
+              if (static_cast<size_t>(dend - p) < len) throw ThriftError("truncated dictionary");
+              dict_spans.emplace_back(p, len);
+              p += len;
+            }
+            dict_resolved = true;
+          }
+          if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
+          int bw = pd.values[0];
+          if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
+          idx.assign(present, 0);
+          decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
+          int64_t vi = 0;
+          for (int64_t k = 0; k < n; k++) {
+            bool v = pd.defs.empty() || pd.defs[k] != 0;
+            uint32_t len = 0;
+            if (v) {
+              int32_t di = idx[vi++];
+              if (di < 0 || di >= (int32_t)dict_spans.size())
+                throw ThriftError("dictionary index out of range");
+              len = dict_spans[di].second;
+              if (data) std::memcpy(data + nbytes, dict_spans[di].first, len);
+            }
+            nbytes += len;
+            offsets[row + k + 1] = nbytes;
+            if (validity) validity[row + k] = v;
+          }
+          row += n;
+        } else {
+          throw ThriftError("unsupported encoding " + std::to_string(pd.encoding));
+        }
+      }
+    }
+  } catch (const std::exception& e) {
+    h->error = e.what();
+    return -1;
+  }
+  return row;
+}
+
+}  // extern "C"

@@ -1,0 +1,147 @@
+"""Native Parquet decoder parity tests.
+
+The decoder (native/hs_native.cc via hyperspace_tpu.native) must agree with
+pyarrow on every file in the framework's index dialect (uncompressed PLAIN or
+dictionary pages) and cleanly refuse anything outside it so scans fall back.
+The reference has no native code (SURVEY.md §2 "Native components: none");
+this is the new C++ Parquet->device-buffer path of SURVEY.md §7.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from hyperspace_tpu import native
+from hyperspace_tpu.exec.io import read_parquet_batch
+
+
+@pytest.fixture(scope="module")
+def sample_table():
+    rng = np.random.default_rng(7)
+    n = 5000
+    return pa.table(
+        {
+            "i64": rng.integers(-(10**12), 10**12, n).astype(np.int64),
+            "i32": rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32),
+            "f64": rng.standard_normal(n),
+            "f32": rng.standard_normal(n).astype(np.float32),
+            "flag": rng.integers(0, 2, n).astype(bool),
+            "s": pa.array([f"val_{i % 97}" for i in range(n)]),
+            "ts": pa.array(
+                np.datetime64("2020-01-01")
+                + rng.integers(0, 10**6, n).astype("timedelta64[s]")
+            ),
+        }
+    )
+
+
+def _assert_batch_matches(batch, table):
+    """Parity contract: the native decode of a file equals pyarrow's decode of
+    the same file (``table`` must come from ``pq.read_table``, not memory —
+    parquet legally rewrites e.g. timestamp units on write)."""
+    for c in table.column_names:
+        exp = table[c].to_numpy(zero_copy_only=False)
+        got = batch[c]
+        if exp.dtype == object:
+            assert all(a == b for a, b in zip(got, exp)), c
+        else:
+            assert got.dtype == exp.dtype, (c, got.dtype, exp.dtype)
+            assert np.array_equal(got, exp), c
+
+
+def test_native_available():
+    assert native.is_available()
+
+
+def test_plain_roundtrip(tmp_path, sample_table):
+    p = str(tmp_path / "plain.parquet")
+    pq.write_table(sample_table, p, use_dictionary=False, compression="NONE")
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_dictionary_roundtrip(tmp_path, sample_table):
+    p = str(tmp_path / "dict.parquet")
+    pq.write_table(sample_table, p, use_dictionary=True, compression="NONE")
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_multi_row_group(tmp_path, sample_table):
+    p = str(tmp_path / "rg.parquet")
+    pq.write_table(sample_table, p, use_dictionary=False, compression="NONE", row_group_size=512)
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_column_subset_and_multiple_files(tmp_path, sample_table):
+    p1 = str(tmp_path / "a.parquet")
+    p2 = str(tmp_path / "b.parquet")
+    pq.write_table(sample_table.slice(0, 2000), p1, use_dictionary=False, compression="NONE")
+    pq.write_table(sample_table.slice(2000), p2, use_dictionary=False, compression="NONE")
+    got = read_parquet_batch([p1, p2], ["i64", "s"])
+    assert set(got) == {"i64", "s"}
+    assert np.array_equal(got["i64"], sample_table["i64"].to_numpy())
+
+
+def test_nulls(tmp_path):
+    t = pa.table(
+        {
+            "x": pa.array([None if i % 7 == 0 else float(i) for i in range(1000)]),
+            "s": pa.array([None if i % 11 == 0 else f"s{i}" for i in range(1000)]),
+            "k": pa.array([None if i % 5 == 0 else i for i in range(1000)], type=pa.int64()),
+        }
+    )
+    p = str(tmp_path / "nulls.parquet")
+    pq.write_table(t, p, use_dictionary=False, compression="NONE")
+    got = read_parquet_batch([p], ["x", "s", "k"])
+    exp_x = t["x"].to_numpy(zero_copy_only=False)
+    assert np.array_equal(np.isnan(got["x"]), np.isnan(exp_x))
+    exp_s = t["s"].to_numpy(zero_copy_only=False)
+    assert all((a is None and b is None) or a == b for a, b in zip(got["s"], exp_s))
+    # nullable ints surface as float64-with-NaN, pyarrow-compatible
+    exp_k = t["k"].to_numpy(zero_copy_only=False)
+    assert got["k"].dtype == exp_k.dtype == np.float64
+    assert np.array_equal(np.isnan(got["k"]), np.isnan(exp_k))
+
+
+def test_compressed_falls_back(tmp_path, sample_table):
+    """Snappy files are outside the native dialect; read_parquet_batch must
+    still return correct data through the pyarrow fallback."""
+    p = str(tmp_path / "snappy.parquet")
+    pq.write_table(sample_table, p, compression="SNAPPY")
+    with pytest.raises(native.NativeUnsupported):
+        native.read_columns(p, ["i64"])
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_native_rejects_nested(tmp_path):
+    t = pa.table({"outer": pa.array([{"a": 1}, {"a": 2}])})
+    p = str(tmp_path / "nested.parquet")
+    pq.write_table(t, p, compression="NONE")
+    with pytest.raises(native.NativeUnsupported):
+        native.read_columns(p, ["outer"])
+
+
+def test_index_files_are_native_decodable(tmp_path):
+    """The bucketed index writer must emit files the native decoder accepts."""
+    from hyperspace_tpu.indexes.covering import write_bucketed
+
+    rng = np.random.default_rng(3)
+    n = 4000
+    t = pa.table(
+        {
+            "k": rng.integers(0, 500, n).astype(np.int64),
+            "v": rng.standard_normal(n),
+            "s": pa.array([f"n{i % 13}" for i in range(n)]),
+        }
+    )
+    out = str(tmp_path / "idx")
+    files = write_bucketed(t, ["k"], 8, out)
+    assert files
+    total = 0
+    for f in files:
+        with native.NativeParquetFile(f) as nf:
+            assert set(nf.columns) == {"k", "v", "s"}
+            k, _ = nf.read_column("k")
+            assert np.all(k[1:] >= k[:-1])  # sorted within bucket
+            total += nf.num_rows
+    assert total == n
