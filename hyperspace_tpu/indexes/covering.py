@@ -197,10 +197,14 @@ def write_bucketed(table: pa.Table, bucket_sort_columns: List[str], num_buckets:
         jax.device_put(hash_inputs), jax.device_put(sort_keys), num_buckets
     )
     perm = np.asarray(perm)
-    sorted_buckets = np.asarray(sorted_buckets)
 
     permuted = table.take(pa.array(perm))
-    boundaries = np.searchsorted(sorted_buckets, np.arange(num_buckets + 1))
+    # per-bucket row counts via the pallas histogram kernel (ops/kernels);
+    # prefix sums of the counts are the bucket boundaries in the sorted order
+    from hyperspace_tpu.ops.kernels import bucket_histogram
+
+    counts = bucket_histogram(sorted_buckets, num_buckets)
+    boundaries = np.concatenate([[0], np.cumsum(counts)])
     written = []
     for b in range(num_buckets):
         lo, hi = int(boundaries[b]), int(boundaries[b + 1])

@@ -193,6 +193,23 @@ class PartitionSketch(Sketch):
         return [uniq[0] if len(uniq) == 1 else None]
 
 
+def _restore_bound(value: float, dtype: np.dtype, lower: bool):
+    """Map a float64 device-reduce result back to the column dtype.
+
+    int64 values beyond 2**53 are not exactly representable in float64; a
+    misrounded bound could wrongly *tighten* the sketch and prune a matching
+    file. Bounds are therefore widened outward (min down, max up) whenever the
+    round trip is inexact — widening only ever costs false positives, which
+    data skipping tolerates by design.
+    """
+    if dtype.kind not in ("i", "u"):
+        return dtype.type(value)
+    iv = int(value)
+    if float(iv) == value and abs(value) <= 2**53:
+        return iv
+    return iv - 1 if lower else iv + 1
+
+
 class DataSkippingIndex(Index):
     kind = "DataSkippingIndex"
     kind_abbr = "DS"
@@ -246,16 +263,44 @@ class DataSkippingIndex(Index):
         self._write_rows(rows, ctx.index_data_path)
 
     def _sketch_rows(self, relation, file_infos, cols: List[str], ctx: CreateContext) -> List[Dict[str, Any]]:
-        rows = []
+        from hyperspace_tpu.exec.io import read_parquet_batch
+
+        batches: List[Dict[str, np.ndarray]] = []
+        rows: List[Dict[str, Any]] = []
         for fi in file_infos:
             fid = ctx.file_id_tracker.add_file(fi)
-            t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=cols)
-            row: Dict[str, Any] = {C.DATA_FILE_NAME_ID: fid}
-            for s in self.sketches:
-                col = t.column(s.expr).to_numpy(zero_copy_only=False)
+            if relation.physical_format == "parquet":
+                batches.append(read_parquet_batch([fi.name], cols))
+            else:
+                t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=cols)
+                batches.append({c: t.column(c).to_numpy(zero_copy_only=False) for c in cols})
+            rows.append({C.DATA_FILE_NAME_ID: fid})
+
+        # numeric MinMax sketches aggregate on device: all files' segments in
+        # one fused pallas min+max sweep (ops/kernels.segmented_min_max)
+        device_minmax = [
+            s
+            for s in self.sketches
+            if isinstance(s, MinMaxSketch)
+            and batches
+            and all(b[s.expr].dtype.kind in ("i", "u", "f") for b in batches)
+        ]
+        for s in device_minmax:
+            from hyperspace_tpu.ops.kernels import segmented_min_max
+
+            mins, maxs = segmented_min_max([b[s.expr] for b in batches])
+            names = s.output_names()
+            for i, row in enumerate(rows):
+                dt = batches[i][s.expr].dtype
+                row[names[0]] = None if np.isnan(mins[i]) else _restore_bound(mins[i], dt, lower=True)
+                row[names[1]] = None if np.isnan(maxs[i]) else _restore_bound(maxs[i], dt, lower=False)
+
+        host_sketches = [s for s in self.sketches if s not in device_minmax]
+        for i, row in enumerate(rows):
+            for s in host_sketches:
+                col = batches[i][s.expr]
                 for name, value in zip(s.output_names(), s.aggregate(col)):
                     row[name] = value
-            rows.append(row)
         return rows
 
     def _write_rows(self, rows: List[Dict[str, Any]], out_dir: str) -> None:

@@ -1,0 +1,81 @@
+"""Pallas kernel numerics (interpret mode on the CPU backend).
+
+The kernels are the device programs behind MinMaxSketch builds and bucketed
+write planning (ops/kernels.py); off-TPU they run in the pallas interpreter
+with identical numerics.
+"""
+
+import numpy as np
+import pytest
+
+from hyperspace_tpu.ops.kernels import bucket_histogram, segmented_min_max
+
+
+def test_segmented_min_max_matches_numpy():
+    rng = np.random.default_rng(0)
+    segs = [rng.standard_normal(int(rng.integers(1, 700))) for _ in range(13)]
+    mins, maxs = segmented_min_max(segs)
+    for i, s in enumerate(segs):
+        assert mins[i] == s.min()
+        assert maxs[i] == s.max()
+
+
+def test_segmented_min_max_nulls_and_empty():
+    segs = [np.array([1.0, np.nan, -3.0]), np.array([]), np.array([np.nan])]
+    mins, maxs = segmented_min_max(segs)
+    assert mins[0] == -3.0 and maxs[0] == 1.0
+    assert np.isnan(mins[1]) and np.isnan(maxs[1])
+    assert np.isnan(mins[2]) and np.isnan(maxs[2])
+
+
+def test_segmented_min_max_int_segments():
+    segs = [np.arange(100, dtype=np.int64), np.array([7], dtype=np.int64)]
+    mins, maxs = segmented_min_max(segs)
+    assert mins[0] == 0 and maxs[0] == 99
+    assert mins[1] == 7 and maxs[1] == 7
+
+
+@pytest.mark.parametrize("n,nb", [(10_000, 64), (5, 8), (2048, 128), (3000, 200)])
+def test_bucket_histogram_matches_bincount(n, nb):
+    rng = np.random.default_rng(n)
+    b = rng.integers(0, nb, n)
+    assert np.array_equal(bucket_histogram(b, nb), np.bincount(b, minlength=nb))
+
+
+def test_bucket_histogram_empty():
+    assert np.array_equal(bucket_histogram(np.array([], dtype=np.int64), 8), np.zeros(8, np.int32))
+
+
+def test_minmax_sketch_build_uses_exact_int_bounds(tmp_path):
+    """End-to-end: DataSkippingIndex MinMax rows equal the host oracle."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.indexes.dataskipping import DataSkippingIndexConfig, MinMaxSketch
+
+    rng = np.random.default_rng(5)
+    root = tmp_path / "data"
+    root.mkdir()
+    expected = []
+    for i in range(5):
+        vals = rng.integers(-(10**9), 10**9, 500).astype(np.int64)
+        expected.append((int(vals.min()), int(vals.max())))
+        pq.write_table(pa.table({"k": vals}), root / f"f{i}.parquet")
+
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: str(tmp_path / "idx")})
+    hst.set_session(sess)
+    try:
+        hs = hst.Hyperspace(sess)
+        df = sess.read_parquet(str(root))
+        hs.create_index(df, DataSkippingIndexConfig("mm", MinMaxSketch("k")))
+        entry = sess.index_manager.get_index("mm")
+        from hyperspace_tpu.indexes.registry import index_of_entry
+
+        idx = index_of_entry(entry)
+        table = idx.read_sketch_table(entry)
+        mins = table.column("MinMax_k__min").to_pylist()
+        maxs = table.column("MinMax_k__max").to_pylist()
+        assert sorted(zip(mins, maxs)) == sorted(expected)
+    finally:
+        hst.set_session(None)
