@@ -207,7 +207,11 @@ def _restore_bound(value: float, dtype: np.dtype, lower: bool):
     iv = int(value)
     if float(iv) == value and abs(value) <= 2**53:
         return iv
-    return iv - 1 if lower else iv + 1
+    # beyond 2**53 the f64 rounding error is up to ulp/2, which grows with
+    # magnitude (512 at 2**62) — widen by a full ulp, clamped to the dtype
+    slack = max(1, int(math.ulp(abs(value))))
+    info = np.iinfo(dtype)
+    return max(iv - slack, info.min) if lower else min(iv + slack, info.max)
 
 
 class DataSkippingIndex(Index):

@@ -20,6 +20,9 @@ from __future__ import annotations
 from functools import partial
 
 import jax
+
+jax.config.update("jax_enable_x64", True)  # float64 sketch bounds, as in ops.sort
+
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
@@ -36,47 +39,115 @@ def _use_interpret() -> bool:
 # ---------------------------------------------------------------------------
 # segmented min/max
 # ---------------------------------------------------------------------------
+#
+# Mosaic has no 64-bit types, so the kernel never sees f64: values are encoded
+# host-side as order-preserving uint64 keys, split into two bias-corrected
+# int32 planes (hi, lo), and the kernel keeps running lexicographic minima and
+# maxima of (hi, lo) pairs — exact for the full f64 range. A third int32 plane
+# masks padding / SQL nulls.
+
+_I32_MAX = np.int32(2**31 - 1)
+_I32_MIN = np.int32(-(2**31))
 
 
-def _minmax_kernel(x_ref, min_ref, max_ref):
+def _f64_to_orderable_u64(v: np.ndarray) -> np.ndarray:
+    """Monotone f64 -> uint64 (NaNs must be excluded by the caller). The
+    extreme keys 0 and 2**64-1 are unreachable (they'd require NaN bit
+    patterns), so they are safe identity sentinels."""
+    bits = np.ascontiguousarray(v, dtype=np.float64).view(np.uint64)
+    neg = (bits >> np.uint64(63)).astype(bool)
+    return np.where(neg, ~bits, bits | np.uint64(0x8000000000000000))
+
+
+def _orderable_u64_to_f64(key: np.ndarray) -> np.ndarray:
+    was_pos = (key >> np.uint64(63)).astype(bool)
+    bits = np.where(was_pos, key & np.uint64(0x7FFFFFFFFFFFFFFF), ~key)
+    return bits.view(np.float64)
+
+
+def _split_hi_lo(key: np.ndarray):
+    """uint64 -> (hi, lo) int32 planes whose signed lexicographic order equals
+    the unsigned uint64 order (both halves are bias-flipped)."""
+    hi = ((key >> np.uint64(32)).astype(np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+    lo = ((key & np.uint64(0xFFFFFFFF)).astype(np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+    return hi, lo
+
+
+def _join_hi_lo(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    h = (hi.view(np.uint32) ^ np.uint32(0x80000000)).astype(np.uint64)
+    l = (lo.view(np.uint32) ^ np.uint32(0x80000000)).astype(np.uint64)
+    return (h << np.uint64(32)) | l
+
+
+def _lex_fold_min(run_h, run_l, cand_h, cand_l):
+    """Merge a (rows,1) candidate pair into the running lexicographic min."""
+    nh = jnp.minimum(run_h, cand_h)
+    l1 = jnp.where(run_h == nh, run_l, _I32_MAX)
+    l2 = jnp.where(cand_h == nh, cand_l, _I32_MAX)
+    return nh, jnp.minimum(l1, l2)
+
+
+def _lex_fold_max(run_h, run_l, cand_h, cand_l):
+    nh = jnp.maximum(run_h, cand_h)
+    l1 = jnp.where(run_h == nh, run_l, _I32_MIN)
+    l2 = jnp.where(cand_h == nh, cand_l, _I32_MIN)
+    return nh, jnp.maximum(l1, l2)
+
+
+def _minmax_kernel(h_ref, l_ref, m_ref, minh_ref, minl_ref, maxh_ref, maxl_ref):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
     def _():
-        min_ref[:] = jnp.full_like(min_ref, jnp.inf)
-        max_ref[:] = jnp.full_like(max_ref, -jnp.inf)
+        minh_ref[:] = jnp.full_like(minh_ref, _I32_MAX)
+        minl_ref[:] = jnp.full_like(minl_ref, _I32_MAX)
+        maxh_ref[:] = jnp.full_like(maxh_ref, _I32_MIN)
+        maxl_ref[:] = jnp.full_like(maxl_ref, _I32_MIN)
 
-    blk = x_ref[:]
-    # NaN doubles as both padding and SQL-null; min/max ignore it
-    valid = jnp.logical_not(jnp.isnan(blk))
-    lo = jnp.where(valid, blk, jnp.inf)
-    hi = jnp.where(valid, blk, -jnp.inf)
-    min_ref[:] = jnp.minimum(min_ref[:], jnp.min(lo, axis=1, keepdims=True))
-    max_ref[:] = jnp.maximum(max_ref[:], jnp.max(hi, axis=1, keepdims=True))
+    valid = m_ref[:] != 0
+    hi = h_ref[:]
+    lo = l_ref[:]
+
+    # -- tile-local lexicographic min over the lane axis --
+    hi_mn = jnp.where(valid, hi, _I32_MAX)
+    lo_mn = jnp.where(valid, lo, _I32_MAX)
+    th = jnp.min(hi_mn, axis=1, keepdims=True)
+    tl = jnp.min(jnp.where(hi_mn == th, lo_mn, _I32_MAX), axis=1, keepdims=True)
+    nh, nl = _lex_fold_min(minh_ref[:], minl_ref[:], th, tl)
+    minh_ref[:] = nh
+    minl_ref[:] = nl
+
+    # -- tile-local lexicographic max --
+    hi_mx = jnp.where(valid, hi, _I32_MIN)
+    lo_mx = jnp.where(valid, lo, _I32_MIN)
+    th = jnp.max(hi_mx, axis=1, keepdims=True)
+    tl = jnp.max(jnp.where(hi_mx == th, lo_mx, _I32_MIN), axis=1, keepdims=True)
+    nh, nl = _lex_fold_max(maxh_ref[:], maxl_ref[:], th, tl)
+    maxh_ref[:] = nh
+    maxl_ref[:] = nl
 
 
 @partial(jax.jit, static_argnames=("interpret",))
-def _minmax_call(x, interpret: bool):
-    n_seg, width = x.shape
+def _minmax_call(hi, lo, mask, interpret: bool):
+    n_seg, width = hi.shape
     row_tile = _SUBLANES
     col_tile = min(width, 512)
     grid = (n_seg // row_tile, width // col_tile)
+    blk = pl.BlockSpec((row_tile, col_tile), lambda i, j: (i, j), memory_space=pltpu.VMEM)
+    out_blk = pl.BlockSpec((row_tile, 1), lambda i, j: (i, j - j), memory_space=pltpu.VMEM)
     return pl.pallas_call(
         _minmax_kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((row_tile, col_tile), lambda i, j: (i, j), memory_space=pltpu.VMEM)
-        ],
-        out_specs=[
-            pl.BlockSpec((row_tile, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((row_tile, 1), lambda i, j: (i, 0), memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n_seg, 1), x.dtype),
-            jax.ShapeDtypeStruct((n_seg, 1), x.dtype),
-        ],
+        in_specs=[blk, blk, blk],
+        out_specs=[out_blk] * 4,
+        out_shape=[jax.ShapeDtypeStruct((n_seg, 1), jnp.int32)] * 4,
         interpret=interpret,
-    )(x)
+    )(hi, lo, mask)
+
+
+# Cap on padded (rows x width) elements per device call; segments are split /
+# grouped so one huge file can never force a dense n_files x max_rows matrix.
+_MINMAX_CALL_ELEMS = 1 << 23
 
 
 def segmented_min_max(segments):
@@ -85,25 +156,89 @@ def segmented_min_max(segments):
     ``segments`` is a list of 1-D numpy arrays (one per source file). NaNs
     (SQL nulls) are ignored, matching Min/Max aggregate semantics. Returns
     (mins, maxs) as float64 numpy arrays of length ``len(segments)``;
-    all-null/empty segments yield (nan, nan).
+    all-null/empty segments yield (nan, nan). Exact over the full f64 range
+    (the kernel compares order-preserving 2x-int32 keys, not floats).
+
+    Memory-bounded: oversized segments are split into pieces and pieces are
+    batched into device calls of at most ``_MINMAX_CALL_ELEMS`` padded
+    elements; per-piece results fold together exactly on the host (each piece
+    result is already an exact element of the segment).
     """
     n = len(segments)
     if n == 0:
         return np.empty(0), np.empty(0)
+
+    max_piece = _MINMAX_CALL_ELEMS // _SUBLANES
+    pieces = []  # (orig_idx, 1-D array)
+    for i, s in enumerate(segments):
+        s = np.asarray(s)
+        if s.shape[0] <= max_piece:
+            pieces.append((i, s))
+        else:
+            for off in range(0, s.shape[0], max_piece):
+                pieces.append((i, s[off : off + max_piece]))
+
+    mins = np.full(n, np.nan)
+    maxs = np.full(n, np.nan)
+    group: list = []
+    group_w = 1
+
+    def flush() -> None:
+        nonlocal group, group_w
+        if not group:
+            return
+        g_mins, g_maxs = _minmax_rect([p for _, p in group])
+        for (idx, _), mn, mx in zip(group, g_mins, g_maxs):
+            mins[idx] = np.fmin(mins[idx], mn)
+            maxs[idx] = np.fmax(maxs[idx], mx)
+        group, group_w = [], 1
+
+    for idx, p in pieces:
+        w = max(int(p.shape[0]), 1)
+        new_w = max(group_w, w)
+        rows = -(-(len(group) + 1) // _SUBLANES) * _SUBLANES
+        if group and rows * new_w > _MINMAX_CALL_ELEMS:
+            flush()
+            new_w = w
+        group.append((idx, p))
+        group_w = new_w
+    flush()
+    return mins, maxs
+
+
+def _minmax_rect(segments):
+    """One dense (padded) device call. Internal; see ``segmented_min_max``."""
+    n = len(segments)
     width = max(max((s.shape[0] for s in segments), default=1), 1)
     rows = -(-n // _SUBLANES) * _SUBLANES
     col_tile = min(512, -(-width // _LANES) * _LANES)
     width_p = -(-width // col_tile) * col_tile
-    mat = np.full((rows, width_p), np.nan, dtype=np.float64)
+    hi = np.zeros((rows, width_p), dtype=np.int32)
+    lo = np.zeros((rows, width_p), dtype=np.int32)
+    mask = np.zeros((rows, width_p), dtype=np.int32)
     for i, s in enumerate(segments):
         v = np.asarray(s, dtype=np.float64)
-        mat[i, : v.shape[0]] = v
-    mins, maxs = _minmax_call(jnp.asarray(mat), _use_interpret())
-    mins = np.asarray(mins)[:n, 0].copy()
-    maxs = np.asarray(maxs)[:n, 0].copy()
-    # rows that stayed at the reduce identity had no valid values at all
-    mins[np.isinf(mins)] = np.nan
-    maxs[np.isinf(maxs)] = np.nan
+        ok = ~np.isnan(v)
+        v = v[ok]
+        if v.shape[0] == 0:
+            continue
+        h, l = _split_hi_lo(_f64_to_orderable_u64(v))
+        hi[i, : v.shape[0]] = h
+        lo[i, : v.shape[0]] = l
+        mask[i, : v.shape[0]] = 1
+    minh, minl, maxh, maxl = _minmax_call(
+        jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(mask), _use_interpret()
+    )
+    minh = np.asarray(minh)[:n, 0]
+    minl = np.asarray(minl)[:n, 0]
+    maxh = np.asarray(maxh)[:n, 0]
+    maxl = np.asarray(maxl)[:n, 0]
+    mins = _orderable_u64_to_f64(_join_hi_lo(minh, minl))
+    maxs = _orderable_u64_to_f64(_join_hi_lo(maxh, maxl))
+    # rows that stayed at the identity sentinels had no valid values at all
+    empty = (minh == _I32_MAX) & (minl == _I32_MAX)
+    mins = np.where(empty, np.nan, mins)
+    maxs = np.where(empty, np.nan, maxs)
     return mins, maxs
 
 
@@ -120,11 +255,14 @@ def _hist_kernel(b_ref, out_ref):
         out_ref[:] = jnp.zeros_like(out_ref)
 
     buckets = b_ref[:]  # (1, tile)
-    nb = out_ref.shape[1]
-    # one-hot compare against all bucket ids, reduce over the tile axis (VPU)
-    ids = jax.lax.broadcasted_iota(jnp.int32, (1, nb), 1)
-    eq = (buckets[0, :, None] == ids[0, None, :]).astype(jnp.int32)  # (tile, nb)
-    out_ref[:] = out_ref[:] + jnp.sum(eq, axis=0, keepdims=True)
+    nb = out_ref.shape[0]
+    # one-hot compare against all bucket ids via a 2-D iota whose rows are the
+    # ids; (1, tile) broadcasts over rows, the lane-axis reduce yields (nb, 1).
+    # (No reindexing/transpose — Mosaic rejects gather-style relayouts.)
+    ids = jax.lax.broadcasted_iota(jnp.int32, (nb, buckets.shape[1]), 0)
+    eq = (ids == buckets).astype(jnp.int32)  # (nb, tile)
+    # dtype pinned: with x64 enabled jnp.sum would promote to (Mosaic-less) i64
+    out_ref[:] = out_ref[:] + jnp.sum(eq, axis=1, keepdims=True, dtype=jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("num_buckets", "interpret"))
@@ -135,9 +273,9 @@ def _hist_call(buckets, num_buckets: int, interpret: bool):
     return pl.pallas_call(
         _hist_kernel,
         grid=grid,
-        in_specs=[pl.BlockSpec((1, tile), lambda i: (0, i), memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, num_buckets), lambda i: (0, 0), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, num_buckets), jnp.int32),
+        in_specs=[pl.BlockSpec((1, tile), lambda i: (i - i, i), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((num_buckets, 1), lambda i: (i - i, i - i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_buckets, 1), jnp.int32),
         interpret=interpret,
     )(buckets)
 
@@ -155,4 +293,4 @@ def bucket_histogram(bucket_ids, num_buckets: int):
     padded[0, :n] = b
     nb_p = -(-num_buckets // _LANES) * _LANES
     out = _hist_call(jnp.asarray(padded), nb_p, _use_interpret())
-    return np.asarray(out)[0, :num_buckets]
+    return np.asarray(out)[:num_buckets, 0]

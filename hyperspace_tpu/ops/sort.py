@@ -52,11 +52,3 @@ def bucket_sort_perm(hash_inputs, sort_keys, num_buckets: int):
     perm = lex_argsort([buckets] + list(sort_keys))
     return perm, buckets[perm]
 
-
-@partial(jax.jit, static_argnames=("num_buckets",))
-def bucket_counts(hash_inputs, num_buckets: int):
-    """Histogram of rows per bucket (used for write planning and skew checks)."""
-    from hyperspace_tpu.ops.hashing import bucket_ids_jnp
-
-    buckets = bucket_ids_jnp(list(hash_inputs), num_buckets)
-    return jnp.bincount(buckets, length=num_buckets)
