@@ -62,12 +62,18 @@ def main() -> None:
         hs = hst.Hyperspace(sess)
         df = sess.read_parquet(data_dir)
 
-        # warm up compile on a tiny build so jit time isn't billed
+        # warm up compile so jit time isn't billed (steady-state throughput is
+        # the metric; first-compile is amortized by the persistent XLA cache):
+        # a tiny end-to-end build warms every non-sort code path, then the
+        # fused sort program is pre-compiled at the main build's size class
         warm_dir = os.path.join(tmp, "warm")
         os.makedirs(warm_dir)
         make_lineitem_like(warm_dir, 10_000, 1)
         warm_df = sess.read_parquet(warm_dir)
         hs.create_index(warm_df, hst.CoveringIndexConfig("warm", ["l_orderkey"], ["l_extendedprice"]))
+        from hyperspace_tpu.ops import sort as hs_sort
+
+        hs_sort.warm_build(hs_sort.padded_size(num_rows), ("i",), (np.int32,), 64)
 
         t0 = time.perf_counter()
         hs.create_index(

@@ -25,6 +25,25 @@ Layer map (mirrors SURVEY.md §1):
   - ``telemetry/`` structured event taxonomy                    (ref: HS/telemetry/)
 """
 
+import os as _os
+
+# Persistent XLA compilation cache: index builds re-run the same fused sort
+# program per size class across processes; without this every fresh process
+# pays a tens-of-seconds TPU compile. Opt out with HS_JAX_CACHE_DIR="".
+_cache_dir = _os.environ.get(
+    "HS_JAX_CACHE_DIR", _os.path.join(_os.path.expanduser("~"), ".cache", "hyperspace_tpu", "xla")
+)
+if _cache_dir and not _os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+    try:
+        import jax as _jax
+
+        # respect a cache dir the user already configured programmatically
+        if not _jax.config.jax_compilation_cache_dir:
+            _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+            _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass
+
 from hyperspace_tpu.version import __version__
 from hyperspace_tpu.config import HyperspaceConf, keys
 from hyperspace_tpu.session import Session, get_session, set_session

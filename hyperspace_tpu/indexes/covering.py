@@ -137,7 +137,38 @@ class CoveringIndex(Index):
     # --- build -------------------------------------------------------------
     def write(self, ctx: CreateContext, df) -> None:
         """Build index data for ``df`` into ``ctx.index_data_path``
-        (ref: CoveringIndex.scala:54-69 write = repartition + saveWithBuckets)."""
+        (ref: CoveringIndex.scala:54-69 write = repartition + saveWithBuckets).
+
+        Without lineage the build is pipelined: only the key columns are
+        decoded before the device program launches; the payload columns decode
+        while the permutation rides back from the device."""
+        from hyperspace_tpu.plan.logical import Scan
+
+        plan = df.plan
+        if isinstance(plan, Scan) and not self.lineage:
+            relation = plan.relation
+            columns = [c.name for c in resolve_columns_against_schema(self.referenced_columns, relation.schema)]
+            self._indexed = [c.name for c in resolve_columns_against_schema(self._indexed, relation.schema)]
+            self._included = [c.name for c in resolve_columns_against_schema(self._included, relation.schema)]
+            ds = relation.arrow_dataset()
+            key_table = ds.to_table(columns=self._indexed)
+            payload_cols = [c for c in columns if c not in self._indexed]
+
+            def payload_fn() -> Optional[pa.Table]:
+                return ds.to_table(columns=payload_cols) if payload_cols else None
+
+            write_bucketed(
+                key_table,
+                self._indexed,
+                self.num_buckets,
+                ctx.index_data_path,
+                payload_fn=payload_fn,
+                column_order=columns,
+            )
+            schema = pa.schema([ds.schema.field(c) for c in columns])
+            self.schema_json = schema_codec.schema_to_json(schema)
+            return
+
         table = self._index_data_table(ctx, df)
         write_bucketed(table, self._indexed, self.num_buckets, ctx.index_data_path)
         self.schema_json = schema_codec.schema_to_json(table.schema)
@@ -171,52 +202,100 @@ class CoveringIndex(Index):
         return pa.concat_tables(tables)
 
 
-def write_bucketed(table: pa.Table, bucket_sort_columns: List[str], num_buckets: int, out_dir: str) -> List[str]:
+def write_bucketed(
+    table: pa.Table,
+    bucket_sort_columns: List[str],
+    num_buckets: int,
+    out_dir: str,
+    payload_fn=None,
+    column_order: Optional[List[str]] = None,
+) -> List[str]:
     """Device-accelerated bucketed + sorted Parquet write.
 
-    The jitted kernel (ops/sort.bucket_sort_perm) computes the bucket of every
-    row and the permutation clustering rows by bucket / sorting by key; the
-    host then gathers once and writes one file per non-empty bucket.
-    Returns written file paths.
+    One fused device program (ops/sort.bucket_sort_build: hash -> bucket ->
+    multi-key sort -> Pallas histogram) returns the clustering permutation and
+    per-bucket counts. The pipeline overlaps every host stage with the device
+    round trip:
+
+      decode keys -> launch device program -> async perm fetch
+                      || payload_fn() decodes the non-key columns
+      fetch done  -> per-bucket (arrow take + parquet write) in a thread pool
+                     (both release the GIL in C++)
+
+    ``table`` must hold at least ``bucket_sort_columns``; ``payload_fn``, if
+    given, is called after the device launch and returns the remaining
+    columns (row-aligned with ``table``) or None. ``column_order`` fixes the
+    output column order. Returns written file paths (bucket order).
     """
     import jax
 
     from hyperspace_tpu.exec.batch import table_to_batch
     from hyperspace_tpu.ops import encode
-    from hyperspace_tpu.ops.sort import bucket_sort_perm
+    from hyperspace_tpu.ops.sort import bucket_sort_build, padded_size
 
     os.makedirs(out_dir, exist_ok=True)
-    if table.num_rows == 0:
+    n = table.num_rows
+    if n == 0:
         return []
 
     batch = table_to_batch(table.select(bucket_sort_columns))
-    key_cols = [batch[c] for c in bucket_sort_columns]
-    hash_inputs, sort_keys = encode.encode_key_columns(key_cols)
-
-    perm, sorted_buckets = bucket_sort_perm(
-        jax.device_put(hash_inputs), jax.device_put(sort_keys), num_buckets
+    keys, kinds, host_hashes = encode.encode_sort_columns(
+        [batch[c] for c in bucket_sort_columns]
     )
-    perm = np.asarray(perm)
+    np2 = padded_size(n)
+    dev_keys = [jax.device_put(np.pad(k, (0, np2 - n))) for k in keys]
+    dev_hashes = [jax.device_put(np.pad(h, (0, np2 - n))) for h in host_hashes]
+    perm, counts = bucket_sort_build(dev_keys, dev_hashes, kinds, num_buckets, n)
+    counts.copy_to_host_async()
+    # the permutation comes back in pieces so bucket writes can start while
+    # later pieces are still in flight (device->host is the narrow link)
+    n_pieces = min(8, max(1, np2 // (1 << 18)))
+    piece_len = np2 // n_pieces
+    pieces = [perm[i * piece_len : (i + 1) * piece_len] for i in range(n_pieces)]
+    for p in pieces:
+        p.copy_to_host_async()
 
-    permuted = table.take(pa.array(perm))
-    # per-bucket row counts via the pallas histogram kernel (ops/kernels);
-    # prefix sums of the counts are the bucket boundaries in the sorted order
-    from hyperspace_tpu.ops.kernels import bucket_histogram
+    # -- overlapped with the device->host transfer ---------------------------
+    if payload_fn is not None:
+        payload = payload_fn()
+        if payload is not None:
+            for name in payload.column_names:
+                table = table.append_column(payload.schema.field(name), payload.column(name))
+    if column_order:
+        table = table.select(column_order)
+    # single-chunk columns so per-bucket takes don't re-resolve chunk offsets
+    table = table.combine_chunks()
 
-    counts = bucket_histogram(sorted_buckets, num_buckets)
-    boundaries = np.concatenate([[0], np.cumsum(counts)])
-    written = []
-    for b in range(num_buckets):
-        lo, hi = int(boundaries[b]), int(boundaries[b + 1])
-        if hi <= lo:
-            continue
+    counts_np = np.asarray(counts)
+    boundaries = np.concatenate([[0], np.cumsum(counts_np)])
+
+    def _take_write(b: int, lo: int, hi: int) -> str:
         path = os.path.join(out_dir, _bucket_file_name(b))
+        rows = table.take(pa.array(perm_np[lo:hi]))
         # uncompressed PLAIN is the index-file dialect: the native decoder
         # (hyperspace_tpu/native) mmaps these and memcpys column chunks into
         # device-feedable buffers with zero decompression work
-        pq.write_table(permuted.slice(lo, hi - lo), path, use_dictionary=False, compression="NONE")
-        written.append(path)
-    return written
+        pq.write_table(rows, path, use_dictionary=False, compression="NONE")
+        return path
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    perm_np = np.empty(np2, dtype=np.int32)
+    arrived = 0
+    next_piece = 0
+    futures = []
+    with ThreadPoolExecutor(max_workers=8) as ex:
+        for b in range(num_buckets):
+            lo, hi = int(boundaries[b]), int(boundaries[b + 1])
+            if hi <= lo:
+                continue
+            while arrived < hi:
+                chunk = np.asarray(pieces[next_piece])  # blocks for this piece only
+                perm_np[arrived : arrived + chunk.shape[0]] = chunk
+                arrived += chunk.shape[0]
+                next_piece += 1
+            futures.append(ex.submit(_take_write, b, lo, hi))
+        return [f.result() for f in futures]
 
 
 class CoveringIndexConfig(IndexConfig):

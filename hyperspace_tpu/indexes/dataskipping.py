@@ -205,7 +205,8 @@ def _restore_bound(value: float, dtype: np.dtype, lower: bool):
     if dtype.kind not in ("i", "u"):
         return dtype.type(value)
     iv = int(value)
-    if float(iv) == value and abs(value) <= 2**53:
+    # strict: at exactly +-2**53 the float may itself be a rounded bound
+    if float(iv) == value and abs(value) < 2**53:
         return iv
     # beyond 2**53 the f64 rounding error is up to ulp/2, which grows with
     # magnitude (512 at 2**62) — widen by a full ulp, clamped to the dtype

@@ -67,3 +67,32 @@ def encode_key_columns(columns) -> Tuple[np.ndarray, np.ndarray]:
     hash_inputs = np.stack([hash_input_uint32(c) for c in columns])
     sort_keys = np.stack([sort_key_int64(c) for c in columns])
     return hash_inputs, sort_keys
+
+
+def encode_sort_columns(columns):
+    """Per-column encoding for the fused build program (ops/sort.bucket_sort_build).
+
+    Returns ``(keys, kinds, host_hashes)``:
+      - ``keys``: one 1-D order key per column; int/date/bool columns whose
+        values fit int32 are downcast (32-bit device sort is ~2x the speed of
+        the emulated 64-bit one) — safe because the device widens back to the
+        exact int64 value before hashing; string codes are always int32.
+      - ``kinds``: dtype kind per column (``'s'`` for strings).
+      - ``host_hashes``: uint32 hash planes for the string columns only —
+        every other kind's hash input is reconstructed on device.
+    """
+    keys, kinds, host_hashes = [], [], []
+    for c in columns:
+        kind = c.dtype.kind
+        if kind in ("U", "S", "O"):
+            codes, _, _ = factorize_strings(c)
+            keys.append(codes.astype(np.int32))
+            kinds.append("s")
+            host_hashes.append(hash_input_uint32(c))
+            continue
+        k = sort_key_int64(c)
+        if kind != "f" and k.size and -(2**31) <= int(k.min()) and int(k.max()) < 2**31:
+            k = k.astype(np.int32)
+        keys.append(k)
+        kinds.append(kind if kind in "iubMf" else "i")
+    return keys, tuple(kinds), host_hashes

@@ -1,9 +1,24 @@
-"""On-device sort primitives.
+"""On-device bucketed-sort primitives — the index-build hot path.
 
-Replaces the per-partition sort of Spark's bucketed write
-(``sortWithinPartitions``; ref: HS/index/DataFrameWriterExtensions.scala:50-68).
-Lexicographic multi-key ordering is built from successive stable argsorts —
-each pass is one XLA sort, fused and tiled by the compiler.
+Replaces the shuffle + per-partition sort of Spark's bucketed write
+(``repartition(numBuckets, cols).sortWithinPartitions``;
+ref: HS/index/covering/CoveringIndex.scala:54-69,
+HS/index/DataFrameWriterExtensions.scala:50-68) with ONE fused XLA program:
+
+  device hash -> bucket ids -> single multi-operand ``lax.sort``
+  (bucket, key..., iota) -> permutation + per-bucket counts
+  (counts via the Pallas histogram kernel, ops/kernels.bucket_histogram)
+
+Design notes:
+  - every operand is a *key* of the one ``lax.sort`` (iota last), so the
+    order is total and no stable-sort or argsort-chaining passes are needed;
+  - hash inputs for numeric/date columns are reconstructed ON DEVICE from the
+    order-preserving sort keys (bit-exact vs the host ``numeric_hash32``), so
+    only the key planes ride host->device; strings ship a host hash plane;
+  - callers pad rows to a power of two and pass the true row count as a
+    *traced* scalar — one compile serves every build of the same size class;
+  - the permutation comes back as int32 and can be fetched asynchronously
+    (``copy_to_host_async``) while the host prepares the gather.
 
 int64 keys require x64; enabled process-wide on import of this module (the
 framework owns the process' JAX config the way Spark owns its executors).
@@ -11,35 +26,37 @@ framework owns the process' JAX config the way Spark owns its executors).
 
 from __future__ import annotations
 
+from functools import partial
+from typing import Sequence, Tuple
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
 
 import jax.numpy as jnp  # noqa: E402
-from functools import partial  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+_I64_SIGN = -0x8000000000000000
 
 
 def lex_argsort(keys) -> "jnp.ndarray":
     """Stable argsort by ``keys[0]`` then ``keys[1]`` ... (most-significant
-    first). ``keys`` is a (k, n) array or list of (n,) arrays."""
+    first), as one multi-operand XLA sort with a trailing iota tiebreak."""
     keys = list(keys)
-    order = jnp.argsort(keys[-1], stable=True)
-    for key in reversed(keys[:-1]):
-        order = order[jnp.argsort(key[order], stable=True)]
-    return order
+    n = keys[0].shape[0]
+    idx = lax.iota(jnp.int32, n)
+    return lax.sort((*keys, idx), num_keys=len(keys) + 1, is_stable=False)[-1]
 
 
 @partial(jax.jit, static_argnames=("num_buckets",))
 def bucket_sort_perm(hash_inputs, sort_keys, num_buckets: int):
-    """The index-build kernel: assign buckets, then produce the permutation
-    that clusters rows by bucket and sorts by the indexed columns within each
-    bucket — the device replacement for Spark's
-    ``repartition(numBuckets, cols).sortWithinPartitions(cols)``
-    (ref: HS/index/covering/CoveringIndex.scala:54-69).
+    """Assign buckets and produce the permutation that clusters rows by bucket
+    and sorts by the indexed columns within each bucket.
 
     Args:
       hash_inputs: (k, n) uint32 per-column hash inputs of the bucket keys.
-      sort_keys:   (k, n) int64 order-preserving keys of the sort columns.
+      sort_keys:   (k, n) order-preserving keys of the sort columns.
       num_buckets: static bucket count.
 
     Returns:
@@ -49,6 +66,95 @@ def bucket_sort_perm(hash_inputs, sort_keys, num_buckets: int):
     from hyperspace_tpu.ops.hashing import bucket_ids_jnp
 
     buckets = bucket_ids_jnp(list(hash_inputs), num_buckets)
-    perm = lex_argsort([buckets] + list(sort_keys))
-    return perm, buckets[perm]
+    n = buckets.shape[0]
+    idx = lax.iota(jnp.int32, n)
+    out = lax.sort(
+        (buckets, *list(sort_keys), idx),
+        num_keys=2 + len(list(sort_keys)),
+        is_stable=False,
+    )
+    return out[-1], out[0]
 
+
+def _device_hash32(kind: str, key):
+    """Reconstruct the column's uint32 hash input from its order key —
+    bit-exact vs the host ``hashing.numeric_hash32`` on the original values."""
+    v64 = key.astype(jnp.int64)
+    if kind == "f":
+        # invert the order-preserving transform back to the raw f64 bits
+        bits_i = jnp.where(v64 < 0, v64 ^ jnp.int64(_I64_SIGN), ~v64)
+    else:  # i / u / b / M — the key IS the value (or its int64 view)
+        bits_i = v64
+    bits = lax.bitcast_convert_type(bits_i, jnp.uint64)
+    return ((bits ^ (bits >> jnp.uint64(32))) & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+
+
+@partial(jax.jit, static_argnames=("num_buckets", "kinds", "interpret"))
+def _build_sorted(keys, host_hashes, n_valid, num_buckets: int, kinds, interpret: bool):
+    from hyperspace_tpu.ops.hashing import bucket_ids_jnp
+    from hyperspace_tpu.ops.kernels import _hist_call
+
+    hash_cols = []
+    hidx = 0
+    for kind, key in zip(kinds, keys):
+        if kind == "s":
+            hash_cols.append(host_hashes[hidx])
+            hidx += 1
+        else:
+            hash_cols.append(_device_hash32(kind, key))
+    buckets = bucket_ids_jnp(hash_cols, num_buckets)
+
+    n = buckets.shape[0]
+    idx = lax.iota(jnp.int32, n)
+    # padding rows get the sentinel bucket ``num_buckets`` so they cluster
+    # after every real bucket and fall outside the returned counts
+    buckets = jnp.where(idx < n_valid, buckets, jnp.int32(num_buckets))
+    out = lax.sort((buckets, *keys, idx), num_keys=2 + len(keys), is_stable=False)
+    sorted_buckets, perm = out[0], out[-1]
+
+    nb_p = -(-(num_buckets + 1) // 128) * 128
+    counts = _hist_call(sorted_buckets[None, :], nb_p, interpret)[:, 0]
+    return perm, counts[:num_buckets]
+
+
+def bucket_sort_build(
+    keys: Sequence,
+    host_hashes: Sequence,
+    kinds: Tuple[str, ...],
+    num_buckets: int,
+    n_valid: int,
+):
+    """The full device program of an index build over padded inputs.
+
+    Args:
+      keys: per-key-column 1-D device arrays (int32 or int64 order keys),
+        all the same power-of-two length, padded past ``n_valid``.
+      host_hashes: uint32 hash planes for the ``kinds == 's'`` columns, in
+        order of appearance.
+      kinds: per-column dtype kind characters (``i u b M f s``), static.
+      num_buckets: static bucket count.
+      n_valid: true row count (traced — padding amount never recompiles).
+
+    Returns:
+      (perm, counts) device arrays: int32 permutation of all padded rows
+      (valid rows occupy positions [0, n_valid)) and int32 rows-per-bucket.
+    """
+    interpret = jax.default_backend() != "tpu"
+    return _build_sorted(
+        tuple(keys), tuple(host_hashes), np.int32(n_valid), num_buckets, tuple(kinds), interpret
+    )
+
+
+def warm_build(n: int, kinds: Tuple[str, ...], key_dtypes: Sequence, num_buckets: int) -> None:
+    """Pre-compile the build program for a given padded size class so the
+    first real build at that size is a cache hit (first XLA compile of the
+    sort is tens of seconds; see bench.py methodology)."""
+    keys = tuple(jnp.zeros(n, dtype=dt) for dt in key_dtypes)
+    hh = tuple(jnp.zeros(n, dtype=jnp.uint32) for k in kinds if k == "s")
+    perm, counts = bucket_sort_build(keys, hh, kinds, num_buckets, n)
+    jax.block_until_ready((perm, counts))
+
+
+def padded_size(n: int) -> int:
+    """Power-of-two size class for ``n`` rows (min 8)."""
+    return max(8, 1 << (max(n - 1, 1)).bit_length())
