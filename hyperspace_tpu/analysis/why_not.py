@@ -30,6 +30,19 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
     applied = {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
 
     scans = L.collect(plan, lambda p: isinstance(p, L.Scan))
+    # unique scans by plan key; disambiguate label collisions across distinct
+    # scans (two datasets can share a directory basename)
+    by_key = {}
+    for s in scans:
+        by_key.setdefault(L.plan_key(s), s)
+    scans = list(by_key.values())
+    labels = {}
+    used_labels: dict = {}
+    for s in scans:
+        base = _subplan_label(s)
+        ordinal = used_labels.get(base, 0)
+        used_labels[base] = ordinal + 1
+        labels[L.plan_key(s)] = base if ordinal == 0 else f"{base[:24]}#{ordinal + 1}"
     buf: List[str] = []
     buf.append("=" * 64)
     buf.append("whyNot report")
@@ -44,14 +57,28 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
         if entry.name in applied:
             buf.append(f"{entry.name:<24} {'-':<28} (applied)")
             continue
-        any_reason = False
+        seen = set()
         for scan in scans:
+            label = labels[L.plan_key(scan)]
             tagged = entry.get_tag(L.plan_key(scan), R.FILTER_REASONS) or []
             for reason in tagged:
-                any_reason = True
                 text = str(reason) if extended else f"[{reason.code}] {reason.arg_str}"
-                buf.append(f"{entry.name:<24} {scan.describe()[:28]:<28} {text}")
-        if not any_reason:
+                row = (label, text)
+                if row in seen:
+                    continue
+                seen.add(row)
+                buf.append(f"{entry.name:<24} {label:<28} {text}")
+        if not seen:
             buf.append(f"{entry.name:<24} {'-':<28} [NO_CANDIDATE] not a candidate for any sub-plan")
     buf.append("=" * 64)
     return "\n".join(buf)
+
+
+def _subplan_label(scan: L.Scan) -> str:
+    """Short, machine-stable label for a source sub-plan: the dataset's last
+    path component (absolute temp paths would make golden files unstable)."""
+    import os
+
+    paths = getattr(scan.relation, "root_paths", None) or []
+    base = os.path.basename(str(paths[0]).rstrip("/")) if paths else "?"
+    return f"Scan({base})"[:28]

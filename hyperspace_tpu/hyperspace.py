@@ -60,10 +60,12 @@ class Hyperspace:
     def index(self, name: str):
         return self._manager.index_stats(name, extended=True)
 
-    def explain(self, df, verbose: bool = False) -> str:
+    def explain(self, df, verbose: bool = False, mode: str = "plaintext") -> str:
+        """``mode`` is one of plaintext / console / html
+        (ref: plananalysis/DisplayMode.scala:61-89)."""
         from hyperspace_tpu.analysis.explain import explain_string
 
-        return explain_string(df, self.session, verbose)
+        return explain_string(df, self.session, verbose, mode=mode)
 
     def why_not(self, df, index_name: Optional[str] = None, extended: bool = False) -> str:
         from hyperspace_tpu.analysis.why_not import why_not_string

@@ -34,13 +34,23 @@ class ApplyHyperspace:
             return plan
 
     def apply_with_score(self, plan: L.LogicalPlan):
+        original = plan
         indexes = self.session.index_manager.get_indexes([states.ACTIVE])
         if not indexes:
-            return plan, 0
+            return original, 0
+        # normalize: push required columns down to the scans (Catalyst runs
+        # ColumnPruning before the reference's rules; this IR does it here)
+        from hyperspace_tpu.rules.utils import prune_columns
+
+        plan = prune_columns(plan)
         candidates = collect_candidates(self.ctx, plan, indexes)
         if not candidates:
-            return plan, 0
+            return original, 0
         new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(plan, candidates)
+        if score == 0:
+            # nothing rewritten — hand back the untouched user plan so explain
+            # shows no spurious diff and execution shape is unchanged
+            return original, 0
         if score > 0:
             used = sorted(
                 {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}

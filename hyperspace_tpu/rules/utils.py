@@ -32,21 +32,27 @@ from hyperspace_tpu.rules.context import RuleContext
 
 
 def destructure_linear(plan: L.LogicalPlan) -> Optional[Tuple[Optional[List[str]], Optional[Expr], L.Scan]]:
-    """Match [Project] -> [Filter] -> Scan; return (project_cols, condition, scan)
+    """Match any interleaving of Project / Filter nodes over a Scan; return
+    (project_cols, condition, scan) — project_cols is the *outermost*
+    projection (the sub-plan's output), condition the AND of all filters
     (the only sub-plan shape the rules accept;
-    ref: FilterPlanNodeFilter / JoinPlanNodeFilter linearity checks)."""
+    ref: FilterPlanNodeFilter / JoinPlanNodeFilter linearity checks; column
+    pruning may stack an extra Project directly above the Scan)."""
     project_cols = None
     condition = None
     node = plan
-    if isinstance(node, L.Project):
-        project_cols = list(node.columns)
-        node = node.child
-    if isinstance(node, L.Filter):
-        condition = node.condition
-        node = node.child
-    if isinstance(node, L.Scan):
-        return project_cols, condition, node
-    return None
+    while True:
+        if isinstance(node, L.Project):
+            if project_cols is None:
+                project_cols = list(node.columns)
+            node = node.child
+        elif isinstance(node, L.Filter):
+            condition = node.condition if condition is None else condition & node.condition
+            node = node.child
+        elif isinstance(node, L.Scan):
+            return project_cols, condition, node
+        else:
+            return None
 
 
 def pruned_buckets_for_predicate(
@@ -175,3 +181,61 @@ def hybrid_coverage_fraction(entry: IndexLogEntry, scan: L.Scan) -> float:
     common = entry.get_tag(key, R.COMMON_SOURCE_SIZE_IN_BYTES) or 0
     total = sum(fi.size for fi in scan.relation.all_file_infos())
     return common / max(1, total)
+
+
+def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
+    """Column pruning: push the set of columns the parent actually needs down
+    to the scans, materialized as a Project directly above each Scan.
+
+    The reference relies on Catalyst's ColumnPruning running *before* its
+    rules, so JoinIndexRule sees minimal per-side required columns
+    (ref: JoinIndexRule.scala:419-448 allRequiredCols over pruned plans);
+    this IR has no separate optimizer, so ApplyHyperspace normalizes first.
+    ``needed=None`` means "all columns".
+    """
+    if isinstance(plan, L.Project):
+        child_needed = set()
+        for c in plan.columns:
+            child_needed.add(c)
+        return L.Project(plan.columns, prune_columns(plan.child, child_needed))
+    if isinstance(plan, L.Filter):
+        child_needed = None if needed is None else set(needed) | set(plan.condition.references())
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, child_needed)])
+    if isinstance(plan, L.Join):
+        left_cols = set(plan.left.output_columns)
+        right_cols = set(plan.right.output_columns)
+        if needed is None:
+            l_needed = r_needed = None
+        else:
+            l_needed, r_needed = set(), set()
+            for c in needed:
+                if c.endswith("#r") and c[:-2] in right_cols:
+                    # the '#r' rename only exists while the column duplicates
+                    # across sides — keep the left copy too
+                    r_needed.add(c[:-2])
+                    if c[:-2] in left_cols:
+                        l_needed.add(c[:-2])
+                elif c in left_cols:
+                    l_needed.add(c)
+                elif c in right_cols:
+                    r_needed.add(c)
+            cond_refs = set(plan.condition.references())
+            l_needed |= cond_refs & left_cols
+            r_needed |= cond_refs & right_cols
+        return L.Join(
+            prune_columns(plan.left, l_needed),
+            prune_columns(plan.right, r_needed),
+            plan.condition,
+            plan.how,
+        )
+    if isinstance(plan, L.Scan):
+        out = plan.output_columns
+        if needed is not None and set(needed) < set(out):
+            ordered = [c for c in out if c in needed]
+            return L.Project(ordered, plan)
+        return plan
+    if isinstance(plan, L.Union):
+        return plan.with_children([prune_columns(c, needed) for c in plan.children()])
+    # unknown node: keep children un-pruned (safe)
+    return plan

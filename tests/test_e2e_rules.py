@@ -244,3 +244,37 @@ class TestCoveringIndexData:
             assert np.all(np.diff(vals) >= 0), f"bucket {b} not sorted"
             for v in np.unique(vals):
                 assert bucket_of_literals([v], 8) == b
+
+
+class TestColumnPruning:
+    """Column pruning pushes required columns to the scans so the join rule
+    sees minimal per-side requirements (Catalyst's ColumnPruning runs before
+    the reference's rules; ref: JoinIndexRule.scala:419-448)."""
+
+    def test_self_join_over_wide_table_uses_index(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("wideJoinIdx", ["c2"], ["c1"]))
+        q = df.join(df, on=["c2"]).select("c1")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda p: True)), plan.pretty()
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_right_side_duplicate_column_survives_pruning(self, session, hs, sample_parquet):
+        """Selecting a '#r'-renamed right-side column must keep working when
+        pruning drops the other side's duplicate."""
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("dupJoinIdx", ["c1"], ["c3"]))
+        q = df.join(df, on=["c1"]).select("c3#r")
+        baseline = q.collect()
+        session.enable_hyperspace()
+        assert_batches_equal(q.collect(), baseline)
+
+    def test_no_rewrite_returns_untouched_plan(self, session, hs, sample_parquet):
+        df = session.read_parquet(sample_parquet)
+        hs.create_index(df, hst.CoveringIndexConfig("unusedIdx", ["c1"], ["c2"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("c3") > 100.0)
+        text = hs.explain(q, mode="console")
+        assert "<----" not in text  # no spurious plan diff when nothing applied
