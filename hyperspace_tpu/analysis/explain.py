@@ -54,46 +54,31 @@ _MODES = {
 
 
 def _subtree_strings(plan: L.LogicalPlan) -> set:
-    out = set()
-
-    def walk(p: L.LogicalPlan) -> None:
-        out.add(p.pretty())
-        for c in p.children():
-            walk(c)
-
-    walk(plan)
-    return out
+    return {p.pretty() for p in L.collect(plan, lambda p: True)}
 
 
 def _pretty_highlighted(plan: L.LogicalPlan, other_subtrees: set, mode: DisplayMode) -> str:
-    """Pretty-print ``plan``, highlighting every maximal subtree that does not
-    appear in the other plan (ref: PlanAnalyzer highlight of differing
-    sub-plans)."""
+    """Pretty-print ``plan``, highlighting each node whose subtree does not
+    appear in the other plan — i.e. the differing region, while identical
+    sub-plans (e.g. the untouched side of a join) stay unmarked
+    (ref: PlanAnalyzer highlight of differing sub-plans)."""
     lines: List[str] = []
 
-    def walk(p: L.LogicalPlan, indent: int, inherited: bool) -> None:
-        differs = inherited or p.pretty() not in other_subtrees
+    def walk(p: L.LogicalPlan, indent: int) -> None:
+        differs = p.pretty() not in other_subtrees
         line = "  " * indent + p.describe()
         if differs:
             line = mode.highlight_begin + line + mode.highlight_end
         lines.append(line)
         for c in p.children():
-            walk(c, indent + 1, differs)
+            walk(c, indent + 1)
 
-    walk(plan, 0, False)
+    walk(plan, 0)
     return "\n".join(lines)
 
 
 def _operator_counts(plan: L.LogicalPlan) -> Counter:
-    c: Counter = Counter()
-
-    def walk(p: L.LogicalPlan) -> None:
-        c[type(p).__name__] += 1
-        for ch in p.children():
-            walk(ch)
-
-    walk(plan)
-    return c
+    return Counter(type(p).__name__ for p in L.collect(plan, lambda p: True))
 
 
 def physical_operator_stats(plan_with: L.LogicalPlan, plan_without: L.LogicalPlan) -> List[Tuple[str, int, int]]:
@@ -128,7 +113,9 @@ def explain_string(df, session, verbose: bool = False, mode: str = "plaintext") 
     the optimizer only (no execution), and diffs the trees)."""
     from hyperspace_tpu.rules.apply import ApplyHyperspace
 
-    dm = _MODES.get(mode, PlainTextMode)()
+    if mode not in _MODES:
+        raise ValueError(f"Unsupported display mode {mode!r}; expected one of {sorted(_MODES)}")
+    dm = _MODES[mode]()
     plan_without = df.plan
     plan_with = ApplyHyperspace(session).apply(plan_without)
 

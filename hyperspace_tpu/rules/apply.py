@@ -51,11 +51,10 @@ class ApplyHyperspace:
             # nothing rewritten — hand back the untouched user plan so explain
             # shows no spurious diff and execution shape is unchanged
             return original, 0
-        if score > 0:
-            used = sorted(
-                {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
-            )
-            get_event_logger(self.session).log_event(
-                HyperspaceIndexUsageEvent(index_names=used, plan_summary=new_plan.describe())
-            )
+        used = sorted(
+            {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
+        )
+        get_event_logger(self.session).log_event(
+            HyperspaceIndexUsageEvent(index_names=used, plan_summary=new_plan.describe())
+        )
         return new_plan, score

@@ -1,0 +1,202 @@
+"""Plan-stability golden suite over TPC-H-shaped tables.
+
+The reference creates all TPC-DS tables as views over empty dirs and compares
+normalized physical-plan trees against approved files, regenerable with
+SPARK_GENERATE_GOLDEN_FILES=1 (ref: goldstandard/TPCDSBase.scala:35-563,
+goldstandard/PlanStabilitySuite.scala:83-290). Here: TPC-H tables as tiny
+parquet datasets, representative index-eligible queries through the full
+optimizer (with covering indexes present), normalized optimized-plan text
+compared against tests/approved_plans/q*.txt; regenerate with
+HS_GENERATE_GOLDEN=1.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu import col
+
+APPROVED_DIR = os.path.join(os.path.dirname(__file__), "approved_plans")
+GENERATE = os.environ.get("HS_GENERATE_GOLDEN", "") == "1"
+
+# TPC-H columns (subset sufficient for the query shapes the IR supports)
+TPCH_SCHEMAS = {
+    "lineitem": {
+        "l_orderkey": np.int64,
+        "l_partkey": np.int64,
+        "l_suppkey": np.int64,
+        "l_quantity": np.int64,
+        "l_extendedprice": np.float64,
+        "l_discount": np.float64,
+        "l_shipdate": "datetime64[D]",
+    },
+    "orders": {
+        "o_orderkey": np.int64,
+        "o_custkey": np.int64,
+        "o_totalprice": np.float64,
+        "o_orderdate": "datetime64[D]",
+    },
+    "customer": {
+        "c_custkey": np.int64,
+        "c_nationkey": np.int64,
+        "c_acctbal": np.float64,
+    },
+    "part": {
+        "p_partkey": np.int64,
+        "p_size": np.int64,
+        "p_retailprice": np.float64,
+    },
+    "partsupp": {
+        "ps_partkey": np.int64,
+        "ps_suppkey": np.int64,
+        "ps_supplycost": np.float64,
+    },
+    "supplier": {
+        "s_suppkey": np.int64,
+        "s_nationkey": np.int64,
+        "s_acctbal": np.float64,
+    },
+    "nation": {"n_nationkey": np.int64, "n_regionkey": np.int64},
+    "region": {"r_regionkey": np.int64},
+}
+
+
+def _write_table(root, name, schema, n=64):
+    import zlib
+
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    cols = {}
+    for cname, dt in schema.items():
+        if dt == "datetime64[D]":
+            cols[cname] = np.datetime64("1995-01-01") + rng.integers(0, 1000, n).astype(
+                "timedelta64[D]"
+            )
+        elif dt is np.float64:
+            cols[cname] = np.round(rng.uniform(0, 1000, n), 4)
+        else:
+            cols[cname] = rng.integers(0, 100, n).astype(np.int64)
+    d = os.path.join(root, name)
+    os.makedirs(d)
+    pq.write_table(pa.table(cols), os.path.join(d, "part-00000.parquet"))
+    return d
+
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpch"))
+    sysp = os.path.join(root, "_indexes")
+    os.makedirs(sysp)
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sysp, hst.keys.NUM_BUCKETS: 4})
+    hst.set_session(sess)
+    hs = hst.Hyperspace(sess)
+    dfs = {}
+    for name, schema in TPCH_SCHEMAS.items():
+        d = _write_table(root, name, schema)
+        dfs[name] = sess.read_parquet(d)
+
+    # the indexes the benchmark configs use (BASELINE.md configs 2-3)
+    hs.create_index(
+        dfs["lineitem"],
+        hst.CoveringIndexConfig("li_shipdate", ["l_shipdate"], ["l_orderkey", "l_extendedprice", "l_discount"]),
+    )
+    hs.create_index(
+        dfs["lineitem"],
+        hst.CoveringIndexConfig("li_orderkey", ["l_orderkey"], ["l_extendedprice", "l_discount", "l_quantity"]),
+    )
+    hs.create_index(
+        dfs["orders"], hst.CoveringIndexConfig("o_orderkey", ["o_orderkey"], ["o_custkey", "o_totalprice"])
+    )
+    hs.create_index(
+        dfs["orders"], hst.CoveringIndexConfig("o_custkey", ["o_custkey"], ["o_orderkey"])
+    )
+    hs.create_index(
+        dfs["customer"], hst.CoveringIndexConfig("c_custkey", ["c_custkey"], ["c_nationkey", "c_acctbal"])
+    )
+    hs.create_index(
+        dfs["part"], hst.CoveringIndexConfig("p_partkey", ["p_partkey"], ["p_size"])
+    )
+    hs.create_index(
+        dfs["partsupp"], hst.CoveringIndexConfig("ps_partkey", ["ps_partkey"], ["ps_supplycost"])
+    )
+    sess.enable_hyperspace()
+    yield sess, hs, dfs, root
+    hst.set_session(None)
+
+
+def _queries(dfs):
+    li, o, c, p, ps = dfs["lineitem"], dfs["orders"], dfs["customer"], dfs["part"], dfs["partsupp"]
+    ship = np.datetime64("1995-06-15")
+    return {
+        # filter-rule shapes (BASELINE config 2)
+        "q01_filter_eq": li.filter(col("l_shipdate") == ship).select("l_orderkey", "l_extendedprice"),
+        "q02_filter_range": li.filter((col("l_shipdate") >= ship) & (col("l_shipdate") < ship + 30)).select(
+            "l_extendedprice", "l_discount"
+        ),
+        "q03_filter_nonindexed": li.filter(col("l_quantity") > 40).select("l_orderkey"),
+        # join-rule shapes (BASELINE config 3)
+        "q04_join_li_orders": li.join(o, on=col("l_orderkey") == col("o_orderkey")).select(
+            "l_extendedprice", "o_totalprice"
+        ),
+        "q05_join_orders_customer": o.join(c, on=col("o_custkey") == col("c_custkey")).select(
+            "o_totalprice", "c_acctbal"
+        ),
+        "q06_join_filter": li.filter(col("l_quantity") > 10)
+        .join(o, on=col("l_orderkey") == col("o_orderkey"))
+        .select("l_quantity", "o_totalprice"),
+        "q07_join_part_partsupp": p.join(ps, on=col("p_partkey") == col("ps_partkey")).select(
+            "p_size", "ps_supplycost"
+        ),
+        "q08_three_way": li.join(o, on=col("l_orderkey") == col("o_orderkey"))
+        .join(c, on=col("o_custkey") == col("c_custkey"))
+        .select("l_extendedprice", "c_acctbal"),
+        "q09_self_join": li.join(li, on=["l_orderkey"]).select("l_extendedprice"),
+        "q10_no_index_join": dfs["supplier"]
+        .join(dfs["nation"], on=col("s_nationkey") == col("n_nationkey"))
+        .select("s_acctbal"),
+    }
+
+
+def _normalize(text: str, root: str) -> str:
+    return text.replace(root, "<TPCH>")
+
+
+@pytest.mark.parametrize("qname", [
+    "q01_filter_eq", "q02_filter_range", "q03_filter_nonindexed", "q04_join_li_orders",
+    "q05_join_orders_customer", "q06_join_filter", "q07_join_part_partsupp",
+    "q08_three_way", "q09_self_join", "q10_no_index_join",
+])
+def test_plan_stability(tpch, qname):
+    sess, hs, dfs, root = tpch
+    q = _queries(dfs)[qname]
+    plan_text = _normalize(q.optimized_plan().pretty(), root)
+    path = os.path.join(APPROVED_DIR, f"{qname}.txt")
+    if GENERATE:
+        os.makedirs(APPROVED_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(plan_text)
+        return
+    with open(path) as f:
+        expected = f.read()
+    assert plan_text == expected, (
+        f"plan for {qname} changed; review and regen with HS_GENERATE_GOLDEN=1\n{plan_text}"
+    )
+
+
+def test_all_queries_execute(tpch):
+    """Every stability query also executes and matches its no-index results
+    (the reference's checkAnswer side of the suite)."""
+    sess, hs, dfs, root = tpch
+    for name, q in _queries(dfs).items():
+        sess.disable_hyperspace()
+        base = q.collect()
+        sess.enable_hyperspace()
+        got = q.collect()
+        for k in base:
+            a = np.sort(np.asarray(base[k], dtype=object if base[k].dtype == object else None))
+            b = np.sort(np.asarray(got[k], dtype=object if got[k].dtype == object else None))
+            assert a.shape == b.shape, (name, k, a.shape, b.shape)
+            np.testing.assert_array_equal(a, b, err_msg=f"{name}.{k}")
