@@ -29,18 +29,23 @@ shard_map = get_shard_map()
 from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
 
 
-def _stage_for_exchange(values, dest, n_dev: int, capacity: int, fill=0):
+def _stage_for_exchange(values, dest, n_dev: int, capacity: int, fill=0, valid=None):
     """Scatter local rows into a (n_dev, capacity) staging grid keyed by
-    destination device; rows beyond capacity are dropped (and counted)."""
+    destination device; rows beyond capacity are dropped (and counted).
+    ``valid`` (optional bool mask) excludes padding rows from the exchange —
+    needed when staging the output of a previous exchange phase."""
     n_loc = dest.shape[0]
+    if valid is not None:
+        dest = jnp.where(valid, dest, n_dev)  # invalid rows -> scratch bin
     order = jnp.argsort(dest, stable=True)
     dest_sorted = dest[order]
-    counts = jnp.bincount(dest, length=n_dev)
+    counts = jnp.bincount(dest, length=n_dev)  # scratch bin excluded
     offsets = jnp.cumsum(counts) - counts
-    rank = jnp.arange(n_loc) - offsets[dest_sorted]
-    valid = rank < capacity
-    slot = dest_sorted * capacity + jnp.clip(rank, 0, capacity - 1)
-    slot = jnp.where(valid, slot, n_dev * capacity)  # overflow -> scratch slot
+    offsets_ext = jnp.concatenate([offsets, jnp.zeros((1,), offsets.dtype)])
+    rank = jnp.arange(n_loc) - offsets_ext[jnp.minimum(dest_sorted, n_dev)]
+    in_slot = (dest_sorted < n_dev) & (rank < capacity)
+    slot = jnp.minimum(dest_sorted, n_dev - 1) * capacity + jnp.clip(rank, 0, capacity - 1)
+    slot = jnp.where(in_slot, slot, n_dev * capacity)  # overflow/invalid -> scratch
 
     staged = []
     for v in values:
@@ -48,7 +53,7 @@ def _stage_for_exchange(values, dest, n_dev: int, capacity: int, fill=0):
         buf = jnp.full((n_dev * capacity + 1,), fill, dtype=v.dtype)
         buf = buf.at[slot].set(v_sorted)
         staged.append(buf[:-1].reshape(n_dev, capacity))
-    mask = jnp.zeros((n_dev * capacity + 1,), dtype=bool).at[slot].set(valid)
+    mask = jnp.zeros((n_dev * capacity + 1,), dtype=bool).at[slot].set(in_slot)
     return staged, mask[:-1].reshape(n_dev, capacity), counts
 
 
@@ -154,3 +159,78 @@ def rebucket_and_sort(
     sorted_buckets, sorted_valid = sorted_res[0], sorted_res[1]
     sorted_arrays = dict(zip(list(arrays), sorted_res[2:]))
     return sorted_arrays, sorted_buckets, sorted_valid, overflow
+
+
+def rebucket_hierarchical(
+    mesh: Mesh,
+    arrays: Dict[str, "jax.Array"],
+    bucket_ids: "jax.Array",
+    capacity_ici: int,
+    capacity_dcn: int,
+) -> Tuple[Dict[str, "jax.Array"], "jax.Array", "jax.Array", "jax.Array"]:
+    """Two-phase re-bucketing over a 2-D (dcn, ici) mesh: rows first hop to
+    their owner's *local position* within their own slice (all_to_all over
+    ICI), then one hop across slices (all_to_all over DCN) — so each row
+    crosses the slow inter-slice link exactly once and all position routing
+    rides ICI (SURVEY.md §5.8 "cross-slice (DCN) handled by hierarchical
+    all-to-all").
+
+    Owner of bucket b on an (S, L) mesh: global device g = b % (S*L),
+    slice s = g // L, local position l = g % L.
+
+    Returns (out_arrays, out_buckets, valid_mask, overflow) per global device
+    shard, like ``rebucket``; ``overflow`` sums drops from both phases.
+    """
+    dcn_axis, ici_axis = mesh.axis_names
+    S = mesh.shape[dcn_axis]
+    L = mesh.shape[ici_axis]
+    n_dev = S * L
+    names = list(arrays)
+    values = [arrays[n] for n in names]
+    both = (dcn_axis, ici_axis)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(both),) * (len(values) + 1),
+        out_specs=(P(both),) * (len(values) + 3),
+    )
+    def exchange(*args):
+        *vals, buckets = args
+        owner = (buckets % n_dev).astype(jnp.int32)
+
+        # -- phase 1 (ICI): route to the owner's local position in this slice
+        dest_local = owner % L
+        staged, mask, counts = _stage_for_exchange([*vals, buckets], dest_local, L, capacity_ici)
+        sent = jnp.minimum(counts, capacity_ici)
+        overflow = jnp.sum(counts - sent)
+        mid = [
+            jax.lax.all_to_all(s, ici_axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+            for s in staged
+        ]
+        mid_mask = jax.lax.all_to_all(
+            mask, ici_axis, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(-1)
+
+        # -- phase 2 (DCN): route to the owner slice; local position is kept
+        *mid_vals, mid_buckets = mid
+        dest_slice = ((mid_buckets % n_dev) // L).astype(jnp.int32)
+        staged2, mask2, counts2 = _stage_for_exchange(
+            [*mid_vals, mid_buckets], dest_slice, S, capacity_dcn, valid=mid_mask
+        )
+        sent2 = jnp.minimum(counts2, capacity_dcn)
+        overflow = overflow + jnp.sum(counts2 - sent2)
+        out = [
+            jax.lax.all_to_all(s, dcn_axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+            for s in staged2
+        ]
+        out_mask = jax.lax.all_to_all(
+            mask2, dcn_axis, split_axis=0, concat_axis=0, tiled=True
+        ).reshape(-1)
+        *out_vals, out_buckets = out
+        return (*out_vals, out_buckets, out_mask, overflow[None])
+
+    results = exchange(*values, bucket_ids)
+    out_arrays = dict(zip(names, results[: len(names)]))
+    out_buckets, valid, overflow = results[len(names)], results[len(names) + 1], results[len(names) + 2]
+    return out_arrays, out_buckets, valid, overflow

@@ -46,3 +46,33 @@ def sharded(mesh: Mesh, axis: Optional[str] = None) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def make_mesh_2d(n_slices: Optional[int] = None, per_slice: Optional[int] = None) -> Mesh:
+    """2-D (dcn, ici) mesh for multi-slice / multi-host topologies: the ici
+    axis spans devices within a slice (fast interconnect), the dcn axis spans
+    slices (data-center network). On a multi-host runtime the slice count
+    defaults to ``jax.process_count()`` so the dcn axis aligns with host
+    boundaries and XLA keeps phase-1 all_to_all traffic on ICI
+    (SURVEY.md §5.8)."""
+    devices = jax.devices()
+    if n_slices is None:
+        n_slices = max(1, jax.process_count())
+    if per_slice is None:
+        if len(devices) % n_slices:
+            raise ValueError(
+                f"{len(devices)} devices do not divide evenly into {n_slices} slices; "
+                "pass per_slice explicitly"
+            )
+        per_slice = len(devices) // n_slices
+    if n_slices * per_slice > len(devices):
+        raise ValueError(
+            f"requested {n_slices}x{per_slice} mesh but only {len(devices)} devices are available"
+        )
+    grid = np.array(devices[: n_slices * per_slice]).reshape(n_slices, per_slice)
+    return Mesh(grid, ("dcn", "ici"))
+
+
+def sharded_2d(mesh: Mesh) -> NamedSharding:
+    """Row sharding of a 1-D array across every device of a 2-D mesh."""
+    return NamedSharding(mesh, PartitionSpec(tuple(mesh.axis_names)))
