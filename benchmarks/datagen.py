@@ -1,0 +1,86 @@
+"""TPC-H-like data generation for the benchmark configs (BASELINE.md).
+
+Not the official dbgen: columns and value distributions follow the TPC-H
+schema shapes the queries need (lineitem, orders), sized by a scale factor
+where SF 1 ~= 6M lineitem rows, matching TPC-H's row-count scaling.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+LINEITEM_ROWS_SF1 = 6_000_000
+ORDERS_ROWS_SF1 = 1_500_000
+
+
+def gen_lineitem(root: str, sf: float, num_files: int = 16, seed: int = 0) -> str:
+    d = os.path.join(root, "lineitem")
+    os.makedirs(d, exist_ok=True)
+    n = int(LINEITEM_ROWS_SF1 * sf)
+    per = max(1, n // num_files)
+    rng = np.random.default_rng(seed)
+    base = np.datetime64("1992-01-01")
+    n_orders = max(1, int(ORDERS_ROWS_SF1 * sf))
+    for i in range(num_files):
+        rows = per if i < num_files - 1 else n - per * (num_files - 1)
+        if rows <= 0:
+            continue
+        t = pa.table(
+            {
+                "l_orderkey": rng.integers(0, n_orders, rows).astype(np.int64),
+                "l_partkey": rng.integers(0, int(200_000 * max(sf, 0.01)), rows).astype(np.int64),
+                "l_quantity": rng.integers(1, 51, rows).astype(np.int64),
+                "l_extendedprice": np.round(rng.uniform(900.0, 105000.0, rows), 2),
+                "l_discount": np.round(rng.uniform(0.0, 0.1, rows), 2),
+                "l_tax": np.round(rng.uniform(0.0, 0.08, rows), 2),
+                "l_shipdate": base + rng.integers(0, 2526, rows).astype("timedelta64[D]"),
+            }
+        )
+        pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+    return d
+
+
+def gen_orders(root: str, sf: float, num_files: int = 8, seed: int = 1) -> str:
+    d = os.path.join(root, "orders")
+    os.makedirs(d, exist_ok=True)
+    n = max(1, int(ORDERS_ROWS_SF1 * sf))
+    per = max(1, n // num_files)
+    rng = np.random.default_rng(seed)
+    base = np.datetime64("1992-01-01")
+    for i in range(num_files):
+        rows = per if i < num_files - 1 else n - per * (num_files - 1)
+        if rows <= 0:
+            continue
+        t = pa.table(
+            {
+                "o_orderkey": np.arange(i * per, i * per + rows, dtype=np.int64),
+                "o_custkey": rng.integers(0, int(150_000 * max(sf, 0.01)), rows).astype(np.int64),
+                "o_totalprice": np.round(rng.uniform(800.0, 600000.0, rows), 2),
+                "o_orderdate": base + rng.integers(0, 2406, rows).astype("timedelta64[D]"),
+            }
+        )
+        pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+    return d
+
+
+def gen_sample(root: str, n: int = 100_000, num_files: int = 4, seed: int = 2) -> str:
+    """Small sample dataset for config 1 (the reference's examples/ data shape)."""
+    d = os.path.join(root, "sample")
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    per = n // num_files
+    for i in range(num_files):
+        t = pa.table(
+            {
+                "id": rng.integers(0, n, per).astype(np.int64),
+                "dept": rng.integers(0, 50, per).astype(np.int64),
+                "value": rng.standard_normal(per),
+                "name": np.array([f"emp_{j % 991}" for j in range(per)]),
+            }
+        )
+        pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+    return d

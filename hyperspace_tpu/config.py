@@ -37,6 +37,7 @@ class keys:
     TPU_MESH_AXIS = "hyperspace.tpu.mesh.axis"
     TPU_BUILD_BATCH_ROWS = "hyperspace.tpu.build.batchRows"
     TPU_QUERY_DEVICE_EXECUTION = "hyperspace.tpu.query.deviceExecution"
+    TPU_QUERY_DEVICE_MIN_ROWS = "hyperspace.tpu.query.deviceMinRows"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -68,6 +69,10 @@ DEFAULTS: Dict[str, Any] = {
     keys.TPU_MESH_AXIS: "buckets",
     keys.TPU_BUILD_BATCH_ROWS: 1 << 22,
     keys.TPU_QUERY_DEVICE_EXECUTION: True,
+    # Below this many rows a host<->device round trip costs more than the
+    # compute it offloads; the executor keeps small batches on host. Tune to 0
+    # on co-located TPU hosts where the whole pipeline stays device-resident.
+    keys.TPU_QUERY_DEVICE_MIN_ROWS: 1 << 25,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -203,6 +208,10 @@ class HyperspaceConf:
     @property
     def device_execution_enabled(self) -> bool:
         return bool(self.get(keys.TPU_QUERY_DEVICE_EXECUTION))
+
+    @property
+    def device_exec_min_rows(self) -> int:
+        return int(self.get(keys.TPU_QUERY_DEVICE_MIN_ROWS))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

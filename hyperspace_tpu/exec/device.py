@@ -26,7 +26,8 @@ host executor (exec/executor.py) runs the plan instead — mirroring how
 
 from __future__ import annotations
 
-from functools import partial
+import os
+from functools import lru_cache, partial
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -113,18 +114,75 @@ def _literal_numeric(codec: ColumnCodec, value):
 
 # --------------------------------------------------------------------------
 # predicate compiler: Expr tree -> jnp program over encoded columns
+#
+# Literal values (and string-dictionary code bounds, which change per batch)
+# are *runtime arguments* of the compiled program, not trace-time constants,
+# so two queries that differ only in their constants (or dictionaries) hit
+# the same XLA executable. The jitted program is cached per predicate
+# *skeleton* (structure + column kinds, no literal values).
 # --------------------------------------------------------------------------
 
 _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
 
 
+class _LitSlots:
+    """Collects literal values during compilation; each gets a slot index in
+    the ``lits`` tuple passed to the compiled program at call time."""
+
+    def __init__(self):
+        self.values: List = []
+
+    def add(self, value) -> int:
+        self.values.append(value)
+        return len(self.values) - 1
+
+
+def predicate_skeleton(expr: Expr, codecs: Dict[str, ColumnCodec]) -> str:
+    """Canonical structure of ``expr`` with literal *values* erased — the
+    cache key for the jitted program (literals are runtime args)."""
+
+    def lit_tag(v) -> str:
+        if isinstance(v, str):
+            return "s"
+        if isinstance(v, (bool, np.bool_)):
+            return "b"
+        if isinstance(v, (int, np.integer)):
+            return "i"
+        if isinstance(v, np.datetime64):
+            return "d"
+        return "f"
+
+    def rec(e: Expr) -> str:
+        if isinstance(e, Col):
+            return f"c:{e.name}:{codecs[e.name].kind if e.name in codecs else '?'}"
+        if isinstance(e, Lit):
+            return f"l:{lit_tag(e.value)}"
+        if isinstance(e, BinaryOp):
+            return f"({rec(e.left)}{e.op}{rec(e.right)})"
+        if isinstance(e, Not):
+            return f"!({rec(e.child)})"
+        if isinstance(e, IsNull):
+            return f"isnull({rec(e.child)})"
+        if isinstance(e, In):
+            return f"in({rec(e.child)},[{','.join(rec(v) for v in e.values)}])"
+        if isinstance(e, InputFileName):
+            return "input_file_name()"
+        return f"{type(e).__name__}({','.join(rec(c) for c in e.children())})"
+
+    return rec(expr)
+
+
 def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
-    """Compile ``expr`` into ``f(cols: dict[str, jnp.ndarray]) -> bool mask``.
+    """Compile ``expr`` into ``(f, lit_values)`` where
+    ``f(cols: dict[str, jnp.ndarray], lits: tuple) -> bool mask`` and
+    ``lit_values`` is the concrete argument tuple for this query.
 
     Raises DeviceUnsupported for shapes outside the device language (string
     arithmetic, input_file_name(), col-vs-col string compares, ...).
     """
     import jax.numpy as jnp
+
+    slots = _LitSlots()
 
     def is_string_col(e: Expr) -> bool:
         return isinstance(e, Col) and codecs[e.name].kind == "string"
@@ -136,19 +194,20 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
             if codec.kind == "string":
                 raise DeviceUnsupported("string column used in numeric context")
             name = e.name
-            return lambda cols: cols[name]
+            return lambda cols, lits: cols[name]
         if isinstance(e, Lit):
             v = e.value
             if isinstance(v, str):
                 raise DeviceUnsupported("string literal in numeric context")
             if isinstance(v, np.datetime64):
                 v = int(v.view("int64"))
-            return lambda cols, v=v: v
+            i = slots.add(_as_lit_scalar(v))
+            return lambda cols, lits: lits[i]
         if isinstance(e, BinaryOp) and e.op in ("+", "-", "*", "/", "%"):
             lf, rf = build_num(e.left), build_num(e.right)
             op = e.op
-            def f(cols):
-                l, r = lf(cols), rf(cols)
+            def f(cols, lits):
+                l, r = lf(cols, lits), rf(cols, lits)
                 if op == "+":
                     return l + r
                 if op == "-":
@@ -166,42 +225,44 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
         if codec.kind != "string" or not isinstance(lit_value, str):
             # mixed-type compares have host-defined semantics; don't guess
             raise DeviceUnsupported("string compare requires string column and string literal")
-        lo, hi = _literal_bounds(codec, lit_value)
+        lo_v, hi_v = _literal_bounds(codec, lit_value)
+        lo = slots.add(np.int32(lo_v))
+        hi = slots.add(np.int32(hi_v))
         name = col.name
         if op == "=":
-            return lambda cols: (cols[name] >= lo) & (cols[name] < hi)
+            return lambda cols, lits: (cols[name] >= lits[lo]) & (cols[name] < lits[hi])
         if op == "!=":
             # null codes (-1) satisfy != like the host's elementwise None != "x"
-            return lambda cols: (cols[name] < lo) | (cols[name] >= hi)
+            return lambda cols, lits: (cols[name] < lits[lo]) | (cols[name] >= lits[hi])
         if op == "<":
-            return lambda cols: (cols[name] < lo) & (cols[name] >= 0)
+            return lambda cols, lits: (cols[name] < lits[lo]) & (cols[name] >= 0)
         if op == "<=":
-            return lambda cols: (cols[name] < hi) & (cols[name] >= 0)
+            return lambda cols, lits: (cols[name] < lits[hi]) & (cols[name] >= 0)
         if op == ">":
-            return lambda cols: cols[name] >= hi
+            return lambda cols, lits: cols[name] >= lits[hi]
         if op == ">=":
-            return lambda cols: cols[name] >= lo
+            return lambda cols, lits: cols[name] >= lits[lo]
         raise DeviceUnsupported(f"unsupported string compare {op}")
 
     def build_bool(e: Expr):
         if isinstance(e, BinaryOp) and e.op in ("AND", "OR"):
             lf, rf = build_bool(e.left), build_bool(e.right)
             if e.op == "AND":
-                return lambda cols: lf(cols) & rf(cols)
-            return lambda cols: lf(cols) | rf(cols)
+                return lambda cols, lits: lf(cols, lits) & rf(cols, lits)
+            return lambda cols, lits: lf(cols, lits) | rf(cols, lits)
         if isinstance(e, Not):
             cf = build_bool(e.child)
-            return lambda cols: ~cf(cols)
+            return lambda cols, lits: ~cf(cols, lits)
         if isinstance(e, IsNull):
             c = e.child
             if isinstance(c, Col):
                 codec = codecs[c.name]
                 name = c.name
                 if codec.kind == "string":
-                    return lambda cols: cols[name] < 0
+                    return lambda cols, lits: cols[name] < 0
                 if codec.kind == "numeric":
-                    return lambda cols: jnp.isnan(cols[name]) if cols[name].dtype == jnp.float64 else jnp.zeros(cols[name].shape, bool)
-                return lambda cols: jnp.zeros(cols[name].shape, bool)
+                    return lambda cols, lits: jnp.isnan(cols[name]) if cols[name].dtype == jnp.float64 else jnp.zeros(cols[name].shape, bool)
+                return lambda cols, lits: jnp.zeros(cols[name].shape, bool)
             raise DeviceUnsupported("IS NULL on non-column")
         if isinstance(e, In):
             child = e.child
@@ -222,11 +283,12 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                 else:
                     cf = build_num(child)
                     num = _literal_numeric(codecs[child.name], val)
-                    terms.append(lambda cols, cf=cf, num=num: cf(cols) == num)
-            def f(cols):
-                m = terms[0](cols)
+                    i = slots.add(_as_lit_scalar(num))
+                    terms.append(lambda cols, lits, cf=cf, i=i: cf(cols, lits) == lits[i])
+            def f(cols, lits):
+                m = terms[0](cols, lits)
                 for t in terms[1:]:
-                    m = m | t(cols)
+                    m = m | t(cols, lits)
                 return m
             return f
         if isinstance(e, BinaryOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
@@ -242,7 +304,8 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                     return string_compare(left, op, right.value)
                 lf = build_num(left)
                 val = _literal_numeric(codec, right.value)
-                return _compare(lf, lambda cols, val=val: val, op)
+                i = slots.add(_as_lit_scalar(val))
+                return _compare(lf, lambda cols, lits: lits[i], op)
             # general numeric compare (col-vs-col, arithmetic)
             return _compare(build_num(left), build_num(right), op)
         if isinstance(e, InputFileName):
@@ -251,18 +314,31 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
 
     def _compare(lf, rf, op: str):
         if op == "=":
-            return lambda cols: lf(cols) == rf(cols)
+            return lambda cols, lits: lf(cols, lits) == rf(cols, lits)
         if op == "!=":
-            return lambda cols: lf(cols) != rf(cols)
+            return lambda cols, lits: lf(cols, lits) != rf(cols, lits)
         if op == "<":
-            return lambda cols: lf(cols) < rf(cols)
+            return lambda cols, lits: lf(cols, lits) < rf(cols, lits)
         if op == "<=":
-            return lambda cols: lf(cols) <= rf(cols)
+            return lambda cols, lits: lf(cols, lits) <= rf(cols, lits)
         if op == ">":
-            return lambda cols: lf(cols) > rf(cols)
-        return lambda cols: lf(cols) >= rf(cols)
+            return lambda cols, lits: lf(cols, lits) > rf(cols, lits)
+        return lambda cols, lits: lf(cols, lits) >= rf(cols, lits)
 
-    return build_bool(expr)
+    fn = build_bool(expr)
+    return fn, tuple(slots.values)
+
+
+def _as_lit_scalar(v):
+    """Fix the dtype a literal is passed with (jit traces lits as 0-d arrays;
+    a stable dtype per slot keeps the executable cache warm)."""
+    if isinstance(v, np.generic):
+        return v
+    if isinstance(v, bool):
+        return np.int64(v)
+    if isinstance(v, int):
+        return np.int64(v)
+    return np.float64(v)
 
 
 # --------------------------------------------------------------------------
@@ -279,12 +355,60 @@ def _pad_to_multiple(arr: np.ndarray, m: int, fill) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
-def device_filter_mask(session, batch: B.Batch, condition: Expr) -> np.ndarray:
+# skeleton -> jitted predicate program; the jit object is reused across
+# queries so only genuinely new predicate *structures* pay an XLA compile
+from collections import OrderedDict as _OrderedDict
+
+_PREDICATE_CACHE: "_OrderedDict[str, callable]" = _OrderedDict()
+_PREDICATE_CACHE_MAX = 256
+
+# (scan identity, column, n_dev) -> (sharded device array, codec, n_rows).
+# Index bucket files are immutable (versioned v__=N dirs), so predicate
+# columns stay resident in HBM across queries — the survey's "index
+# column-chunks resident in HBM" stance (SURVEY.md §3.2); only the first
+# query on an index version pays the host->device transfer.
+from hyperspace_tpu.utils.lru import BytesLRU
+
+_device_cache = BytesLRU(int(os.environ.get("HS_DEVICE_CACHE_BYTES", 1 << 31)))
+
+
+def _device_cache_get(key):
+    return _device_cache.get(key)
+
+
+def _device_cache_put(key, value, nbytes: int) -> None:
+    # overwrite semantics matter: a stale same-key entry (e.g. rows changed)
+    # must be replaced, not pinned
+    _device_cache.put(key, value, nbytes)
+
+
+def clear_device_cache() -> None:
+    _device_cache.clear()
+
+
+def _cached_predicate_jit(skeleton: str, fn):
+    import jax
+
+    jitted = _PREDICATE_CACHE.get(skeleton)
+    if jitted is None:
+        while len(_PREDICATE_CACHE) >= _PREDICATE_CACHE_MAX:
+            _PREDICATE_CACHE.popitem(last=False)
+        jitted = jax.jit(fn)
+        _PREDICATE_CACHE[skeleton] = jitted
+    else:
+        _PREDICATE_CACHE.move_to_end(skeleton)
+    return jitted
+
+
+def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) -> np.ndarray:
     """Evaluate ``condition`` on device over the referenced columns of
     ``batch``; returns the host bool mask. Raises DeviceUnsupported when the
-    predicate is outside the device language."""
+    predicate is outside the device language.
+
+    ``scan_key`` identifies an immutable file set (IndexScan bucket files);
+    when given, encoded predicate columns are kept resident on device across
+    queries."""
     import jax
-    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     refs = sorted(condition.references())
@@ -295,22 +419,52 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr) -> np.ndarray:
     if n == 0:
         return np.zeros(0, dtype=bool)
 
-    encoded: Dict[str, np.ndarray] = {}
-    codecs: Dict[str, ColumnCodec] = {}
-    for r in refs:
-        encoded[r], codecs[r] = encode_column(batch[r])
-    fn = compile_predicate(condition, codecs)
-
     mesh = session.mesh
     n_dev = mesh.devices.size
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
-    dev_cols = {
-        k: jax.device_put(_pad_to_multiple(v, n_dev, 0 if v.dtype != np.float64 else np.nan), sharding)
-        for k, v in encoded.items()
-    }
 
-    mask = jax.jit(fn)(dev_cols)
+    dev_cols: Dict[str, "jax.Array"] = {}
+    codecs: Dict[str, ColumnCodec] = {}
+    missing: List[str] = []
+    for r in refs:
+        ckey = (scan_key, r, n_dev) if scan_key is not None else None
+        cached = _device_cache_get(ckey) if ckey is not None else None
+        if cached is not None and cached[2] == n:
+            dev_cols[r], codecs[r] = cached[0], cached[1]
+        else:
+            missing.append(r)
+
+    if missing:
+        # reject unsupported predicates BEFORE encoding/transferring the
+        # missing columns — an unsupported shape must not cost HBM space or
+        # a wasted upload. Dry codecs carry only the dtype kind (string
+        # bounds resolve to 0 here; values are discarded).
+        dry_codecs: Dict[str, ColumnCodec] = {}
+        for r in refs:
+            kind = batch[r].dtype.kind
+            if kind in ("U", "S", "O"):
+                dry_codecs[r] = ColumnCodec("string", uniques=np.empty(0, dtype=str))
+            elif kind == "M":
+                dry_codecs[r] = ColumnCodec("datetime", unit=np.datetime_data(batch[r].dtype)[0])
+            elif kind in ("i", "u", "b", "f"):
+                dry_codecs[r] = ColumnCodec("numeric")
+            else:
+                raise DeviceUnsupported(f"unsupported column dtype {batch[r].dtype}")
+        compile_predicate(condition, dry_codecs)
+
+        for r in missing:
+            arr, codec = encode_column(batch[r])
+            padded = _pad_to_multiple(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
+            dev = jax.device_put(padded, sharding)
+            dev_cols[r] = dev
+            codecs[r] = codec
+            if scan_key is not None:
+                _device_cache_put((scan_key, r, n_dev), (dev, codec, n), int(padded.nbytes))
+
+    fn, lit_values = compile_predicate(condition, codecs)
+    jitted = _cached_predicate_jit(predicate_skeleton(condition, codecs), fn)
+    mask = jitted(dev_cols, lit_values)
     return np.asarray(mask)[:n]
 
 
@@ -389,24 +543,86 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str]
     return out
 
 
-def device_bucketed_join(session, plan: L.Join) -> B.Batch:
-    """Execute a compatible bucketed equi-join on device.
-
-    Per-bucket sorted runs of both sides are padded to rectangles, sharded over
-    the mesh's bucket axis, and each device computes, for every left row, the
-    [lo, hi) span of matching right rows via two vmapped ``searchsorted``
-    passes — no collective is emitted (the reference's no-exchange SMJ,
-    HS/index/covering/JoinIndexRule.scala:604-618). Pair expansion and column
-    gathering happen host-side (variable-size output).
-    """
+@lru_cache(maxsize=32)
+def _bucketed_span_program(mesh, axis: str):
+    """Jitted per-bucket match-span program, cached per mesh so repeated joins
+    reuse one XLA executable (jit's own cache handles shape variation)."""
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
     from hyperspace_tpu.parallel.mesh import get_shard_map
 
     shard_map = get_shard_map()
 
+    @jax.jit
+    def spans(lm, rm):
+        @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)))
+        def per_shard(lm_, rm_):
+            lo = jax.vmap(lambda lk, rk: jnp.searchsorted(rk, lk, side="left"))(lm_, rm_)
+            hi = jax.vmap(lambda lk, rk: jnp.searchsorted(rk, lk, side="right"))(lm_, rm_)
+            return lo, hi
+        return per_shard(lm, rm)
+
+    return spans
+
+
+def _join_key_of(batch: B.Batch, key: str) -> np.ndarray:
+    """Encode a join-key column; only identity-ordered encodings are
+    cross-side comparable."""
+    arr = batch[key]
+    if arr.dtype.kind in ("i", "u", "b"):
+        return arr.astype(np.int64)
+    if arr.dtype.kind == "M":
+        return arr.view("int64").astype(np.int64)
+    raise DeviceUnsupported(f"device join requires integer/datetime keys; got {arr.dtype}")
+
+
+_FOOTER_ROWS_CACHE: Dict[Tuple[str, int, int], int] = {}
+
+
+def _file_num_rows(path: str) -> int:
+    """Row count from the parquet footer, memoized on (path, mtime, size)."""
+    import pyarrow.parquet as pq
+
+    st = os.stat(path)
+    key = (path, st.st_mtime_ns, st.st_size)
+    got = _FOOTER_ROWS_CACHE.get(key)
+    if got is None:
+        if len(_FOOTER_ROWS_CACHE) > 65536:
+            _FOOTER_ROWS_CACHE.clear()
+        got = pq.read_metadata(path).num_rows
+        _FOOTER_ROWS_CACHE[key] = got
+    return got
+
+
+def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
+    """Single entry point for the bucketed-SMJ paths: one compatibility
+    analysis, then device or host spans by the input-rows threshold.
+    Raises DeviceUnsupported when the join isn't a compatible bucketed pair
+    (the executor then falls back to its generic merge join)."""
     compat = join_sides_compatible(plan)
+    if compat is None:
+        raise DeviceUnsupported("join sides are not compatible bucketed index scans")
+    total = 0
+    for scan in (compat[0], compat[1]):
+        for f in scan.files:
+            try:
+                total += _file_num_rows(f)
+            except OSError:
+                total = 0
+                break
+    if total >= session.conf.device_exec_min_rows:
+        return device_bucketed_join(session, plan, _compat=compat)
+    return host_bucketed_join(session, plan, _compat=compat)
+
+
+def _bucketed_join_setup(plan: L.Join, compat=None):
+    """Shared validation + per-bucket decode for the bucketed SMJ paths.
+
+    Returns (lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed).
+    """
+    if compat is None:
+        compat = join_sides_compatible(plan)
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
     lscan, rscan, lkeys, rkeys = compat
@@ -435,66 +651,40 @@ def device_bucketed_join(session, plan: L.Join) -> B.Batch:
     lbuckets = _read_buckets(lscan, lcols_needed, sort_key=lkey)
     rbuckets = _read_buckets(rscan, rcols_needed, sort_key=rkey)
     nb = lscan.bucket_spec.num_buckets
+    return lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed
 
-    # Encode keys; only identity-ordered encodings are cross-side comparable.
-    def key_of(batch: B.Batch, key: str) -> np.ndarray:
-        arr = batch[key]
-        if arr.dtype.kind in ("i", "u", "b"):
-            return arr.astype(np.int64)
-        if arr.dtype.kind == "M":
-            return arr.view("int64").astype(np.int64)
-        raise DeviceUnsupported(f"device join requires integer/datetime keys; got {arr.dtype}")
 
-    SENTINEL = np.int64(2**62)
-    mesh = session.mesh
-    n_dev = mesh.devices.size
-    axis = mesh.axis_names[0]
-    nb_padded = nb + ((-nb) % n_dev)
-
-    def stack_side(buckets: Dict[int, B.Batch], key: str):
-        lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
-        width = max(max(lens), 1)
-        keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
-        for b in range(nb_padded):
-            if lens[b]:
-                keys_mat[b, : lens[b]] = key_of(buckets[b], key)
-        return keys_mat, np.asarray(lens, dtype=np.int64)
-
-    lmat, llens = stack_side(lbuckets, lkey)
-    rmat, rlens = stack_side(rbuckets, rkey)
-
-    sharding = NamedSharding(mesh, P(axis))
-
-    @jax.jit
-    def spans(lm, rm):
-        @partial(shard_map, mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=(P(axis), P(axis)))
-        def per_shard(lm_, rm_):
-            lo = jax.vmap(lambda lk, rk: jnp.searchsorted(rk, lk, side="left"))(lm_, rm_)
-            hi = jax.vmap(lambda lk, rk: jnp.searchsorted(rk, lk, side="right"))(lm_, rm_)
-            return lo, hi
-        return per_shard(lm, rm)
-
-    lo, hi = spans(jax.device_put(lmat, sharding), jax.device_put(rmat, sharding))
-    lo = np.asarray(lo)
-    hi = np.asarray(hi)
-
-    # host-side pair expansion (variable-size output) + column gather
+def _expand_join_pairs(
+    plan: L.Join,
+    lbuckets: Dict[int, B.Batch],
+    rbuckets: Dict[int, B.Batch],
+    nb: int,
+    lcols_needed: List[str],
+    rcols_needed: List[str],
+    span_of,
+) -> B.Batch:
+    """Pair expansion (variable-size output) + column gather, shared by the
+    device and host span backends. ``span_of(b)`` returns (lo, hi) arrays of
+    length len(left bucket b) — the matching right-row span per left row."""
     out_batches: List[B.Batch] = []
     out_cols = plan.output_columns
     lout = list(lcols_needed)
     rout = list(rcols_needed)
     for b in range(nb):
-        ll = int(llens[b])
-        if ll == 0 or int(rlens[b]) == 0:
+        if b not in lbuckets or b not in rbuckets:
             continue
-        counts = (hi[b, :ll] - lo[b, :ll]).astype(np.int64)
+        ll = B.num_rows(lbuckets[b])
+        if ll == 0 or B.num_rows(rbuckets[b]) == 0:
+            continue
+        lo_b, hi_b = span_of(b)
+        counts = (hi_b - lo_b).astype(np.int64)
         total = int(counts.sum())
         if total == 0:
             continue
         lidx = np.repeat(np.arange(ll), counts)
         # right indices: for row i, lo[i] .. hi[i]-1
         offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
-        ridx = np.arange(total) - np.repeat(offsets, counts) + np.repeat(lo[b, :ll], counts)
+        ridx = np.arange(total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
         lb, rb = lbuckets[b], rbuckets[b]
         out: B.Batch = {}
         for name in out_cols:
@@ -521,3 +711,72 @@ def device_bucketed_join(session, plan: L.Join) -> B.Batch:
 
         return {name: empty_like(name) for name in out_cols}
     return B.concat(out_batches)
+
+
+def device_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
+    """Execute a compatible bucketed equi-join on device.
+
+    Per-bucket sorted runs of both sides are padded to rectangles, sharded over
+    the mesh's bucket axis, and each device computes, for every left row, the
+    [lo, hi) span of matching right rows via two vmapped ``searchsorted``
+    passes — no collective is emitted (the reference's no-exchange SMJ,
+    HS/index/covering/JoinIndexRule.scala:604-618). Pair expansion and column
+    gathering happen host-side (variable-size output).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(plan, _compat)
+
+    SENTINEL = np.int64(2**62)
+    mesh = session.mesh
+    n_dev = mesh.devices.size
+    axis = mesh.axis_names[0]
+    nb_padded = nb + ((-nb) % n_dev)
+
+    def stack_side(buckets: Dict[int, B.Batch], key: str):
+        lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
+        width = max(max(lens), 1)
+        keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
+        for b in range(nb_padded):
+            if lens[b]:
+                keys_mat[b, : lens[b]] = _join_key_of(buckets[b], key)
+        return keys_mat, np.asarray(lens, dtype=np.int64)
+
+    lmat, llens = stack_side(lbuckets, lkey)
+    rmat, rlens = stack_side(rbuckets, rkey)
+
+    sharding = NamedSharding(mesh, P(axis))
+
+    spans = _bucketed_span_program(mesh, axis)
+    lo, hi = spans(jax.device_put(lmat, sharding), jax.device_put(rmat, sharding))
+    lo = np.asarray(lo)
+    hi = np.asarray(hi)
+
+    def span_of(b: int):
+        ll = int(llens[b])
+        return lo[b, :ll], hi[b, :ll]
+
+    return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
+
+
+def host_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
+    """The same shuffle-free bucketed SMJ with spans computed host-side
+    (per-bucket ``np.searchsorted`` over the pre-sorted runs). Used below the
+    device-dispatch row threshold, where a host<->device round trip would cost
+    more than the span computation itself."""
+    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(plan, _compat)
+
+    lkeys_by_bucket: Dict[int, np.ndarray] = {}
+    rkeys_by_bucket: Dict[int, np.ndarray] = {}
+    for b, batch in lbuckets.items():
+        lkeys_by_bucket[b] = _join_key_of(batch, lkey)
+    for b, batch in rbuckets.items():
+        rkeys_by_bucket[b] = _join_key_of(batch, rkey)
+
+    def span_of(b: int):
+        lk = lkeys_by_bucket[b]
+        rk = rkeys_by_bucket[b]
+        return np.searchsorted(rk, lk, side="left"), np.searchsorted(rk, lk, side="right")
+
+    return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)

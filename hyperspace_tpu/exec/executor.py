@@ -21,6 +21,24 @@ from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.plan.expr import INPUT_FILE_NAME, Expr, InputFileName, extract_equi_join_keys
 
 
+def _scan_identity(scan):
+    """Stable identity of a scan's file set for device-side caching: any
+    rewrite of a file (new index version, compaction) changes mtime/size and
+    naturally invalidates. Returns None (= don't cache) when any file can't
+    be stat'ed — a path-only key could serve stale device columns after an
+    in-place rewrite."""
+    import os
+
+    parts = []
+    for f in scan.files:
+        try:
+            st = os.stat(f)
+        except OSError:
+            return None
+        parts.append((f, st.st_mtime_ns, st.st_size))
+    return tuple(parts)
+
+
 def _plan_needs_file_names(plan: L.LogicalPlan) -> bool:
     def expr_has(e: Expr) -> bool:
         if isinstance(e, InputFileName):
@@ -103,13 +121,17 @@ class Executor:
     def _filter_mask(self, plan: L.Filter, child: B.Batch) -> np.ndarray:
         """Predicate evaluation: device path over index/file scans when the
         session mesh is available, host numpy otherwise."""
-        if self.session.conf.device_execution_enabled and isinstance(
-            plan.child, (L.IndexScan, L.FileScan)
+        if (
+            self.session.conf.device_execution_enabled
+            and isinstance(plan.child, (L.IndexScan, L.FileScan))
+            and B.num_rows(child) >= self.session.conf.device_exec_min_rows
         ):
             from hyperspace_tpu.exec import device as D
 
             try:
-                return D.device_filter_mask(self.session, child, plan.condition)
+                return D.device_filter_mask(
+                    self.session, child, plan.condition, scan_key=_scan_identity(plan.child)
+                )
             except D.DeviceUnsupported:
                 pass
         return np.asarray(plan.condition.eval(child), dtype=bool)
@@ -117,13 +139,18 @@ class Executor:
     def _exec_join(self, plan: L.Join, with_file_names: bool) -> B.Batch:
         import pandas as pd
 
-        if self.session.conf.device_execution_enabled and not with_file_names:
-            from hyperspace_tpu.exec import device as D
-
+        if not with_file_names and self.session.conf.device_execution_enabled:
+            # deviceExecution=False is the kill switch back to the pandas
+            # merge below — it routes around the whole bucketed-SMJ stack
             try:
-                return D.device_bucketed_join(self.session, plan)
-            except D.DeviceUnsupported:
-                pass
+                from hyperspace_tpu.exec import device as D
+            except ImportError:
+                D = None
+            if D is not None:
+                try:
+                    return D.dispatch_bucketed_join(self.session, plan)
+                except D.DeviceUnsupported:
+                    pass
 
         pairs = extract_equi_join_keys(plan.condition)
         if pairs is None:

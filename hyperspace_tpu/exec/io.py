@@ -10,6 +10,7 @@ buffers, no JVM and no pyarrow table materialization in the hot loop
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -18,6 +19,64 @@ import pyarrow.dataset as pads
 import pyarrow.parquet as pq
 
 from hyperspace_tpu.exec import batch as B
+
+# ---------------------------------------------------------------------------
+# Per-file decoded-batch cache (the framework's buffer pool). Spark gets this
+# from the OS page cache + executor columnar caching; here repeated scans of
+# the same immutable index/bucket files skip decode entirely. Entries key on
+# (path, mtime_ns, size, columns) so any rewrite invalidates naturally.
+# ---------------------------------------------------------------------------
+
+from hyperspace_tpu.utils.lru import BytesLRU
+
+_io_cache = BytesLRU(int(os.environ.get("HS_IO_CACHE_BYTES", 1 << 31)))
+
+
+def _batch_nbytes(batch: B.Batch) -> int:
+    total = 0
+    for a in batch.values():
+        if a.dtype == object and len(a):
+            # strings: numpy reports pointer size only; estimate payload by
+            # scaling a bounded sample to the full length
+            k = min(len(a), 64)
+            sample = sum(len(str(v)) for v in a[:k])
+            total += int(a.nbytes) + int(sample * len(a) / k)
+        else:
+            total += int(a.nbytes)
+    return total
+
+
+def _io_cache_key(path: str, columns: Optional[List[str]]):
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (path, st.st_mtime_ns, st.st_size, tuple(columns) if columns is not None else None)
+
+
+def _io_cache_get(key) -> Optional[B.Batch]:
+    if key is None:
+        return None
+    got = _io_cache.get(key)
+    if got is not None:
+        return dict(got)  # callers may add/remove dict keys
+    return None
+
+
+def _io_cache_put(key, batch: B.Batch) -> None:
+    if key is None:
+        return
+    # cached buffers are shared with every future reader of this file —
+    # freeze them so an in-place mutation of a collected result raises
+    # instead of silently corrupting the cache (collect() results can be
+    # read-only views; copy before mutating)
+    for a in batch.values():
+        a.setflags(write=False)
+    _io_cache.put(key, dict(batch), _batch_nbytes(batch))
+
+
+def clear_io_cache() -> None:
+    _io_cache.clear()
 
 
 def _dtype_hints(schema: pa.Schema, columns: List[str]) -> Optional[Dict[str, np.dtype]]:
@@ -73,7 +132,11 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
 
     batches: List[B.Batch] = []
     for f, schema in zip(files, schemas):
-        got = None
+        ckey = _io_cache_key(f, columns)
+        got = _io_cache_get(ckey)
+        if got is not None:
+            batches.append(got)
+            continue
         try:
             cols = list(columns) if columns is not None else list(schema.names)
             hints = _dtype_hints(schema, cols)
@@ -84,6 +147,7 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
         if got is None:  # preserve file order on fallback (bucket sortedness)
             t = pads.dataset([f], format="parquet").to_table(columns=columns)
             got = B.table_to_batch(t)
+        _io_cache_put(ckey, got)
         batches.append(got)
     if not batches:
         return _dataset_read()

@@ -65,6 +65,12 @@ class DataFrame:
         return self.plan
 
     def collect(self) -> Dict[str, np.ndarray]:
+        """Execute and return columns as numpy arrays.
+
+        Arrays may be read-only views of the scan cache (pass-through plans
+        share decoded buffers across queries); ``np.copy`` one before
+        mutating it in place.
+        """
         from hyperspace_tpu.exec.executor import Executor
 
         plan = self.optimized_plan()

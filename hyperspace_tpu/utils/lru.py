@@ -1,0 +1,49 @@
+"""Byte-capped LRU used by the scan/device caches.
+
+One policy implementation shared by the host batch cache (exec/io.py) and the
+HBM column cache (exec/device.py): get() refreshes recency, put() overwrites
+existing keys (adjusting the byte count) and evicts least-recently-used
+entries until the total fits the cap.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Hashable, Optional
+
+
+class BytesLRU:
+    def __init__(self, cap_bytes: int):
+        self.cap = cap_bytes
+        self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
+        self._bytes = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def total_bytes(self) -> int:
+        return self._bytes
+
+    def get(self, key: Hashable) -> Optional[Any]:
+        got = self._entries.get(key)
+        if got is None:
+            return None
+        self._entries.move_to_end(key)
+        return got[0]
+
+    def put(self, key: Hashable, value: Any, nbytes: int) -> None:
+        if self.cap <= 0 or nbytes > self.cap:
+            return
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._bytes -= old[1]
+        self._entries[key] = (value, nbytes)
+        self._bytes += nbytes
+        while self._bytes > self.cap and self._entries:
+            _, (_, nb) = self._entries.popitem(last=False)
+            self._bytes -= nb
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0

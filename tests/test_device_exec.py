@@ -41,6 +41,9 @@ def hs(session):
 def run_both(session, query):
     """Collect with device execution on and off; both must agree."""
     session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    # force the device path even on tiny test batches (the row threshold
+    # exists for latency, not correctness)
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
     dev = query.collect()
     session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
     host = query.collect()
@@ -282,6 +285,63 @@ class TestDeviceJoin:
         assert out["k"].dtype == np.int64
         assert out["a"].dtype == np.int64
         assert out["b"].dtype == np.float64
+
+    def test_host_bucketed_join_matches_device_and_pandas(self, session, hs, two_tables):
+        """host_bucketed_join is the default production path below the
+        deviceMinRows threshold — its spans must agree with both the device
+        SMJ and the independent pandas merge."""
+        from hyperspace_tpu.exec import device as D
+
+        lpath, rpath = two_tables
+        session.conf.set(hst.keys.NUM_BUCKETS, 16)
+        ldf = session.read_parquet(lpath)
+        rdf = session.read_parquet(rpath)
+        hs.create_index(ldf, hst.CoveringIndexConfig("hjL", ["k"], ["lv"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("hjR", ["k"], ["rv"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on="k").select("k", "lv", "rv")
+        plan = q.optimized_plan()
+        joins = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.Join)]
+        assert joins, plan.pretty()
+
+        host_out = D.host_bucketed_join(session, joins[0])
+        dev_out = D.device_bucketed_join(session, joins[0])
+        assert_batches_equal(host_out, dev_out)
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        pandas_out = q.collect()  # kill switch -> pandas merge
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        # the raw join node also outputs the right key copy (k#r); the public
+        # query's Project drops it
+        host_proj = {k: v for k, v in host_out.items() if k in pandas_out}
+        assert_batches_equal(host_proj, pandas_out)
+        assert B.num_rows(host_out) > 0
+
+    def test_join_threshold_dispatch(self, session, hs, two_tables, monkeypatch):
+        """Above deviceMinRows the device path runs; below it the host path
+        runs — same results either way through the public API."""
+        lpath, rpath = two_tables
+        session.conf.set(hst.keys.NUM_BUCKETS, 16)
+        ldf = session.read_parquet(lpath)
+        rdf = session.read_parquet(rpath)
+        hs.create_index(ldf, hst.CoveringIndexConfig("tdL", ["k"], ["lv"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("tdR", ["k"], ["rv"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on="k").select("k", "lv", "rv")
+
+        from hyperspace_tpu.exec import device as D
+
+        calls = []
+        real_dev, real_host = D.device_bucketed_join, D.host_bucketed_join
+        monkeypatch.setattr(D, "device_bucketed_join", lambda *a, **k: calls.append("dev") or real_dev(*a, **k))
+        monkeypatch.setattr(D, "host_bucketed_join", lambda *a, **k: calls.append("host") or real_host(*a, **k))
+
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+        low = q.collect()
+        assert calls[-1] == "dev"
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 1 << 40)
+        high = q.collect()
+        assert calls[-1] == "host"
+        assert_batches_equal(low, high)
 
     def test_string_key_join_falls_back_to_host(self, session, hs, tmp_path):
         lroot, rroot = tmp_path / "l3", tmp_path / "r3"
