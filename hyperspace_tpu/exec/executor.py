@@ -50,23 +50,96 @@ def _plan_needs_file_names(plan: L.LogicalPlan) -> bool:
     return any(_plan_needs_file_names(c) for c in plan.children())
 
 
-def _read_files(files: List[str], file_format: str, columns: Optional[List[str]], with_file_names: bool) -> B.Batch:
+def _read_files(
+    files: List[str],
+    file_format: str,
+    columns: Optional[List[str]],
+    with_file_names: bool,
+    partition_values: Optional[dict] = None,
+    partition_dtypes: Optional[dict] = None,
+) -> B.Batch:
+    """Read ``files`` into one batch. ``partition_values`` ({file -> {col ->
+    typed value}}) attaches hive-partition columns — constant per file, absent
+    from the file bytes — to each file's rows."""
     from hyperspace_tpu.exec.io import read_parquet_batch
 
-    if with_file_names:
-        batches = []
-        for f in files:
-            if file_format == "parquet":
-                b = read_parquet_batch([f], columns)
-            else:
-                b = B.table_to_batch(pads.dataset([f], format=file_format).to_table(columns=columns))
+    part_cols = set()
+    if partition_values:
+        for v in partition_values.values():
+            part_cols.update(v)
+
+    file_columns = columns
+    attach: Optional[List[str]] = None
+    if part_cols:
+        if columns is None:
+            attach = sorted(part_cols)
+        else:
+            attach = [c for c in columns if c in part_cols]
+            file_columns = [c for c in columns if c not in part_cols]
+
+    def read_one(f: str) -> B.Batch:
+        if file_columns is not None and not file_columns:
+            # every requested column is a partition column: the file is never
+            # decoded, but its row count still shapes the output
+            b: B.Batch = {}
+            n = pads.dataset([f], format=file_format).count_rows()
+        elif file_format == "parquet":
+            b = read_parquet_batch([f], file_columns)
+            n = B.num_rows(b)
+        else:
+            b = B.table_to_batch(pads.dataset([f], format=file_format).to_table(columns=file_columns))
+            n = B.num_rows(b)
+        if attach:
+            from hyperspace_tpu.sources import partitions as P
+
+            values = partition_values.get(f, {})
+            for c in attach:
+                dt = (partition_dtypes or {}).get(c, np.dtype(object))
+                b[c] = P.column_array(values.get(c), dt, n)
+        if with_file_names:
             b[INPUT_FILE_NAME] = np.full(B.num_rows(b), f, dtype=object)
-            batches.append(b)
-        return B.concat(batches)
+        return b
+
+    if with_file_names or attach:
+        return B.concat([read_one(f) for f in files])
     if file_format == "parquet":
         return read_parquet_batch(list(files), columns)
     t = pads.dataset(files, format=file_format).to_table(columns=columns)
     return B.table_to_batch(t)
+
+
+def _prune_partitions(scan: L.Scan, condition) -> Optional[List[str]]:
+    """Files of ``scan`` surviving the partition-column conjuncts of
+    ``condition`` (None = no partitioning / nothing prunable)."""
+    from hyperspace_tpu.plan.expr import split_conjunctive
+
+    rel = scan.relation
+    part_cols = set(getattr(rel, "partition_columns", []) or [])
+    if not part_cols:
+        return None
+    terms = [t for t in split_conjunctive(condition) if set(t.references()) and set(t.references()) <= part_cols]
+    if not terms:
+        return None
+    files = [fi.name for fi in rel.all_file_infos()]
+    # vectorized: one "row" per file holding its partition values
+    dtypes = getattr(rel, "partition_dtypes", {}) or {}
+    from hyperspace_tpu.sources import partitions as P
+
+    pvs = [rel.partition_values_for(f) for f in files]
+    file_batch = {}
+    for c in sorted(part_cols):
+        dt = dtypes.get(c, np.dtype(object))
+        vals = [pv.get(c) for pv in pvs]
+        if dt == np.dtype(object):
+            arr = np.empty(len(vals), dtype=object)
+            arr[:] = vals
+        else:
+            arr = np.array([P.typed_value(None, dt) if v is None else v for v in vals], dtype=dt)
+        file_batch[c] = arr
+    mask = np.ones(len(files), dtype=bool)
+    for t in terms:
+        mask &= np.asarray(t.eval(file_batch), dtype=bool)
+    return [f for f, keep in zip(files, mask) if keep]
 
 
 class Executor:
@@ -84,18 +157,30 @@ class Executor:
 
     def _exec(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
         if isinstance(plan, L.Scan):
-            rel = plan.relation
-            files = [fi.name for fi in rel.all_file_infos()]
-            return _read_files(files, rel.physical_format, None, with_file_names)
+            return self._exec_scan(plan, with_file_names)
 
         if isinstance(plan, L.FileScan):
-            return _read_files(list(plan.files), plan.file_format, list(plan.columns), with_file_names)
+            return _read_files(
+                list(plan.files),
+                plan.file_format,
+                list(plan.columns),
+                with_file_names,
+                partition_values=plan.partition_values,
+                partition_dtypes=plan.partition_dtypes,
+            )
 
         if isinstance(plan, L.IndexScan):
             return _read_files(list(plan.files), "parquet", list(plan.columns), with_file_names)
 
         if isinstance(plan, L.Filter):
-            child = self._exec(plan.child, with_file_names)
+            if isinstance(plan.child, L.Scan):
+                # partition pruning: conjuncts over partition columns decide
+                # per-file from path-derived values which files to read at all
+                # (Spark's PartitioningAwareFileIndex.listFiles role)
+                files = _prune_partitions(plan.child, plan.condition)
+                child = self._exec_scan(plan.child, with_file_names, files=files)
+            else:
+                child = self._exec(plan.child, with_file_names)
             mask = self._filter_mask(plan, child)
             return B.mask_rows(child, mask)
 
@@ -117,6 +202,29 @@ class Executor:
             return self._exec(plan.child, with_file_names)
 
         raise NotImplementedError(f"Cannot execute {type(plan).__name__}")
+
+    def _exec_scan(self, plan: L.Scan, with_file_names: bool, files: Optional[List[str]] = None) -> B.Batch:
+        rel = plan.relation
+        if files is None:
+            files = [fi.name for fi in rel.all_file_infos()]
+        if not files:
+            # empty after pruning: typed empty columns from the schema
+            from hyperspace_tpu.sources import schema as schema_codec
+
+            batch: B.Batch = {
+                f.name: np.empty(0, dtype=schema_codec.arrow_to_numpy_dtype(f.type))
+                for f in rel.schema
+            }
+            if with_file_names:
+                batch[INPUT_FILE_NAME] = np.empty(0, dtype=object)
+            return batch
+        part_cols = list(getattr(rel, "partition_columns", []) or [])
+        pv = pd = None
+        if part_cols:
+            pv = {f: rel.partition_values_for(f) for f in files}
+            pd_ = getattr(rel, "partition_dtypes", None)
+            pd = dict(pd_) if pd_ else None
+        return _read_files(files, rel.physical_format, None, with_file_names, pv, pd)
 
     def _filter_mask(self, plan: L.Filter, child: B.Batch) -> np.ndarray:
         """Predicate evaluation: device path over index/file scans when the

@@ -193,10 +193,11 @@ class CoveringIndex(Index):
             return relation.arrow_dataset().to_table(columns=columns)
 
         # lineage: attach _data_file_id per source file at decode time
+        # (arrow_dataset so hive-partition columns resolve per file)
         tables = []
         for fi in relation.all_file_infos():
             fid = ctx.file_id_tracker.add_file(fi)
-            t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=columns)
+            t = relation.arrow_dataset([fi.name]).to_table(columns=columns)
             t = t.append_column(C.DATA_FILE_NAME_ID, pa.array(np.full(t.num_rows, fid, dtype=np.int64)))
             tables.append(t)
         return pa.concat_tables(tables)

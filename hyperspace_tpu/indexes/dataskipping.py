@@ -270,15 +270,31 @@ class DataSkippingIndex(Index):
     def _sketch_rows(self, relation, file_infos, cols: List[str], ctx: CreateContext) -> List[Dict[str, Any]]:
         from hyperspace_tpu.exec.io import read_parquet_batch
 
+        part_cols = set(getattr(relation, "partition_columns", []) or []) & set(cols)
+        file_cols = [c for c in cols if c not in part_cols]
+        part_dtypes = dict(getattr(relation, "partition_dtypes", {}) or {})
+
         batches: List[Dict[str, np.ndarray]] = []
         rows: List[Dict[str, Any]] = []
         for fi in file_infos:
             fid = ctx.file_id_tracker.add_file(fi)
-            if relation.physical_format == "parquet":
-                batches.append(read_parquet_batch([fi.name], cols))
+            if not file_cols:
+                b = {}
+                n = relation.arrow_dataset([fi.name]).count_rows()
+            elif relation.physical_format == "parquet":
+                b = read_parquet_batch([fi.name], file_cols)
+                n = len(next(iter(b.values()))) if b else 0
             else:
-                t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=cols)
-                batches.append({c: t.column(c).to_numpy(zero_copy_only=False) for c in cols})
+                t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=file_cols)
+                b = {c: t.column(c).to_numpy(zero_copy_only=False) for c in file_cols}
+                n = len(next(iter(b.values()))) if b else 0
+            if part_cols:
+                from hyperspace_tpu.sources import partitions as P
+
+                values = relation.partition_values_for(fi.name)
+                for c in part_cols:
+                    b[c] = P.column_array(values.get(c), part_dtypes.get(c, np.dtype(object)), n)
+            batches.append(b)
             rows.append({C.DATA_FILE_NAME_ID: fid})
 
         # numeric MinMax sketches aggregate on device: all files' segments in

@@ -180,13 +180,26 @@ class FileScan(LogicalPlan):
     hybrid scan; ref: CoveringIndexRuleUtils' appended-data scan,
     HS/index/covering/CoveringIndexRuleUtils.scala:206-243)."""
 
-    def __init__(self, files: List[str], file_format: str, columns: List[str], via_index: Optional[str] = None):
+    def __init__(
+        self,
+        files: List[str],
+        file_format: str,
+        columns: List[str],
+        via_index: Optional[str] = None,
+        partition_values: Optional[dict] = None,
+        partition_dtypes: Optional[dict] = None,
+    ):
         self.files = list(files)
         self.file_format = file_format
         self.columns = list(columns)
         # name of the index whose rewrite produced this scan (e.g. a
         # data-skipping prune), for explain/whyNot reporting
         self.via_index = via_index
+        # hive-partition values per file ({file -> {col -> typed value}}) for
+        # partition columns the requested ``columns`` include but the file
+        # bytes do not carry
+        self.partition_values = partition_values
+        self.partition_dtypes = partition_dtypes
 
     @property
     def output_columns(self) -> List[str]:

@@ -321,8 +321,19 @@ def apply_data_skipping_rule(
     schema_names = {c.lower(): c for c in scan.output_columns}
     needed = [schema_names.get(c.lower(), c) for c in needed]
 
+    rel = scan.relation
+    pv = pd = None
+    if getattr(rel, "partition_columns", None):
+        pv = {f: rel.partition_values_for(f) for f in surviving}
+        pd_ = getattr(rel, "partition_dtypes", None)
+        pd = dict(pd_) if pd_ else None
     new_scan: L.LogicalPlan = L.FileScan(
-        surviving, scan.relation.physical_format, needed, via_index=entry.name
+        surviving,
+        rel.physical_format,
+        needed,
+        via_index=entry.name,
+        partition_values=pv,
+        partition_dtypes=pd,
     )
     new_plan: L.LogicalPlan = L.Filter(condition, new_scan)
     if project_cols is not None:

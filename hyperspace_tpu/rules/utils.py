@@ -166,7 +166,15 @@ def _hybrid_scan_plan(
     if not appended:
         return index_side
 
-    appended_scan = L.FileScan(appended, scan.relation.physical_format, list(required))
+    rel = scan.relation
+    pv = pd = None
+    if getattr(rel, "partition_columns", None):
+        pv = {f: rel.partition_values_for(f) for f in appended}
+        pd_ = getattr(rel, "partition_dtypes", None)
+        pd = dict(pd_) if pd_ else None
+    appended_scan = L.FileScan(
+        appended, rel.physical_format, list(required), partition_values=pv, partition_dtypes=pd
+    )
     rebucketed = L.Repartition(bucket_spec, appended_scan)
     branches = [index_side, rebucketed]
     return L.BucketUnion(branches, bucket_spec)

@@ -10,10 +10,12 @@ import glob as globlib
 import os
 from typing import Dict, List, Optional
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.dataset as pads
 
 from hyperspace_tpu.models.log_entry import Content, FileInfo, Relation, Storage
+from hyperspace_tpu.sources import partitions
 from hyperspace_tpu.sources import schema as schema_codec
 from hyperspace_tpu.sources.interfaces import (
     FileBasedRelation,
@@ -61,16 +63,56 @@ class DefaultFileBasedRelation(FileBasedRelation):
         if not self._files:
             raise FileNotFoundError(f"No data files under {root_paths!r}")
         self._schema: Optional[pa.Schema] = None
+        # hive-style partition discovery (.../col=value/... segments); single
+        # root only, so arrow_dataset() can serve the same partition columns
+        # (multi-root layouts are treated as unpartitioned, like Spark
+        # without an explicit basePath)
+        if len(self._root_paths) == 1 and os.path.isdir(self._root_paths[0]):
+            self._part_cols, self._part_raw = partitions.discover(self._files, self._root_paths)
+        else:
+            self._part_cols, self._part_raw = [], {}
+        self._part_dtypes = partitions.infer_dtypes(self._part_cols, self._part_raw)
 
     @property
     def name(self) -> str:
         return ",".join(self._root_paths)
 
+    def _partition_arrow_fields(self) -> List[pa.Field]:
+        out = []
+        for c in self._part_cols:
+            dt = self._part_dtypes[c]
+            if dt == np.dtype(np.int64):
+                out.append(pa.field(c, pa.int64()))
+            elif dt == np.dtype(np.float64):
+                out.append(pa.field(c, pa.float64()))
+            else:
+                out.append(pa.field(c, pa.string()))
+        return out
+
     @property
     def schema(self) -> pa.Schema:
+        # arrow_dataset() carries the hive partitioning, so its schema
+        # already includes the partition fields (the path-derived value
+        # shadows any same-named column in the file bytes)
         if self._schema is None:
             self._schema = self.arrow_dataset().schema
         return self._schema
+
+    @property
+    def partition_columns(self) -> List[str]:
+        return list(self._part_cols)
+
+    def partition_values_for(self, file_path: str) -> Dict[str, object]:
+        """Typed partition-column values of one file's rows."""
+        raw = self._part_raw.get(os.path.abspath(file_path), {})
+        return {
+            c: partitions.typed_value(raw.get(c), self._part_dtypes[c])
+            for c in self._part_cols
+        }
+
+    @property
+    def partition_dtypes(self) -> Dict[str, "np.dtype"]:
+        return dict(self._part_dtypes)
 
     @property
     def root_paths(self) -> List[str]:
@@ -85,7 +127,16 @@ class DefaultFileBasedRelation(FileBasedRelation):
         return dict(self._options)
 
     def arrow_dataset(self, files: Optional[List[str]] = None) -> pads.Dataset:
-        return pads.dataset(files if files is not None else self._files, format=self._file_format)
+        target = files if files is not None else self._files
+        if self._part_cols:
+            part = pads.partitioning(pa.schema(self._partition_arrow_fields()), flavor="hive")
+            return pads.dataset(
+                target,
+                format=self._file_format,
+                partitioning=part,
+                partition_base_dir=self._root_paths[0],
+            )
+        return pads.dataset(target, format=self._file_format)
 
     def all_file_infos(self) -> List[FileInfo]:
         return [FileInfo.from_path(f) for f in self._files]

@@ -1,0 +1,239 @@
+"""Hive-partitioned dataset support.
+
+The reference indexes partitioned data through Spark's partition-aware file
+index and has dedicated suites for it (E2EHyperspaceRulesTest partitioned
+cases, HybridScanForPartitionedDataTest — SURVEY.md §4); here partition
+columns come from .../col=value/... path segments (sources/partitions.py).
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.sources import partitions
+
+
+def sort_batch(b):
+    order = np.lexsort([v.astype(str) if v.dtype == object else v for v in reversed(list(b.values()))])
+    return {k: v[order] for k, v in b.items()}
+
+
+def assert_same(a, b):
+    assert sorted(a.keys()) == sorted(b.keys())
+    assert B.num_rows(a) == B.num_rows(b)
+    a, b = sort_batch(a), sort_batch(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+def write_partitioned(root, depts=(3, 7, 11), rows=400, seed=0):
+    rng = np.random.default_rng(seed)
+    for d in depts:
+        p = os.path.join(root, f"dept={d}")
+        os.makedirs(p, exist_ok=True)
+        pq.write_table(
+            pa.table(
+                {
+                    "id": rng.integers(0, 10_000, rows).astype(np.int64),
+                    "value": rng.standard_normal(rows),
+                }
+            ),
+            os.path.join(p, "part-0.parquet"),
+        )
+    return str(root)
+
+
+class TestDiscovery:
+    def test_types_and_values(self, tmp_path):
+        root = tmp_path / "t"
+        for seg, name in [("a=1", "x.parquet"), ("a=2", "y.parquet")]:
+            d = root / seg
+            d.mkdir(parents=True)
+            pq.write_table(pa.table({"v": np.arange(2, dtype=np.int64)}), d / name)
+        files = sorted(str(p) for p in root.rglob("*.parquet"))
+        cols, raw = partitions.discover(files, [str(root)])
+        assert cols == ["a"]
+        dt = partitions.infer_dtypes(cols, raw)
+        assert dt["a"] == np.dtype(np.int64)
+
+    def test_mixed_layout_is_unpartitioned(self, tmp_path):
+        root = tmp_path / "t"
+        (root / "a=1").mkdir(parents=True)
+        pq.write_table(pa.table({"v": np.arange(2, dtype=np.int64)}), root / "a=1" / "x.parquet")
+        pq.write_table(pa.table({"v": np.arange(2, dtype=np.int64)}), root / "flat.parquet")
+        files = sorted(str(p) for p in root.rglob("*.parquet"))
+        cols, _ = partitions.discover(files, [str(root)])
+        assert cols == []
+
+    def test_hive_null_promotes_int_to_float(self, tmp_path):
+        root = tmp_path / "t"
+        for seg in ("a=1", f"a={partitions.HIVE_NULL}"):
+            d = root / seg
+            d.mkdir(parents=True)
+            pq.write_table(pa.table({"v": np.arange(2, dtype=np.int64)}), d / "x.parquet")
+        files = sorted(str(p) for p in root.rglob("*.parquet"))
+        cols, raw = partitions.discover(files, [str(root)])
+        dt = partitions.infer_dtypes(cols, raw)
+        assert dt["a"] == np.dtype(np.float64)
+
+    def test_url_decoding(self, tmp_path):
+        root = tmp_path / "t"
+        d = root / "city=new%20york"
+        d.mkdir(parents=True)
+        pq.write_table(pa.table({"v": np.arange(1, dtype=np.int64)}), d / "x.parquet")
+        files = [str(next(root.rglob("*.parquet")))]
+        cols, raw = partitions.discover(files, [str(root)])
+        assert cols == ["city"]
+        assert list(raw.values())[0]["city"] == "new york"
+
+
+class TestPartitionedQueries:
+    def test_scan_exposes_partition_column(self, session, tmp_path):
+        root = write_partitioned(tmp_path / "d")
+        df = session.read_parquet(root)
+        out = df.collect()
+        assert "dept" in out
+        assert set(np.unique(out["dept"])) == {3, 7, 11}
+
+    def test_partition_pruning_reads_fewer_files(self, session, tmp_path, monkeypatch):
+        root = write_partitioned(tmp_path / "d")
+        df = session.read_parquet(root)
+        import hyperspace_tpu.exec.executor as E
+
+        seen = []
+        real = E._read_files
+
+        def spy(files, *a, **k):
+            seen.append(list(files))
+            return real(files, *a, **k)
+
+        monkeypatch.setattr(E, "_read_files", spy)
+        out = df.filter(hst.col("dept") == 7).collect()
+        assert all(v == 7 for v in out["dept"])
+        assert len(seen[-1]) == 1  # one partition dir -> one file read
+
+    def test_filter_index_over_partitioned_data(self, session, hs, tmp_path):
+        root = write_partitioned(tmp_path / "d")
+        session.conf.set(hst.keys.NUM_BUCKETS, 8)
+        df = session.read_parquet(root)
+        hs.create_index(df, hst.CoveringIndexConfig("pIdx", ["id"], ["value", "dept"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("id") < 500).select("id", "value", "dept")
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert scans, plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert_same(on, off)
+
+    def test_index_on_partition_column(self, session, hs, tmp_path):
+        """The partition column itself can be an indexed column."""
+        root = write_partitioned(tmp_path / "d")
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(root)
+        hs.create_index(df, hst.CoveringIndexConfig("pdeptIdx", ["dept"], ["value"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("dept") == 7).select("value")
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert scans, plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert_same(on, off)
+        assert B.num_rows(on) == 400
+
+    def test_lineage_build_over_partitioned_data(self, session, hs, tmp_path):
+        root = write_partitioned(tmp_path / "d")
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+        df = session.read_parquet(root)
+        hs.create_index(df, hst.CoveringIndexConfig("plinIdx", ["id"], ["dept"]))
+        entry = session.index_manager.get_index("plinIdx")
+        assert entry is not None
+
+    def test_hybrid_scan_append_new_partition(self, session, hs, tmp_path):
+        root = write_partitioned(tmp_path / "d")
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(root)
+        hs.create_index(df, hst.CoveringIndexConfig("phyIdx", ["id"], ["value", "dept"]))
+        # new partition appears after indexing
+        write_partitioned(tmp_path / "d", depts=(13,), rows=100, seed=5)
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.enable_hyperspace()
+        df2 = session.read_parquet(root)
+        q = df2.filter(hst.col("id") >= 0).select("id", "value", "dept")
+        plan = q.optimized_plan()
+        unions = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.BucketUnion)]
+        assert unions, plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert_same(on, off)
+        assert set(np.unique(on["dept"])) == {3, 7, 11, 13}
+
+    def test_filescan_with_only_partition_columns(self, session, tmp_path):
+        """A FileScan whose requested columns are all partition columns must
+        still produce one row per file row (the file is not decoded, only
+        counted)."""
+        from hyperspace_tpu.exec.executor import Executor
+
+        root = write_partitioned(tmp_path / "d", depts=(7,), rows=5)
+        df = session.read_parquet(root)
+        rel = df.plan.relation
+        files = [fi.name for fi in rel.all_file_infos()]
+        scan = L.FileScan(
+            files,
+            "parquet",
+            ["dept"],
+            partition_values={f: rel.partition_values_for(f) for f in files},
+            partition_dtypes=rel.partition_dtypes,
+        )
+        out = Executor(session).execute(scan, required_columns=["dept"])
+        assert len(out["dept"]) == 5
+        assert all(v == 7 for v in out["dept"])
+
+    def test_join_over_partitioned_tables(self, session, hs, tmp_path):
+        lroot = write_partitioned(tmp_path / "l", depts=(1, 2), rows=300, seed=1)
+        rroot = tmp_path / "r"
+        rroot.mkdir()
+        rng = np.random.default_rng(2)
+        pq.write_table(
+            pa.table(
+                {
+                    "id": rng.integers(0, 10_000, 500).astype(np.int64),
+                    "w": rng.standard_normal(500),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 8)
+        ldf = session.read_parquet(lroot)
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("pjL", ["id"], ["value", "dept"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("pjR", ["id"], ["w"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on="id").select("id", "dept", "value", "w")
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert len(scans) == 2, plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert_same(on, off)
