@@ -62,4 +62,12 @@ def concat(batches: List[Batch]) -> Batch:
 
 
 def select(batch: Batch, columns: List[str]) -> Batch:
-    return {c: batch[c] for c in columns}
+    from hyperspace_tpu.plan.expr import get_column
+
+    out: Batch = {}
+    for c in columns:
+        got = batch[c] if c in batch else get_column(batch, c)
+        if got is None:
+            raise KeyError(f"Column {c!r} not found in batch with columns {list(batch)}")
+        out[c] = got
+    return out

@@ -508,7 +508,12 @@ def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.IndexScan, L.IndexSc
             rkeys.append(a)
         else:
             return None
-    if list(lspec.bucket_columns) != lkeys or list(rspec.bucket_columns) != rkeys:
+    from hyperspace_tpu.plan.expr import strip_nested_prefix
+
+    def norm(cols):
+        return [strip_nested_prefix(c).lower() for c in cols]
+
+    if norm(lspec.bucket_columns) != norm(lkeys) or norm(rspec.bucket_columns) != norm(rkeys):
         return None
     return lchild, rchild, lkeys, rkeys
 
@@ -532,9 +537,15 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str]
         per_bucket.setdefault(b, []).append(f)
     from hyperspace_tpu.exec.io import read_parquet_batch
 
+    # nested index columns live under flat __hs_nested. names in the files
+    file_cols = [scan.file_column_of(c) for c in columns]
+    rename = file_cols != list(columns)
+
     out: Dict[int, B.Batch] = {}
     for b, files in per_bucket.items():
-        batch = read_parquet_batch(files, columns)
+        batch = read_parquet_batch(files, file_cols)
+        if rename:
+            batch = {o: batch[fc] for o, fc in zip(columns, file_cols)}
         if sort_key is not None and len(files) > 1:
             k = batch[sort_key]
             if k.size > 1 and np.any(k[1:] < k[:-1]):
@@ -640,7 +651,7 @@ def _bucketed_join_setup(plan: L.Join, compat=None):
     for scan, key in ((lscan, lkey), (rscan, rkey)):
         if not scan.files:
             raise DeviceUnsupported("empty index scan")
-        field = pq.read_schema(scan.files[0]).field(key)
+        field = pq.read_schema(scan.files[0]).field(scan.file_column_of(key))
         if not (pa.types.is_integer(field.type) or pa.types.is_temporal(field.type) or pa.types.is_boolean(field.type)):
             raise DeviceUnsupported(f"device join requires integer/datetime keys; got {field.type}")
 

@@ -170,7 +170,18 @@ class Executor:
             )
 
         if isinstance(plan, L.IndexScan):
-            return _read_files(list(plan.files), "parquet", list(plan.columns), with_file_names)
+            fcols = plan.file_columns if plan.file_columns is not None else list(plan.columns)
+            batch = _read_files(list(plan.files), "parquet", list(fcols), with_file_names)
+            if plan.file_columns is not None:
+                # nested index columns are stored under their flat
+                # __hs_nested. name; present them under the output name
+                renamed: B.Batch = {}
+                for out, fc in zip(plan.columns, fcols):
+                    renamed[out] = batch[fc]
+                if INPUT_FILE_NAME in batch:
+                    renamed[INPUT_FILE_NAME] = batch[INPUT_FILE_NAME]
+                return renamed
+            return batch
 
         if isinstance(plan, L.Filter):
             if isinstance(plan.child, L.Scan):
@@ -268,19 +279,32 @@ class Executor:
         left = {k: v for k, v in left.items() if k != INPUT_FILE_NAME}
         right = {k: v for k, v in right.items() if k != INPUT_FILE_NAME}
 
-        left_cols = list(left)
-        right_cols = list(right)
+        def materialize_key(batch: B.Batch, name: str) -> bool:
+            """Ensure ``name`` is a column of ``batch``; a dotted nested key
+            is extracted from its root struct column on demand."""
+            if name in batch:
+                return True
+            from hyperspace_tpu.plan.expr import get_column
+
+            got = get_column(batch, name)
+            if got is not None:
+                batch[name] = got
+                return True
+            return False
+
         # validate key sides (columns may arrive swapped from the user)
         lkeys, rkeys = [], []
         for a, b in pairs:
-            if a in left_cols and b in right_cols:
+            if materialize_key(left, a) and materialize_key(right, b):
                 lkeys.append(a)
                 rkeys.append(b)
-            elif b in left_cols and a in right_cols:
+            elif materialize_key(left, b) and materialize_key(right, a):
                 lkeys.append(b)
                 rkeys.append(a)
             else:
                 raise ValueError(f"Join keys ({a}, {b}) not found in the two sides")
+        left_cols = list(left)
+        right_cols = list(right)
 
         # rename duplicated right-side columns up front so every output column
         # (including unmatched-row nulls on outer joins) comes straight out of

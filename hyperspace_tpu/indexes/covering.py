@@ -147,15 +147,14 @@ class CoveringIndex(Index):
         plan = df.plan
         if isinstance(plan, Scan) and not self.lineage:
             relation = plan.relation
-            columns = [c.name for c in resolve_columns_against_schema(self.referenced_columns, relation.schema)]
-            self._indexed = [c.name for c in resolve_columns_against_schema(self._indexed, relation.schema)]
-            self._included = [c.name for c in resolve_columns_against_schema(self._included, relation.schema)]
+            resolved = self._resolve_all(ctx, relation.schema)
+            columns = [r.normalized_name for r in resolved]
             ds = relation.arrow_dataset()
-            key_table = ds.to_table(columns=self._indexed)
-            payload_cols = [c for c in columns if c not in self._indexed]
+            key_table = ds.to_table(columns=_nested_projection([r for r in resolved if r.normalized_name in self._indexed]))
+            payload = [r for r in resolved if r.normalized_name not in self._indexed]
 
             def payload_fn() -> Optional[pa.Table]:
-                return ds.to_table(columns=payload_cols) if payload_cols else None
+                return ds.to_table(columns=_nested_projection(payload)) if payload else None
 
             write_bucketed(
                 key_table,
@@ -165,13 +164,38 @@ class CoveringIndex(Index):
                 payload_fn=payload_fn,
                 column_order=columns,
             )
-            schema = pa.schema([ds.schema.field(c) for c in columns])
+            schema = pa.schema([_arrow_field_for(r, ds.schema) for r in resolved])
             self.schema_json = schema_codec.schema_to_json(schema)
             return
 
         table = self._index_data_table(ctx, df)
         write_bucketed(table, self._indexed, self.num_buckets, ctx.index_data_path)
         self.schema_json = schema_codec.schema_to_json(table.schema)
+
+    def _resolve_all(self, ctx: CreateContext, schema: pa.Schema):
+        """Resolve indexed/included columns, normalizing nested paths with the
+        ``__hs_nested.`` prefix; nested indexing is gated on conf
+        (ref: CoveringIndexConfig nested normalization, ResolverUtils.scala:44-105).
+
+        Names may arrive already normalized (refresh/optimize revive the index
+        from its log entry) — strip the prefix before re-resolving against the
+        source schema."""
+        from hyperspace_tpu.plan.resolver import ResolvedColumn
+
+        def denorm(names):
+            return [ResolvedColumn.from_normalized(n).name for n in names]
+
+        resolved = resolve_columns_against_schema(denorm(self.referenced_columns), schema)
+        if any(r.is_nested for r in resolved):
+            conf = getattr(getattr(ctx, "session", None), "conf", None)
+            if conf is not None and not conf.nested_column_enabled:
+                raise ValueError(
+                    "Indexing nested columns requires "
+                    f"{C.keys.NESTED_COLUMN_ENABLED}=true"
+                )
+        self._indexed = [r.normalized_name for r in resolve_columns_against_schema(denorm(self._indexed), schema)]
+        self._included = [r.normalized_name for r in resolve_columns_against_schema(denorm(self._included), schema)]
+        return resolved
 
     def _index_data_table(self, ctx: CreateContext, df) -> pa.Table:
         """The vertical slice (+ optional lineage column) as one arrow table
@@ -185,22 +209,42 @@ class CoveringIndex(Index):
                 "of a supported relation); got: " + type(plan).__name__
             )
         relation = plan.relation
-        columns = [c.name for c in resolve_columns_against_schema(self.referenced_columns, relation.schema)]
-        self._indexed = [c.name for c in resolve_columns_against_schema(self._indexed, relation.schema)]
-        self._included = [c.name for c in resolve_columns_against_schema(self._included, relation.schema)]
+        resolved = self._resolve_all(ctx, relation.schema)
+        projection = _nested_projection(resolved)
 
         if not self.lineage:
-            return relation.arrow_dataset().to_table(columns=columns)
+            return relation.arrow_dataset().to_table(columns=projection)
 
         # lineage: attach _data_file_id per source file at decode time
         # (arrow_dataset so hive-partition columns resolve per file)
         tables = []
         for fi in relation.all_file_infos():
             fid = ctx.file_id_tracker.add_file(fi)
-            t = relation.arrow_dataset([fi.name]).to_table(columns=columns)
+            t = relation.arrow_dataset([fi.name]).to_table(columns=projection)
             t = t.append_column(C.DATA_FILE_NAME_ID, pa.array(np.full(t.num_rows, fid, dtype=np.int64)))
             tables.append(t)
         return pa.concat_tables(tables)
+
+
+def _nested_projection(resolved) -> Dict[str, Any]:
+    """Arrow dataset projection dict: normalized output name -> field ref
+    (nested paths project the struct leaf into a flat column)."""
+    import pyarrow.compute as pc
+
+    out: Dict[str, Any] = {}
+    for r in resolved:
+        out[r.normalized_name] = pc.field(*r.name.split(".")) if r.is_nested else pc.field(r.name)
+    return out
+
+
+def _arrow_field_for(resolved_col, schema: pa.Schema) -> pa.Field:
+    """The (leaf) arrow field a resolved column projects to, named by its
+    normalized (flat) name."""
+    parts = resolved_col.name.split(".")
+    field = schema.field(parts[0])
+    for p in parts[1:]:
+        field = field.type.field(p)
+    return pa.field(resolved_col.normalized_name, field.type)
 
 
 def write_bucketed(

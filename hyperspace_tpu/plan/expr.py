@@ -23,6 +23,88 @@ INPUT_FILE_NAME = "__input_file_name"
 NESTED_PREFIX = "__hs_nested."
 
 
+def strip_nested_prefix(name: str) -> str:
+    """``__hs_nested.a.b`` -> ``a.b`` (identity for flat names)."""
+    return name[len(NESTED_PREFIX):] if name.startswith(NESTED_PREFIX) else name
+
+
+def get_column(batch: Dict[str, np.ndarray], name: str) -> Optional[np.ndarray]:
+    """Canonical possibly-nested batch lookup used by eval, select, and join
+    key materialization: exact key, case-insensitive key, the flat
+    ``__hs_nested.``-prefixed copy an index scan carries, then struct
+    extraction for dotted paths. None when nothing resolves."""
+    if name in batch:
+        return batch[name]
+    lowered = name.lower()
+    for k, v in batch.items():
+        if k.lower() == lowered:
+            return v
+    if "." in name:
+        stripped = strip_nested_prefix(name)
+        if not name.startswith(NESTED_PREFIX):
+            pref = (NESTED_PREFIX + name).lower()
+            for k, v in batch.items():
+                if k.lower() == pref:
+                    return v
+        return extract_nested_from_batch(batch, stripped)
+    return None
+
+
+def column_root_member(name: str, available) -> Optional[str]:
+    """Case-insensitive membership of a (possibly dotted) column name in a
+    set of flat names: a dotted name belongs where its root struct column is.
+    Returns the resolved name (root exact-cased) or None."""
+    lowered = {a.lower(): a for a in available}
+    hit = lowered.get(name.lower())
+    if hit is not None:
+        return hit
+    if "." in name:
+        root, _, rest = name.partition(".")
+        base = lowered.get(root.lower())
+        if base is not None:
+            return f"{base}.{rest}"
+    return None
+
+
+def extract_nested_from_batch(batch: Dict[str, np.ndarray], dotted: str) -> Optional[np.ndarray]:
+    """Materialize a nested struct field (``a.b.c``) from a batch whose root
+    column holds per-row dicts (how arrow struct columns decode host-side).
+    Case-insensitive per path segment. None when the path doesn't resolve."""
+    parts = dotted.split(".")
+    root = None
+    for k in batch:
+        if k.lower() == parts[0].lower():
+            root = batch[k]
+            break
+    if root is None or root.dtype != object:
+        return None
+
+    _MISSING = object()
+
+    def dig(value, segs):
+        for s in segs:
+            if value is None:
+                return None  # null struct row: field value is null
+            if not isinstance(value, dict):
+                return _MISSING  # path goes through a non-struct: unresolvable
+            hit = next((kk for kk in value if kk.lower() == s.lower()), None)
+            if hit is None:
+                return _MISSING
+            value = value[hit]
+        return value
+
+    vals = [dig(v, parts[1:]) for v in root]
+    if any(v is _MISSING for v in vals):
+        return None
+    arr = np.asarray(vals)
+    if arr.dtype == object:
+        try:
+            arr = np.asarray(vals, dtype=np.float64)
+        except (TypeError, ValueError):
+            pass
+    return arr
+
+
 class Expr:
     """Base expression node. Python comparison operators build trees, so
     identity-based hashing is retained explicitly."""
@@ -113,13 +195,10 @@ class Col(Expr):
         out.add(self.name)
 
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        if self.name in batch:
-            return batch[self.name]
-        # case-insensitive fallback; resolution normally happens before eval
-        for k, v in batch.items():
-            if k.lower() == self.name.lower():
-                return v
-        raise KeyError(f"Column {self.name!r} not found in batch with columns {list(batch)}")
+        got = get_column(batch, self.name)
+        if got is None:
+            raise KeyError(f"Column {self.name!r} not found in batch with columns {list(batch)}")
+        return got
 
     def __repr__(self) -> str:
         return f"col({self.name!r})"

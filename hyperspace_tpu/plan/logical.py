@@ -230,16 +230,29 @@ class IndexScan(LogicalPlan):
         bucket_spec: Optional[BucketSpec],
         files: Optional[List[str]] = None,
         pruned_buckets: Optional[List[int]] = None,
+        file_columns: Optional[List[str]] = None,
     ):
         self.entry = entry
         self.columns = list(columns)
         self.bucket_spec = bucket_spec
         self.files = files if files is not None else entry.content.files
         self.pruned_buckets = pruned_buckets
+        # parallel to ``columns``: the flat column names inside the index
+        # parquet files when they differ from the output names (nested fields
+        # are stored under their __hs_nested.-prefixed flat name)
+        self.file_columns = list(file_columns) if file_columns is not None else None
 
     @property
     def output_columns(self) -> List[str]:
         return list(self.columns)
+
+    def file_column_of(self, output_col: str) -> str:
+        if self.file_columns is None:
+            return output_col
+        try:
+            return self.file_columns[self.columns.index(output_col)]
+        except ValueError:
+            return output_col
 
     def with_children(self, children: Sequence[LogicalPlan]) -> "IndexScan":
         assert not children

@@ -62,10 +62,17 @@ def _resolve_against_schema(name: str, schema: pa.Schema) -> Optional[ResolvedCo
 
 
 def resolve_column(name: str, available: Sequence[str]) -> Optional[str]:
-    """Resolve ``name`` case-insensitively against flat column names."""
+    """Resolve ``name`` case-insensitively against flat column names; a
+    dotted nested path resolves when its root column does (the remaining
+    segments resolve at execution against the struct values)."""
     for a in available:
         if a.lower() == name.lower():
             return a
+    if "." in name:
+        root, _, rest = name.partition(".")
+        for a in available:
+            if a.lower() == root.lower():
+                return f"{a}.{rest}"
     return None
 
 

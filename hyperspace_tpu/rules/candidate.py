@@ -38,9 +38,27 @@ def _schema_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntry])
     """Index's referenced columns ⊆ relation output (ref: ColumnSchemaFilter.scala:29-44)."""
     out = []
     relation_cols = {c.lower() for c in scan.output_columns}
+
+    def covered(name: str) -> bool:
+        # nested index columns (__hs_nested.a.b) must fully resolve against
+        # the relation schema — the root struct existing is not enough after
+        # source schema evolution dropped the leaf
+        from hyperspace_tpu.plan.expr import strip_nested_prefix
+        from hyperspace_tpu.plan.resolver import resolve_columns_against_schema
+
+        stripped = strip_nested_prefix(name)
+        if stripped.lower() in relation_cols:
+            return True
+        if "." not in stripped or stripped.split(".")[0].lower() not in relation_cols:
+            return False
+        try:
+            resolve_columns_against_schema([stripped], scan.relation.schema)
+            return True
+        except ValueError:
+            return False
     for entry in indexes:
         referenced = _referenced_columns(entry)
-        ok = all(c.lower() in relation_cols for c in referenced)
+        ok = all(covered(c) for c in referenced)
         if ctx.tag_reason_if_failed(
             ok, entry, scan, lambda: R.col_schema_mismatch(referenced, scan.output_columns)
         ):

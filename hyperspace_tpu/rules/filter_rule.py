@@ -34,21 +34,24 @@ def _filter_column_filter(
 ) -> List[IndexLogEntry]:
     """(ref: FilterColumnFilter — first indexed col must appear in the
     predicate; index covers filter+project columns)."""
+    from hyperspace_tpu.plan.expr import strip_nested_prefix
+
     out = []
-    pred_cols = {c.lower() for c in condition.references()}
+    # nested refs/index columns compare on their un-prefixed dotted path
+    pred_cols = {strip_nested_prefix(c).lower() for c in condition.references()}
     for entry in candidates:
         if entry.kind != "CoveringIndex":
             continue
         props = entry.derived_dataset.properties
         indexed = [str(c) for c in props.get("indexedColumns", [])]
         included = [str(c) for c in props.get("includedColumns", [])]
-        first_ok = bool(indexed) and indexed[0].lower() in pred_cols
+        first_ok = bool(indexed) and strip_nested_prefix(indexed[0]).lower() in pred_cols
         if not ctx.tag_reason_if_failed(
             first_ok, entry, scan, lambda: R.no_first_indexed_col_cond(indexed[0] if indexed else "", pred_cols)
         ):
             continue
-        covered = {c.lower() for c in indexed + included}
-        covers = all(c.lower() in covered for c in required)
+        covered = {strip_nested_prefix(c).lower() for c in indexed + included}
+        covers = all(strip_nested_prefix(c).lower() in covered for c in required)
         if not ctx.tag_reason_if_failed(
             covers, entry, scan, lambda: R.missing_required_col(required, indexed + included)
         ):

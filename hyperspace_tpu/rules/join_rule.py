@@ -39,18 +39,26 @@ def _attribute_mapping(
     pairs: List[Tuple[str, str]], left_cols: List[str], right_cols: List[str]
 ) -> Optional[Dict[str, str]]:
     """One-to-one mapping of left join cols -> right join cols
-    (ref: JoinAttributeFilter :247-286)."""
-    lset = {c.lower(): c for c in left_cols}
-    rset = {c.lower(): c for c in right_cols}
+    (ref: JoinAttributeFilter :247-286). A dotted nested key belongs to the
+    side whose output has its root struct column."""
+    from hyperspace_tpu.plan.expr import column_root_member
+
+    def member(name: str, side: List[str]) -> Optional[str]:
+        return column_root_member(name, side)
+
+    lset, rset = list(left_cols), list(right_cols)
     mapping: Dict[str, str] = {}
     reverse: Dict[str, str] = {}
     for a, b in pairs:
-        if a.lower() in lset and b.lower() in rset:
-            l, r = lset[a.lower()], rset[b.lower()]
-        elif b.lower() in lset and a.lower() in rset:
-            l, r = lset[b.lower()], rset[a.lower()]
+        al, bl = member(a, lset), member(b, rset)
+        if al is not None and bl is not None:
+            l, r = al, bl
         else:
-            return None
+            bl2, ar2 = member(b, lset), member(a, rset)
+            if bl2 is not None and ar2 is not None:
+                l, r = bl2, ar2
+            else:
+                return None
         if mapping.get(l, r) != r or reverse.get(r, l) != l:
             return None  # not one-to-one
         mapping[l] = r
@@ -68,20 +76,22 @@ def _side_candidates(
 ) -> List[IndexLogEntry]:
     """JoinColumnFilter (ref: :419-448)."""
     out = []
-    join_set = {c.lower() for c in join_cols}
+    from hyperspace_tpu.plan.expr import strip_nested_prefix
+
+    join_set = {strip_nested_prefix(c).lower() for c in join_cols}
     for entry in entries:
         if entry.kind != "CoveringIndex":
             continue
         props = entry.derived_dataset.properties
         indexed = [str(c) for c in props.get("indexedColumns", [])]
         included = [str(c) for c in props.get("includedColumns", [])]
-        exact = {c.lower() for c in indexed} == join_set
+        exact = {strip_nested_prefix(c).lower() for c in indexed} == join_set
         if not ctx.tag_reason_if_failed(
             exact, entry, scan, lambda: R.not_all_join_cols_indexed(side, join_cols, indexed)
         ):
             continue
-        covered = {c.lower() for c in indexed + included}
-        covers = all(c.lower() in covered for c in required)
+        covered = {strip_nested_prefix(c).lower() for c in indexed + included}
+        covers = all(strip_nested_prefix(c).lower() in covered for c in required)
         if not ctx.tag_reason_if_failed(
             covers, entry, scan, lambda: R.missing_required_col(required, indexed + included)
         ):
@@ -96,8 +106,13 @@ def _compatible(l_entry: IndexLogEntry, r_entry: IndexLogEntry, mapping: Dict[st
     r_indexed = [str(c) for c in r_entry.derived_dataset.properties.get("indexedColumns", [])]
     if len(l_indexed) != len(r_indexed):
         return False
+    from hyperspace_tpu.plan.expr import strip_nested_prefix
+
     lowered = {k.lower(): v.lower() for k, v in mapping.items()}
-    return all(lowered.get(lc.lower()) == rc.lower() for lc, rc in zip(l_indexed, r_indexed))
+    return all(
+        lowered.get(strip_nested_prefix(lc).lower()) == strip_nested_prefix(rc).lower()
+        for lc, rc in zip(l_indexed, r_indexed)
+    )
 
 
 def _rank_pairs(

@@ -62,17 +62,37 @@ def pruned_buckets_for_predicate(
     column narrows the scan to specific buckets
     (ref: FilterIndexRule useBucketSpec, HS/index/covering/FilterIndexRule.scala:162-167)."""
     from hyperspace_tpu.ops.hashing import bucket_of_literals
+    from hyperspace_tpu.plan.expr import strip_nested_prefix
 
     if condition is None or len(bucket_columns) != 1:
         return None
-    key = bucket_columns[0].lower()
+    key = strip_nested_prefix(bucket_columns[0]).lower()
     for term in split_conjunctive(condition):
         eq = extract_eq_literal(term)
-        if eq is not None and eq[0].lower() == key:
+        if eq is not None and strip_nested_prefix(eq[0]).lower() == key:
             return [bucket_of_literals([eq[1]], num_buckets)]
-        if isinstance(term, In) and isinstance(term.child, Col) and term.child.name.lower() == key:
+        if (
+            isinstance(term, In)
+            and isinstance(term.child, Col)
+            and strip_nested_prefix(term.child.name).lower() == key
+        ):
             return sorted({bucket_of_literals([v.value], num_buckets) for v in term.values})
     return None
+
+
+def index_file_columns(entry: IndexLogEntry, output_cols: List[str]) -> Optional[List[str]]:
+    """Map required output names (possibly dotted nested paths) onto the flat
+    column names stored in the index files (__hs_nested.-prefixed for nested
+    fields). None when every name maps to itself."""
+    from hyperspace_tpu.plan.expr import strip_nested_prefix
+
+    props = entry.derived_dataset.properties
+    stored = [str(c) for c in props.get("indexedColumns", [])] + [
+        str(c) for c in props.get("includedColumns", [])
+    ]
+    lookup = {strip_nested_prefix(s).lower(): s for s in stored}
+    mapped = [lookup.get(strip_nested_prefix(c).lower(), c) for c in output_cols]
+    return mapped if mapped != list(output_cols) else None
 
 
 def index_files_for_buckets(entry: IndexLogEntry, buckets: Optional[List[int]]) -> List[str]:
@@ -104,6 +124,7 @@ def transform_plan_to_use_index(
     index = CoveringIndex.from_derived_dataset(entry.derived_dataset)
     bucket_spec = index.bucket_spec()
     hybrid = bool(entry.get_tag(L.plan_key(scan), R.HYBRIDSCAN_REQUIRED))
+    file_cols = index_file_columns(entry, required_all)
 
     if not hybrid:
         buckets = (
@@ -117,6 +138,7 @@ def transform_plan_to_use_index(
             bucket_spec=bucket_spec if use_bucket_spec else None,
             files=index_files_for_buckets(entry, buckets),
             pruned_buckets=buckets,
+            file_columns=file_cols,
         )
     else:
         new_scan = _hybrid_scan_plan(ctx, entry, scan, required_all, bucket_spec)
@@ -146,7 +168,12 @@ def _hybrid_scan_plan(
     if deleted and C.DATA_FILE_NAME_ID not in index_cols:
         index_cols = index_cols + [C.DATA_FILE_NAME_ID]
 
-    index_side: L.LogicalPlan = L.IndexScan(entry, columns=index_cols, bucket_spec=bucket_spec)
+    index_side: L.LogicalPlan = L.IndexScan(
+        entry,
+        columns=index_cols,
+        bucket_spec=bucket_spec,
+        file_columns=index_file_columns(entry, index_cols),
+    )
     if deleted:
         tracker = entry.file_id_tracker()
         deleted_infos = {fi.name: fi for fi in entry.source_file_infos()}
@@ -213,6 +240,13 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
     if isinstance(plan, L.Join):
         left_cols = set(plan.left.output_columns)
         right_cols = set(plan.right.output_columns)
+
+        def on_side(c: str, side: set) -> bool:
+            # a dotted nested ref belongs to the side holding its root struct
+            from hyperspace_tpu.plan.expr import column_root_member
+
+            return column_root_member(c, side) is not None
+
         if needed is None:
             l_needed = r_needed = None
         else:
@@ -224,13 +258,15 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
                     r_needed.add(c[:-2])
                     if c[:-2] in left_cols:
                         l_needed.add(c[:-2])
-                elif c in left_cols:
+                elif on_side(c, left_cols):
                     l_needed.add(c)
-                elif c in right_cols:
+                elif on_side(c, right_cols):
                     r_needed.add(c)
-            cond_refs = set(plan.condition.references())
-            l_needed |= cond_refs & left_cols
-            r_needed |= cond_refs & right_cols
+            for c in plan.condition.references():
+                if on_side(c, left_cols):
+                    l_needed.add(c)
+                if on_side(c, right_cols):
+                    r_needed.add(c)
         return L.Join(
             prune_columns(plan.left, l_needed),
             prune_columns(plan.right, r_needed),
@@ -239,9 +275,17 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         )
     if isinstance(plan, L.Scan):
         out = plan.output_columns
-        if needed is not None and set(needed) < set(out):
-            ordered = [c for c in out if c in needed]
-            return L.Project(ordered, plan)
+        if needed is None:
+            return plan
+        out_set = set(out)
+        flat = {c for c in needed if c in out_set}
+        # dotted refs survive pruning as their own projected columns (the
+        # reference relies on Catalyst extracting nested field accesses)
+        dotted = {c for c in needed if c not in out_set and "." in c and c.split(".")[0] in out_set}
+        if flat | {d.split(".")[0] for d in dotted} < out_set or dotted:
+            ordered = [c for c in out if c in flat] + sorted(dotted)
+            if set(ordered) != out_set:
+                return L.Project(ordered, plan)
         return plan
     if isinstance(plan, L.Union):
         return plan.with_children([prune_columns(c, needed) for c in plan.children()])
