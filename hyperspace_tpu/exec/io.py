@@ -79,6 +79,20 @@ def clear_io_cache() -> None:
     _io_cache.clear()
 
 
+_DECODE_POOL = None
+
+
+def _decode_pool():
+    """Shared decode thread pool — per-call pools would pay thread spin-up on
+    every scan."""
+    global _DECODE_POOL
+    if _DECODE_POOL is None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        _DECODE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="hs-decode")
+    return _DECODE_POOL
+
+
 def _dtype_hints(schema: pa.Schema, columns: List[str]) -> Optional[Dict[str, np.dtype]]:
     """Numpy dtypes for native INT64-backed logical types (timestamps/dates).
 
@@ -160,25 +174,33 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
             if any(c not in s.names for c in columns):
                 return _dataset_read()
 
-    batches: List[B.Batch] = []
-    for f, schema in zip(files, schemas):
+    def read_one(f: str, schema) -> B.Batch:
         ckey = _io_cache_key(f, columns)
         got = _io_cache_get(ckey)
         if got is not None:
-            batches.append(got)
-            continue
+            return got
         try:
             cols = list(columns) if columns is not None else list(schema.names)
             hints = _dtype_hints(schema, cols)
-            if hints is not None:
-                got = native.read_columns(f, cols, hints)
+            got = native.read_columns(f, cols, hints) if hints is not None else None
         except (native.NativeUnsupported, OSError, KeyError):
             got = None
-        if got is None:  # preserve file order on fallback (bucket sortedness)
+        if got is None:
             t = pads.dataset([f], format="parquet").to_table(columns=columns)
             got = B.table_to_batch(t)
         _io_cache_put(ckey, got)
-        batches.append(got)
+        return got
+
+    # decode files concurrently (pyarrow and the native decoder release the
+    # GIL); list order — bucket sortedness — is preserved by mapping, not by
+    # completion. Fully-cached reads skip the pool: no decode to parallelize.
+    cached = [_io_cache_get(_io_cache_key(f, columns)) for f in files]
+    if all(b is not None for b in cached):
+        batches = cached
+    elif len(files) > 1:
+        batches = list(_decode_pool().map(read_one, files, schemas))
+    else:
+        batches = [read_one(f, s) for f, s in zip(files, schemas)]
     if not batches:
         return _dataset_read()
     if len(batches) == 1:

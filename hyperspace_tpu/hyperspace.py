@@ -71,3 +71,29 @@ class Hyperspace:
         from hyperspace_tpu.analysis.why_not import why_not_string
 
         return why_not_string(df, self.session, index_name, extended)
+
+    # --- reference-API aliases ---------------------------------------------
+    # The reference's JVM/PySpark binding exposes camelCase method names
+    # (ref: HS/Hyperspace.scala:27-231, python/hyperspace/hyperspace.py:9-192);
+    # users migrating from it can keep their call sites. Thin delegating defs
+    # so subclass overrides of the snake_case methods stay authoritative.
+    def createIndex(self, df, index_config) -> IndexLogEntry:
+        return self.create_index(df, index_config)
+
+    def deleteIndex(self, name: str) -> IndexLogEntry:
+        return self.delete_index(name)
+
+    def restoreIndex(self, name: str) -> IndexLogEntry:
+        return self.restore_index(name)
+
+    def vacuumIndex(self, name: str) -> IndexLogEntry:
+        return self.vacuum_index(name)
+
+    def refreshIndex(self, name: str, mode: str = C.REFRESH_MODE_FULL) -> IndexLogEntry:
+        return self.refresh_index(name, mode)
+
+    def optimizeIndex(self, name: str, mode: str = C.OPTIMIZE_MODE_QUICK) -> IndexLogEntry:
+        return self.optimize_index(name, mode)
+
+    def whyNot(self, df, index_name: Optional[str] = None, extended: bool = False) -> str:
+        return self.why_not(df, index_name, extended)

@@ -390,5 +390,40 @@ class CoveringIndexConfig(IndexConfig):
     def __repr__(self) -> str:
         return f"CoveringIndexConfig({self._name!r}, indexed={self._indexed}, included={self._included})"
 
+    class Builder:
+        """Fluent builder (ref: CoveringIndexConfig builder, :118-200)."""
+
+        def __init__(self):
+            self._name: Optional[str] = None
+            self._indexed: List[str] = []
+            self._included: List[str] = []
+
+        def indexName(self, name: str) -> "CoveringIndexConfig.Builder":
+            if self._name:
+                raise ValueError("indexName is already set")
+            self._name = name
+            return self
+
+        index_name = indexName
+
+        def indexBy(self, *columns: str) -> "CoveringIndexConfig.Builder":
+            self._indexed.extend(columns)
+            return self
+
+        index_by = indexBy
+
+        def include(self, *columns: str) -> "CoveringIndexConfig.Builder":
+            self._included.extend(columns)
+            return self
+
+        def create(self) -> "CoveringIndexConfig":
+            if not self._name:
+                raise ValueError("indexName must be set")
+            return CoveringIndexConfig(self._name, self._indexed, self._included)
+
+    @staticmethod
+    def builder() -> "CoveringIndexConfig.Builder":
+        return CoveringIndexConfig.Builder()
+
 
 registry.register(CoveringIndex.kind, CoveringIndex.from_derived_dataset)

@@ -71,6 +71,17 @@ class Session:
     def is_hyperspace_enabled(self) -> bool:
         return self.hyperspace_enabled
 
+    # reference-API aliases (ref: HS/package.scala:36-43 spark.enableHyperspace());
+    # delegating defs so subclass overrides stay authoritative
+    def enableHyperspace(self) -> "Session":
+        return self.enable_hyperspace()
+
+    def disableHyperspace(self) -> "Session":
+        return self.disable_hyperspace()
+
+    def isHyperspaceEnabled(self) -> bool:
+        return self.is_hyperspace_enabled()
+
     @contextlib.contextmanager
     def with_hyperspace_disabled(self):
         prev = self.hyperspace_enabled
