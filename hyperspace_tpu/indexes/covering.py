@@ -272,26 +272,39 @@ def write_bucketed(
     columns (row-aligned with ``table``) or None. ``column_order`` fixes the
     output column order. Returns written file paths (bucket order).
     """
+    import time as _time
+
     import jax
 
     from hyperspace_tpu.exec.batch import table_to_batch
     from hyperspace_tpu.ops import encode
     from hyperspace_tpu.ops.sort import bucket_sort_build, padded_size
 
+    timing = os.environ.get("HS_BUILD_TIMING", "") == "1"
+    marks = {}
+
+    def mark(name, t0):
+        if timing:
+            marks[name] = round(_time.perf_counter() - t0, 3)
+        return _time.perf_counter()
+
     os.makedirs(out_dir, exist_ok=True)
     n = table.num_rows
     if n == 0:
         return []
 
+    t = _time.perf_counter()
     batch = table_to_batch(table.select(bucket_sort_columns))
     keys, kinds, host_hashes = encode.encode_sort_columns(
         [batch[c] for c in bucket_sort_columns]
     )
+    t = mark("encode_keys", t)
     np2 = padded_size(n)
     dev_keys = [jax.device_put(np.pad(k, (0, np2 - n))) for k in keys]
     dev_hashes = [jax.device_put(np.pad(h, (0, np2 - n))) for h in host_hashes]
     perm, counts = bucket_sort_build(dev_keys, dev_hashes, kinds, num_buckets, n)
     counts.copy_to_host_async()
+    t = mark("pad_upload_launch", t)
     # the permutation comes back in pieces so bucket writes can start while
     # later pieces are still in flight (device->host is the narrow link)
     n_pieces = min(8, max(1, np2 // (1 << 18)))
@@ -306,20 +319,26 @@ def write_bucketed(
         if payload is not None:
             for name in payload.column_names:
                 table = table.append_column(payload.schema.field(name), payload.column(name))
+    t = mark("payload_decode", t)
     if column_order:
         table = table.select(column_order)
+
     # single-chunk columns so per-bucket takes don't re-resolve chunk offsets
+    # (a numpy-gather variant measured equal within noise; arrow take keeps
+    # string/date columns on one code path)
     table = table.combine_chunks()
+    t = mark("combine_chunks", t)
 
     counts_np = np.asarray(counts)
     boundaries = np.concatenate([[0], np.cumsum(counts_np)])
+    t = mark("counts_wait", t)
 
     def _take_write(b: int, lo: int, hi: int) -> str:
         path = os.path.join(out_dir, _bucket_file_name(b))
-        rows = table.take(pa.array(perm_np[lo:hi]))
         # uncompressed PLAIN is the index-file dialect: the native decoder
         # (hyperspace_tpu/native) mmaps these and memcpys column chunks into
         # device-feedable buffers with zero decompression work
+        rows = table.take(pa.array(perm_np[lo:hi]))
         pq.write_table(rows, path, use_dictionary=False, compression="NONE")
         return path
 
@@ -340,7 +359,16 @@ def write_bucketed(
                 arrived += chunk.shape[0]
                 next_piece += 1
             futures.append(ex.submit(_take_write, b, lo, hi))
-        return [f.result() for f in futures]
+        out = [f.result() for f in futures]
+    mark("perm_drain_take_write", t)
+    if timing:
+        # stderr: bench.py's stdout contract is exactly one JSON line.
+        # (Coarse wall-clock marks complement session.profile()'s XLA traces
+        # for machines without trace tooling; stage labels match.)
+        import sys as _sys
+
+        print(f"HS_BUILD_TIMING rows={n} {marks}", file=_sys.stderr, flush=True)
+    return out
 
 
 class CoveringIndexConfig(IndexConfig):

@@ -100,6 +100,29 @@ class Session:
             self._index_manager = CachingIndexCollectionManager(self)
         return self._index_manager
 
+    # --- profiling ----------------------------------------------------------
+    # The reference delegates runtime profiling to the Spark UI (SURVEY.md
+    # §5.1); here the XLA profiler is the equivalent surface: traces cover the
+    # build/query device programs and host stages, viewable in TensorBoard or
+    # Perfetto.
+    def start_profile(self, log_dir: str) -> None:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+
+    def stop_profile(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+
+    @contextlib.contextmanager
+    def profile(self, log_dir: str):
+        self.start_profile(log_dir)
+        try:
+            yield
+        finally:
+            self.stop_profile()
+
     # --- device mesh --------------------------------------------------------
     @property
     def mesh(self):

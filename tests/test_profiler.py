@@ -1,0 +1,28 @@
+"""XLA profiler hooks — the framework's runtime-profiling surface
+(SURVEY.md §5.1: the reference delegates to the Spark UI; here traces come
+from the XLA profiler)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import hyperspace_tpu as hst
+
+
+def test_profile_context_captures_trace(session, tmp_path):
+    d = tmp_path / "d"
+    d.mkdir()
+    pq.write_table(
+        pa.table({"k": np.arange(500, dtype=np.int64), "v": np.arange(500.0)}),
+        d / "p.parquet",
+    )
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    hs = hst.Hyperspace(session)
+    df = session.read_parquet(str(d))
+    prof_dir = str(tmp_path / "prof")
+    with session.profile(prof_dir):
+        hs.create_index(df, hst.CoveringIndexConfig("profIdx", ["k"], ["v"]))
+    files = [f for _, _, fs in os.walk(prof_dir) for f in fs]
+    assert files, "profiler produced no trace files"
