@@ -33,6 +33,14 @@ def source_data_changed() -> FilterReason:
     return FilterReason("SOURCE_DATA_CHANGED", (), "Index signature does not match the current source data.")
 
 
+def signature_provider_mismatch(recorded: str) -> FilterReason:
+    return FilterReason(
+        "SIGNATURE_PROVIDER_MISMATCH",
+        (("recordedProvider", recorded),),
+        f"Index was recorded under signature provider {recorded!r}; refresh the index to re-sign it.",
+    )
+
+
 def no_delete_support() -> FilterReason:
     return FilterReason("NO_DELETE_SUPPORT", (), "Index doesn't support deleted files (no lineage).")
 

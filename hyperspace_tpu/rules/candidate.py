@@ -17,7 +17,7 @@ from hyperspace_tpu.analysis import reasons as R
 from hyperspace_tpu.models.log_entry import FileInfo, IndexLogEntry
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.rules.context import RuleContext
-from hyperspace_tpu.sources.signatures import index_signature
+from hyperspace_tpu.sources.signatures import INDEX_SIGNATURE_PROVIDER, index_signature
 
 
 def _referenced_columns(entry: IndexLogEntry) -> List[str]:
@@ -77,7 +77,16 @@ def _signature_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntr
     out = []
     for e in indexes:
         entry = scan.relation.closest_index(e)
-        if entry.signature.signatures and entry.signature.signatures[0].value == current_sig:
+        sig0 = entry.signature.signatures[0] if entry.signature.signatures else None
+        if sig0 is not None and sig0.provider != INDEX_SIGNATURE_PROVIDER:
+            # recorded under an older/incompatible provider: values are not
+            # comparable — require a refresh rather than mis-reporting
+            # "source data changed"
+            ctx.tag_reason_if_failed(
+                False, entry, scan, lambda: R.signature_provider_mismatch(sig0.provider)
+            )
+            continue
+        if sig0 is not None and sig0.value == current_sig:
             entry.set_tag(L.plan_key(scan), R.COMMON_SOURCE_SIZE_IN_BYTES, entry.source_files_size())
             entry.set_tag(L.plan_key(scan), R.HYBRIDSCAN_REQUIRED, False)
             out.append(entry)

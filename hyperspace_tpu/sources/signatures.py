@@ -13,7 +13,11 @@ from typing import Optional
 from hyperspace_tpu.utils.hashing import md5_hex
 
 FILE_BASED_SIGNATURE_PROVIDER = "FileBasedSignatureProvider"
-INDEX_SIGNATURE_PROVIDER = "IndexSignatureProvider"
+# /v2: the plan-structure token canonicalizes Scan by format instead of by
+# root-path spelling (glob/dir/file-list addressing of the same files now
+# signature-equal). Entries recorded under an older provider are disqualified
+# with an explicit provider-mismatch reason until refreshed.
+INDEX_SIGNATURE_PROVIDER = "IndexSignatureProvider/v2"
 
 
 def file_based_signature(file_infos) -> str:
@@ -28,7 +32,13 @@ def plan_structure_string(plan) -> str:
 
     def walk(p) -> str:
         if isinstance(p, L.Scan):
-            return f"Scan({','.join(sorted(p.relation.root_paths))})"
+            # canonicalize by format, not path spelling: the same file set is
+            # addressable as a directory, a glob, or an explicit list, and
+            # data identity is already carried by the file-based signature
+            # (the reference needs a globbingPattern conf for this,
+            # HS/index/IndexConstants + DataPathFilter; resolved-file identity
+            # subsumes it)
+            return f"Scan({p.relation.file_format})"
         name = type(p).__name__
         inner = ",".join(walk(c) for c in p.children())
         if isinstance(p, L.Project):

@@ -1,0 +1,155 @@
+"""Source addressing: glob patterns and non-parquet formats.
+
+The reference's E2E suite covers globbing patterns (E2EHyperspaceRulesTest;
+conf ``spark.hyperspace.source.globbingPattern``) and CSV/JSON sources
+(DefaultFileBasedSource supported formats, HS/util/HyperspaceConf.scala:94-99).
+Here path spelling is canonicalized out of the plan fingerprint, so an index
+applies regardless of whether the data was addressed as a directory or a
+glob — no conf needed.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+def _index_scans(q):
+    return [p for p in L.collect(q.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+
+
+def _write_parquet_files(d, n_files=3, rows=1000):
+    rng = np.random.default_rng(0)
+    os.makedirs(d, exist_ok=True)
+    for i in range(n_files):
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 50, rows).astype(np.int64),
+                    "v": rng.standard_normal(rows),
+                }
+            ),
+            os.path.join(d, f"p{i}.parquet"),
+        )
+
+
+class TestGlobAddressing:
+    def test_index_from_glob_applies_to_dir_read(self, session, hs, tmp_path):
+        d = str(tmp_path / "t")
+        _write_parquet_files(d)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        dfg = session.read_parquet(os.path.join(d, "*.parquet"))
+        hs.create_index(dfg, hst.CoveringIndexConfig("globIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        dfd = session.read_parquet(d)
+        q = dfd.filter(hst.col("k") == 7).select("v")
+        assert _index_scans(q), q.optimized_plan().pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert np.array_equal(np.sort(on["v"]), np.sort(off["v"]))
+
+    def test_index_from_dir_applies_to_glob_read(self, session, hs, tmp_path):
+        d = str(tmp_path / "t2")
+        _write_parquet_files(d)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        dfd = session.read_parquet(d)
+        hs.create_index(dfd, hst.CoveringIndexConfig("dirIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        dfg = session.read_parquet(os.path.join(d, "*.parquet"))
+        q = dfg.filter(hst.col("k") == 3).select("v")
+        assert _index_scans(q), q.optimized_plan().pretty()
+
+    def test_changed_file_set_still_disqualifies(self, session, hs, tmp_path):
+        d = str(tmp_path / "t3")
+        _write_parquet_files(d)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(d)
+        hs.create_index(df, hst.CoveringIndexConfig("chIdx", ["k"], ["v"]))
+        _write_parquet_files(d, n_files=4)  # appended file -> different set
+        session.enable_hyperspace()
+        df2 = session.read_parquet(d)
+        q = df2.filter(hst.col("k") == 1).select("v")
+        assert not _index_scans(q)  # no hybrid scan conf -> disqualified
+
+
+class TestSignatureProviderVersioning:
+    def test_old_provider_disqualifies_with_clear_reason(self, session, hs, tmp_path, monkeypatch):
+        """An index signed under an older provider is not comparable — it must
+        be disqualified with a provider-mismatch reason, not a misleading
+        'source data changed'."""
+        d = str(tmp_path / "sv")
+        _write_parquet_files(d)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(d)
+        hs.create_index(df, hst.CoveringIndexConfig("svIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+
+        import hyperspace_tpu.rules.candidate as cand
+        import hyperspace_tpu.sources.signatures as sigs
+
+        monkeypatch.setattr(sigs, "INDEX_SIGNATURE_PROVIDER", "IndexSignatureProvider/v99")
+        monkeypatch.setattr(cand, "INDEX_SIGNATURE_PROVIDER", "IndexSignatureProvider/v99")
+        session.index_manager.clear_cache()
+        q = df.filter(hst.col("k") == 7).select("v")
+        assert not _index_scans(q)
+        report = hs.why_not(q)
+        assert "SIGNATURE_PROVIDER_MISMATCH" in report
+
+
+class TestCsvJsonSources:
+    def test_csv_index_end_to_end(self, session, hs, tmp_path):
+        d = tmp_path / "csv"
+        d.mkdir()
+        rng = np.random.default_rng(1)
+        t = pa.table(
+            {
+                "k": rng.integers(0, 30, 800).astype(np.int64),
+                "v": np.round(rng.standard_normal(800), 6),
+            }
+        )
+        pacsv.write_csv(t, d / "data.csv")
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_csv(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("csvIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("k") == 5).select("v")
+        assert _index_scans(q), q.optimized_plan().pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert np.allclose(np.sort(on["v"]), np.sort(off["v"]))
+
+    def test_json_index_end_to_end(self, session, hs, tmp_path):
+        import json
+
+        d = tmp_path / "json"
+        d.mkdir()
+        rng = np.random.default_rng(2)
+        with open(d / "data.json", "w") as f:
+            for _ in range(500):
+                f.write(json.dumps({"k": int(rng.integers(0, 20)), "v": float(rng.random())}) + "\n")
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_json(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("jsonIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("k") == 5).select("v")
+        assert _index_scans(q), q.optimized_plan().pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert np.allclose(np.sort(on["v"]), np.sort(off["v"]))
