@@ -676,11 +676,28 @@ def _expand_join_pairs(
 ) -> B.Batch:
     """Pair expansion (variable-size output) + column gather, shared by the
     device and host span backends. ``span_of(b)`` returns (lo, hi) arrays of
-    length len(left bucket b) — the matching right-row span per left row."""
-    out_batches: List[B.Batch] = []
+    length len(left bucket b) — the matching right-row span per left row.
+
+    Two passes: spans/counts first, then gathers straight into preallocated
+    output columns (a concat of per-bucket batches would copy the whole
+    result a second time)."""
     out_cols = plan.output_columns
     lout = list(lcols_needed)
     rout = list(rcols_needed)
+
+    def column_source(name: str):
+        """(side batches, source column name) for one output column."""
+        if name in lout:
+            return lbuckets, name, True
+        if name.endswith("#r") and name[:-2] in rout:
+            return rbuckets, name[:-2], False
+        if name in rout:
+            return rbuckets, name, False
+        raise DeviceUnsupported(f"join output column {name!r} not found on either side")
+
+    # pass 1: spans + counts
+    chunks = []  # (bucket, lo, counts, out_offset, chunk_total)
+    total = 0
     for b in range(nb):
         if b not in lbuckets or b not in rbuckets:
             continue
@@ -689,39 +706,45 @@ def _expand_join_pairs(
             continue
         lo_b, hi_b = span_of(b)
         counts = (hi_b - lo_b).astype(np.int64)
-        total = int(counts.sum())
-        if total == 0:
+        chunk_total = int(counts.sum())
+        if chunk_total == 0:
             continue
+        chunks.append((b, lo_b, counts, total, chunk_total))
+        total += chunk_total
+
+    sources = {name: column_source(name) for name in out_cols}
+    participating = [c[0] for c in chunks]
+
+    def out_dtype(name: str) -> np.dtype:
+        src, col, _ = sources[name]
+        # promote across participating buckets (a nullable int column decodes
+        # as float64 only in buckets whose files hold nulls), matching what
+        # np.concatenate of per-bucket results used to do
+        dtypes = [src[b][col].dtype for b in (participating or src) if col in src.get(b, {})]
+        if not dtypes:
+            dtypes = [bb[col].dtype for bb in src.values() if col in bb]
+        if not dtypes:
+            raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
+        if any(dt == object for dt in dtypes):
+            return np.dtype(object)
+        return np.result_type(*dtypes)
+
+    out = {name: np.empty(total, dtype=out_dtype(name)) for name in out_cols}
+    if total == 0:
+        return out
+
+    # pass 2: gather into the preallocated columns
+    for b, lo_b, counts, off, chunk_total in chunks:
+        ll = counts.shape[0]
         lidx = np.repeat(np.arange(ll), counts)
         # right indices: for row i, lo[i] .. hi[i]-1
         offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
-        ridx = np.arange(total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
-        lb, rb = lbuckets[b], rbuckets[b]
-        out: B.Batch = {}
+        ridx = np.arange(chunk_total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
         for name in out_cols:
-            if name in lout:
-                out[name] = lb[name][lidx]
-            elif name.endswith("#r") and name[:-2] in rout:
-                out[name] = rb[name[:-2]][ridx]
-            elif name in rout:
-                out[name] = rb[name][ridx]
-            else:
-                raise DeviceUnsupported(f"join output column {name!r} not found on either side")
-        out_batches.append(out)
-    if not out_batches:
-        # preserve real column dtypes in the empty result
-        def empty_like(name: str) -> np.ndarray:
-            if name in lout:
-                src, col = lbuckets, name
-            else:
-                src, col = rbuckets, name[:-2] if name.endswith("#r") else name
-            for b in src.values():
-                if col in b:
-                    return np.empty(0, dtype=b[col].dtype)
-            raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
-
-        return {name: empty_like(name) for name in out_cols}
-    return B.concat(out_batches)
+            src, col, is_left = sources[name]
+            arr = src[b][col]
+            out[name][off : off + chunk_total] = arr[lidx if is_left else ridx]
+    return out
 
 
 def device_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:

@@ -343,6 +343,34 @@ class TestDeviceJoin:
         assert calls[-1] == "host"
         assert_batches_equal(low, high)
 
+    def test_expand_pairs_promotes_mixed_bucket_dtypes(self):
+        """A nullable int column decodes as float64 (with NaN) only in the
+        buckets whose files hold nulls; the preallocated output must promote
+        across buckets instead of truncating into the first bucket's dtype."""
+        from hyperspace_tpu.exec.device import _expand_join_pairs
+
+        class FakeJoin:
+            output_columns = ["k", "val"]
+
+        lbuckets = {
+            0: {"k": np.array([1, 2], dtype=np.int64), "val": np.array([10, 20], dtype=np.int64)},
+            1: {"k": np.array([3], dtype=np.int64), "val": np.array([np.nan], dtype=np.float64)},
+        }
+        rbuckets = {
+            0: {"k": np.array([1, 2], dtype=np.int64)},
+            1: {"k": np.array([3], dtype=np.int64)},
+        }
+
+        def span_of(b):
+            lk = lbuckets[b]["k"]
+            rk = rbuckets[b]["k"]
+            return np.searchsorted(rk, lk, "left"), np.searchsorted(rk, lk, "right")
+
+        out = _expand_join_pairs(FakeJoin(), lbuckets, rbuckets, 2, ["k", "val"], ["k"], span_of)
+        assert out["val"].dtype == np.float64
+        assert np.isnan(out["val"][-1])
+        np.testing.assert_array_equal(out["val"][:2], [10.0, 20.0])
+
     def test_string_key_join_falls_back_to_host(self, session, hs, tmp_path):
         lroot, rroot = tmp_path / "l3", tmp_path / "r3"
         lroot.mkdir()
