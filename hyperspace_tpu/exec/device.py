@@ -722,8 +722,6 @@ def _expand_join_pairs(
         # np.concatenate of per-bucket results used to do
         dtypes = [src[b][col].dtype for b in (participating or src) if col in src.get(b, {})]
         if not dtypes:
-            dtypes = [bb[col].dtype for bb in src.values() if col in bb]
-        if not dtypes:
             raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
         if any(dt == object for dt in dtypes):
             return np.dtype(object)

@@ -1,0 +1,151 @@
+"""TTL index cache, composite-key indexes, and case sensitivity.
+
+Reference counterparts: IndexCacheTest (TTL expiry), CreateIndexTest
+multi-column indexes, and E2EHyperspaceRulesTest's case-sensitivity cases
+(SURVEY.md §4).
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+def _index_scans(q):
+    return [p for p in L.collect(q.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+
+
+def write_two_tables(tmp_path):
+    rng = np.random.default_rng(0)
+    l, r = tmp_path / "l", tmp_path / "r"
+    l.mkdir()
+    r.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "a": rng.integers(0, 10, 2000).astype(np.int64),
+                "b": rng.integers(0, 10, 2000).astype(np.int64),
+                "v": rng.standard_normal(2000),
+            }
+        ),
+        l / "p.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "a": rng.integers(0, 10, 500).astype(np.int64),
+                "b": rng.integers(0, 10, 500).astype(np.int64),
+                "w": rng.standard_normal(500),
+            }
+        ),
+        r / "p.parquet",
+    )
+    return str(l), str(r)
+
+
+class TestIndexCache:
+    def test_cache_serves_entries_within_ttl(self, session, hs, tmp_path):
+        lpath, _ = write_two_tables(tmp_path)
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_parquet(lpath)
+        hs.create_index(df, hst.CoveringIndexConfig("cacheIdx", ["a"], ["v"]))
+        mgr = session.index_manager
+        assert any(e.name == "cacheIdx" for e in mgr.get_indexes())
+        # remove the index behind the manager's back: the TTL cache (300 s
+        # default) still serves the stale listing
+        shutil.rmtree(os.path.join(session.conf.get(hst.keys.SYSTEM_PATH), "cacheIdx"))
+        assert any(e.name == "cacheIdx" for e in mgr.get_indexes())
+
+    def test_cache_expiry_refetches(self, session, hs, tmp_path):
+        lpath, _ = write_two_tables(tmp_path)
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_parquet(lpath)
+        hs.create_index(df, hst.CoveringIndexConfig("ttlIdx", ["a"], ["v"]))
+        mgr = session.index_manager
+        assert any(e.name == "ttlIdx" for e in mgr.get_indexes())
+        shutil.rmtree(os.path.join(session.conf.get(hst.keys.SYSTEM_PATH), "ttlIdx"))
+        session.conf.set(hst.keys.CACHE_EXPIRY_SECONDS, 0)  # everything expired
+        assert not any(e.name == "ttlIdx" for e in mgr.get_indexes())
+
+    def test_mutations_invalidate(self, session, hs, tmp_path):
+        lpath, _ = write_two_tables(tmp_path)
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_parquet(lpath)
+        hs.create_index(df, hst.CoveringIndexConfig("invIdx", ["a"], ["v"]))
+        mgr = session.index_manager
+        mgr.get_indexes()  # populate cache
+        hs.delete_index("invIdx")  # mutation clears it
+        from hyperspace_tpu.models import states
+
+        active = mgr.get_indexes([states.ACTIVE])
+        assert not any(e.name == "invIdx" for e in active)
+
+
+class TestCompositeKeyIndexes:
+    def test_multikey_filter_and_join(self, session, hs, tmp_path):
+        lpath, rpath = write_two_tables(tmp_path)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf = session.read_parquet(lpath)
+        rdf = session.read_parquet(rpath)
+        hs.create_index(ldf, hst.CoveringIndexConfig("mkL", ["a", "b"], ["v"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("mkR", ["a", "b"], ["w"]))
+        session.enable_hyperspace()
+
+        q1 = ldf.filter((hst.col("a") == 3) & (hst.col("b") > 5)).select("v")
+        assert _index_scans(q1), q1.optimized_plan().pretty()
+        on = q1.collect()
+        session.disable_hyperspace()
+        off = q1.collect()
+        session.enable_hyperspace()
+        assert np.array_equal(np.sort(on["v"]), np.sort(off["v"]))
+
+        q2 = ldf.join(rdf, on=["a", "b"]).select("v", "w")
+        assert len(_index_scans(q2)) == 2, q2.optimized_plan().pretty()
+        on2 = q2.collect()
+        session.disable_hyperspace()
+        off2 = q2.collect()
+        session.enable_hyperspace()
+        assert sorted(zip(on2["v"], on2["w"])) == sorted(zip(off2["v"], off2["w"]))
+        assert len(on2["v"]) > 0
+
+    def test_join_on_subset_of_indexed_cols_not_rewritten(self, session, hs, tmp_path):
+        """Indexed cols must equal join cols exactly (ref: JoinColumnFilter)."""
+        lpath, rpath = write_two_tables(tmp_path)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf = session.read_parquet(lpath)
+        rdf = session.read_parquet(rpath)
+        hs.create_index(ldf, hst.CoveringIndexConfig("subL", ["a", "b"], ["v"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("subR", ["a", "b"], ["w"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=["a"]).select("v", "w")
+        assert not _index_scans(q)
+
+
+class TestCaseSensitivity:
+    def test_mixed_case_references_resolve(self, session, hs, tmp_path):
+        lpath, _ = write_two_tables(tmp_path)
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_parquet(lpath)
+        hs.create_index(df, hst.CoveringIndexConfig("caseIdx", ["A"], ["V"]))  # wrong-case config
+        session.enable_hyperspace()
+        q = df.filter(hst.col("A") == 3).select("V")
+        assert _index_scans(q), q.optimized_plan().pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        (on_col,) = on.values()
+        (off_col,) = off.values()
+        assert np.array_equal(np.sort(on_col), np.sort(off_col))
+        assert len(on_col) > 0
