@@ -74,6 +74,38 @@ class Action:
         latest.state = self.transient_state
         return latest
 
+    # Actions whose final entry snapshots a fresh view of the source (create,
+    # full/incremental refresh) record a source-version -> log-id history
+    # entry; every other action only carries the history forward — recording
+    # there would map new log ids onto stale logged versions (e.g. quick
+    # refresh copies an entry whose versionAsOf predates the data it covers
+    # via hybrid scan).
+    records_source_version: bool = False
+
+    def _enrich_final(self, final: IndexLogEntry, final_id: int) -> None:
+        """Source-provider property enrichment at commit time (ref:
+        CreateActionBase enriched props + DeltaLakeRelationMetadata's
+        deltaVersions history)."""
+        source = getattr(final, "source", None)
+        if source is None or source.relation is None:
+            return
+        from hyperspace_tpu.sources.manager import HyperspaceException
+
+        try:
+            meta = self.session.provider_manager.create_relation_metadata(source.relation)
+        except HyperspaceException:
+            # no provider answers for this logged relation (e.g. builders
+            # reconfigured since the index was created) — nothing to enrich
+            return
+        if meta is None:
+            return
+        prev = self.log_manager.get_log(self.base_id) if self.base_id >= 0 else None
+        final.properties = meta.enrich_index_properties(
+            dict(final.properties),
+            log_id=final_id if self.records_source_version else None,
+            previous_properties=(prev.properties if prev is not None else None),
+        )
+
     # --- protocol ----------------------------------------------------------
     def _emit(self, state: str, message: str = "") -> None:
         get_event_logger(self.session).log_event(
@@ -97,6 +129,7 @@ class Action:
             final = self.log_entry()
             final.state = self.final_state
             final.timestamp = int(time.time() * 1000)
+            self._enrich_final(final, self.base_id + 2)
             if not self.log_manager.write_log(self.base_id + 2, final):
                 raise ConcurrentModificationException(
                     f"Failed to commit final state for index {self.index_name!r}."

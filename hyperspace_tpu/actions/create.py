@@ -27,6 +27,7 @@ from hyperspace_tpu.version import INDEX_LOG_VERSION, __version__
 
 
 class CreateAction(Action):
+    records_source_version = True
     transient_state = states.CREATING
     final_state = states.ACTIVE
     event_class = CreateActionEvent

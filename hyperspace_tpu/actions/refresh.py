@@ -110,6 +110,7 @@ class _RefreshActionBase(Action):
 
 
 class RefreshFullAction(_RefreshActionBase):
+    records_source_version = True
     """Full rebuild (ref: RefreshAction.scala:33-64)."""
 
     event_class = RefreshActionEvent
@@ -135,6 +136,7 @@ class RefreshFullAction(_RefreshActionBase):
 
 
 class RefreshIncrementalAction(_RefreshActionBase):
+    records_source_version = True
     """Index only the appended files; drop rows of deleted files via lineage
     (ref: RefreshIncrementalAction.scala:45-133)."""
 

@@ -20,7 +20,7 @@ import os
 import re
 import time
 import uuid
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import pyarrow as pa
 import pyarrow.dataset as pads
@@ -177,13 +177,22 @@ class DeltaLakeRelation(FileBasedRelation):
         history = entry.properties.get(DELTA_VERSIONS_PROPERTY)
         if not history:
             return entry
-        # history: {index_log_id(str): delta_version(int)}
+        # history: {index_log_id(str): delta_version(int)}; among versions at
+        # most the queried one, prefer the highest version and, on ties, the
+        # LATEST log id (earlier ids for the same version are superseded)
         best_log_id, best_delta = None, None
         for log_id_str, delta_v in history.items():
-            dv = int(delta_v)
-            if dv <= self._version and (best_delta is None or dv > best_delta):
-                best_log_id, best_delta = int(log_id_str), dv
+            dv, lid = int(delta_v), int(log_id_str)
+            if dv <= self._version and (best_delta is None or (dv, lid) > (best_delta, best_log_id)):
+                best_log_id, best_delta = lid, dv
         if best_log_id is None or best_log_id == entry.id:
+            return entry
+        # the LATEST entry covers the newest recorded snapshot even when its
+        # own id isn't in the history (optimize/restore entries supersede the
+        # recording refresh without changing source coverage) — only reach
+        # back for a strictly older snapshot
+        latest_recorded = max(int(v) for v in history.values())
+        if best_delta >= latest_recorded:
             return entry
         from hyperspace_tpu.models.log_manager import IndexLogManager
         from hyperspace_tpu.models.path_resolver import PathResolver
@@ -205,8 +214,28 @@ class DeltaLakeRelationMetadata(FileBasedRelationMetadata):
     def to_relation_object(self) -> DeltaLakeRelation:
         return DeltaLakeRelation(self.relation.root_paths[0])  # latest version
 
-    def enrich_index_properties(self, properties: Dict[str, str]) -> Dict[str, str]:
-        return properties
+    def enrich_index_properties(
+        self,
+        properties: Dict[str, Any],
+        log_id: Optional[int] = None,
+        previous_properties: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Maintain the index-log-version -> delta-table-version history that
+        time-travel queries consult via ``closest_index``
+        (ref: DeltaLakeRelationMetadata.scala:39-53 deltaVersions).
+
+        ``log_id=None`` means carry the history forward without recording
+        (actions whose entries copy their predecessor)."""
+        history = dict((previous_properties or {}).get(DELTA_VERSIONS_PROPERTY) or {})
+        if log_id is not None:
+            version = self.relation.options.get("versionAsOf")
+            if version is not None:
+                history[str(log_id)] = int(version)
+        if not history:
+            return properties
+        out = dict(properties)
+        out[DELTA_VERSIONS_PROPERTY] = history
+        return out
 
 
 class DeltaLakeFileBasedSource(FileBasedSourceProvider):

@@ -201,7 +201,7 @@ class IcebergRelationMetadata(FileBasedRelationMetadata):
     def internal_file_format_name(self) -> str:
         return "parquet"
 
-    def enrich_index_properties(self, properties: Dict[str, str]) -> Dict[str, str]:
+    def enrich_index_properties(self, properties, log_id=None, previous_properties=None):
         return properties
 
 

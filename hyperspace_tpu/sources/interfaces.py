@@ -10,7 +10,7 @@ Mirrors the reference's pluggable source layer
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
 import pyarrow as pa
 
@@ -94,7 +94,18 @@ class FileBasedRelationMetadata:
     def internal_file_format_name(self) -> str:
         return self.relation.file_format
 
-    def enrich_index_properties(self, properties: Dict[str, str]) -> Dict[str, str]:
+    def enrich_index_properties(
+        self,
+        properties: Dict[str, Any],
+        log_id: Optional[int] = None,
+        previous_properties: Optional[Dict[str, Any]] = None,
+    ) -> Dict[str, Any]:
+        """Provider hook run when an action commits its final log entry
+        (ref: FileBasedRelationMetadata.enrichIndexProperties,
+        HS/index/sources/interfaces.scala:249-272): ``log_id`` is the entry's
+        id and ``previous_properties`` the preceding entry's properties, so a
+        provider can maintain per-log-version history (Delta's
+        ``deltaVersions`` time-travel map)."""
         return properties
 
 

@@ -85,6 +85,64 @@ class TestDeltaSource:
         assert any(isinstance(p, L.BucketUnion) for p in L.collect(plan, lambda p: True)), plan.pretty()
         assert_batches_equal(q.collect(), baseline)
 
+    def test_time_travel_picks_closest_index_version(self, session, hs, delta_root):
+        """closest_index: querying an older table version must use the index
+        log version recorded for that delta version, not the latest
+        (ref: DeltaLakeRelation.scala:179-251 deltaVersions history)."""
+        from hyperspace_tpu.sources.delta import DELTA_VERSIONS_PROPERTY
+
+        df0 = session.read_delta(delta_root)
+        v0 = df0.plan.relation.version
+        hs.create_index(df0, hst.CoveringIndexConfig("deltaTT", ["k"], ["v"]))
+        write_delta_table(make_table(11), delta_root)
+        hs.refresh_index("deltaTT", "incremental")
+        entry = session.index_manager.get_index("deltaTT")
+        history = entry.properties.get(DELTA_VERSIONS_PROPERTY)
+        assert history and len(history) >= 2  # create + refresh recorded
+
+        session.enable_hyperspace()
+        # latest query -> latest index log version
+        q_latest = session.read_delta(delta_root).filter(hst.col("k") == 7).select("v")
+        latest_scans = [p for p in L.collect(q_latest.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+        assert latest_scans
+        # time travel -> the older index log version covering v0
+        q_old = session.read_delta(delta_root, version=v0).filter(hst.col("k") == 7).select("v")
+        old_scans = [p for p in L.collect(q_old.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+        assert old_scans, q_old.optimized_plan().pretty()
+        assert old_scans[0].entry.id < latest_scans[0].entry.id
+        on = q_old.collect()
+        session.disable_hyperspace()
+        assert_batches_equal(on, q_old.collect())
+        session.enable_hyperspace()
+
+    def test_maintenance_entries_do_not_pollute_time_travel(self, session, hs, delta_root):
+        """optimize/delete/restore copy their predecessor entry: they must
+        carry the deltaVersions history forward without recording new ids,
+        and latest-version queries must use the latest entry (not reach back
+        to the superseded pre-optimize log)."""
+        from hyperspace_tpu.sources.delta import DELTA_VERSIONS_PROPERTY
+
+        df0 = session.read_delta(delta_root)
+        v0 = df0.plan.relation.version
+        hs.create_index(df0, hst.CoveringIndexConfig("deltaMnt", ["k"], ["v"]))
+        write_delta_table(make_table(12), delta_root)
+        hs.refresh_index("deltaMnt", "incremental")
+        hs.optimize_index("deltaMnt", "full")
+        hs.delete_index("deltaMnt")
+        hs.restore_index("deltaMnt")
+        entry = session.index_manager.get_index("deltaMnt")
+        history = entry.properties.get(DELTA_VERSIONS_PROPERTY)
+        assert set(history.values()) == {v0, v0 + 1}
+        assert len(history) == 2  # only create + incremental refresh recorded
+
+        session.enable_hyperspace()
+        q = session.read_delta(delta_root).filter(hst.col("k") == 7).select("v")
+        scans = [p for p in L.collect(q.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+        assert scans and scans[0].entry.id == entry.id  # latest, post-optimize
+        q_old = session.read_delta(delta_root, version=v0).filter(hst.col("k") == 7).select("v")
+        old_scans = [p for p in L.collect(q_old.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+        assert old_scans and old_scans[0].entry.id < entry.id
+
     def test_refresh_delta_index(self, session, hs, delta_root):
         df = session.read_delta(delta_root)
         hs.create_index(df, hst.CoveringIndexConfig("deltaRef", ["k"], ["v"]))
