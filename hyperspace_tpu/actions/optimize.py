@@ -80,15 +80,49 @@ class OptimizeAction(Action):
             )
 
     def op(self) -> None:
+        import pyarrow.parquet as pq
+
         index = registry.index_of_entry(self._entry)
         assert isinstance(index, CoveringIndex)
         latest = self.data_manager.get_latest_version()
         self._version = 0 if latest is None else latest + 1
         out_dir = self.data_manager.version_path(self._version)
-        files = [fi.name for group in self._to_optimize.values() for fi in group]
-        table = pads.dataset(files, format="parquet").to_table()
-        # one write_bucketed pass re-buckets + re-sorts the merged rows
-        write_bucketed(table, index.indexed_columns, index.num_buckets, out_dir)
+
+        # Compaction must leave ONE file per optimized bucket, so chunking by
+        # row ranges (which splits buckets into multiple runs and would make
+        # repeated optimize calls non-convergent) is not an option here.
+        # Device memory is bounded instead by processing whole-bucket GROUPS
+        # whose total rows fit the batch budget; a single oversized bucket
+        # becomes its own group.
+        budget = self.session.conf.build_batch_rows
+
+        def bucket_rows(fis) -> int:
+            total = 0
+            for fi in fis:
+                try:
+                    total += pq.read_metadata(fi.name).num_rows
+                except OSError:
+                    return 1 << 62  # unknown -> force its own group
+            return total
+
+        groups: List[List[int]] = []
+        cur: List[int] = []
+        cur_rows = 0
+        for b in sorted(self._to_optimize):
+            rows = bucket_rows(self._to_optimize[b])
+            if cur and budget > 0 and cur_rows + rows > budget:
+                groups.append(cur)
+                cur, cur_rows = [], 0
+            cur.append(b)
+            cur_rows += rows
+        if cur:
+            groups.append(cur)
+
+        for group in groups:
+            files = [fi.name for b in group for fi in self._to_optimize[b]]
+            table = pads.dataset(files, format="parquet").to_table()
+            # one write_bucketed pass per group re-buckets + re-sorts
+            write_bucketed(table, index.indexed_columns, index.num_buckets, out_dir)
 
     def log_entry(self) -> IndexLogEntry:
         new_content = Content.from_directory(self.data_manager.version_path(self._version), self._tracker)

@@ -163,13 +163,20 @@ class CoveringIndex(Index):
                 ctx.index_data_path,
                 payload_fn=payload_fn,
                 column_order=columns,
+                batch_rows=ctx.session.conf.build_batch_rows,
             )
             schema = pa.schema([_arrow_field_for(r, ds.schema) for r in resolved])
             self.schema_json = schema_codec.schema_to_json(schema)
             return
 
         table = self._index_data_table(ctx, df)
-        write_bucketed(table, self._indexed, self.num_buckets, ctx.index_data_path)
+        write_bucketed(
+            table,
+            self._indexed,
+            self.num_buckets,
+            ctx.index_data_path,
+            batch_rows=ctx.session.conf.build_batch_rows,
+        )
         self.schema_json = schema_codec.schema_to_json(table.schema)
 
     def _resolve_all(self, ctx: CreateContext, schema: pa.Schema):
@@ -254,6 +261,7 @@ def write_bucketed(
     out_dir: str,
     payload_fn=None,
     column_order: Optional[List[str]] = None,
+    batch_rows: Optional[int] = None,
 ) -> List[str]:
     """Device-accelerated bucketed + sorted Parquet write.
 
@@ -270,7 +278,13 @@ def write_bucketed(
     ``table`` must hold at least ``bucket_sort_columns``; ``payload_fn``, if
     given, is called after the device launch and returns the remaining
     columns (row-aligned with ``table``) or None. ``column_order`` fixes the
-    output column order. Returns written file paths (bucket order).
+    output column order.
+
+    ``batch_rows`` (> 0) caps rows per device program: larger tables are
+    processed in chunks, each writing its own sorted run per bucket (the
+    multi-run state incremental refresh also produces; optimize compacts
+    it). Returns written file paths — bucket order within each chunk,
+    chunk-major with repeated bucket ids when chunking kicks in.
     """
     import time as _time
 
@@ -292,6 +306,40 @@ def write_bucketed(
     n = table.num_rows
     if n == 0:
         return []
+
+    if batch_rows is not None and batch_rows > 0 and n > batch_rows:
+        # chunked build: each chunk runs the single-shot device program and
+        # writes its own sorted run per bucket, bounding device memory at
+        # ~batch_rows regardless of table size. Multi-run buckets are the
+        # same physical state incremental refresh produces (UpdateMode.Merge)
+        # — the join path re-sorts them lazily and optimize compacts them.
+        # payload decodes lazily on first use, so chunk 0's device launch
+        # still overlaps it (per-chunk slices are zero-copy afterwards)
+        payload_cell: List[Optional[pa.Table]] = []
+
+        def full_payload() -> Optional[pa.Table]:
+            if not payload_cell:
+                payload_cell.append(payload_fn() if payload_fn is not None else None)
+            return payload_cell[0]
+
+        paths: List[str] = []
+        for off in range(0, n, batch_rows):
+            chunk_payload_fn = None
+            if payload_fn is not None:
+                def chunk_payload_fn(off=off):
+                    p = full_payload()
+                    return p.slice(off, batch_rows) if p is not None else None
+            paths.extend(
+                write_bucketed(
+                    table.slice(off, batch_rows),
+                    bucket_sort_columns,
+                    num_buckets,
+                    out_dir,
+                    payload_fn=chunk_payload_fn,
+                    column_order=column_order,
+                )
+            )
+        return paths
 
     t = _time.perf_counter()
     batch = table_to_batch(table.select(bucket_sort_columns))
