@@ -25,6 +25,9 @@ class keys:
     LINEAGE_ENABLED = "hyperspace.index.lineage.enabled"
     OPTIMIZE_FILE_SIZE_THRESHOLD = "hyperspace.index.optimize.fileSizeThreshold"
     SOURCE_BUILDERS = "hyperspace.index.sources.fileBasedBuilders"
+    # Accepted for reference compatibility but inert here: plan fingerprints
+    # canonicalize path spelling away, so glob-addressed and dir-addressed
+    # reads of the same files already signature-match (sources/signatures.py).
     GLOBBING_PATTERN = "hyperspace.source.globbingPattern"
     DATASKIPPING_TARGET_FILE_SIZE = "hyperspace.index.dataskipping.targetIndexDataFileSize"
     EVENT_LOGGER_CLASS = "hyperspace.eventLoggerClass"

@@ -22,6 +22,12 @@ import pyarrow as pa
 import pyarrow.dataset as pads
 import pyarrow.parquet as pq
 
+from hyperspace_tpu.utils.lru import BytesLRU
+
+# sketch tables keyed by the log entry's recorded file identities;
+# refresh/optimize produce new entries with new keys and invalidate naturally
+_SKETCH_TABLE_CACHE = BytesLRU(int(os.environ.get("HS_SKETCH_CACHE_BYTES", 64 << 20)))
+
 from hyperspace_tpu import config as C
 from hyperspace_tpu.indexes import registry
 from hyperspace_tpu.indexes.base import CreateContext, Index, IndexConfig, UpdateMode
@@ -333,7 +339,16 @@ class DataSkippingIndex(Index):
         pq.write_table(table, os.path.join(out_dir, "sketches-00000.parquet"))
 
     def read_sketch_table(self, entry) -> pa.Table:
-        return pads.dataset(entry.content.files, format="parquet").to_table()
+        """Sketch tables are tiny (one row per source file) but consulted on
+        every optimizer pass — cache them by the log entry's recorded file
+        identities (FileInfo.key = name/size/mtime; no extra stat syscalls)
+        so repeated queries don't re-read parquet."""
+        key = tuple(fi.key for fi in entry.content.file_infos())
+        got = _SKETCH_TABLE_CACHE.get(key)
+        if got is None:
+            got = pads.dataset(entry.content.files, format="parquet").to_table()
+            _SKETCH_TABLE_CACHE.put(key, got, int(got.nbytes))
+        return got
 
 
 class DataSkippingIndexConfig(IndexConfig):

@@ -7,6 +7,18 @@ from typing import Any, Dict
 from hyperspace_tpu.models.log_entry import IndexLogEntry
 
 
+def _index_location(entry: IndexLogEntry) -> str:
+    """Common directory of the index's data files (after incremental refresh
+    the content can span several v__=N version dirs; their parent is the
+    index root — ref: IndexStatistics commonPrefix, IndexStatistics.scala:70-96)."""
+    import os
+
+    files = entry.content.files
+    if not files:
+        return entry.content.root.name
+    return os.path.commonpath([os.path.dirname(f) for f in files])
+
+
 def index_statistics(session, entry: IndexLogEntry, extended: bool = False) -> Dict[str, Any]:
     infos = entry.content.file_infos()
     row: Dict[str, Any] = {
@@ -15,7 +27,7 @@ def index_statistics(session, entry: IndexLogEntry, extended: bool = False) -> D
         "includedColumns": entry.derived_dataset.properties.get("includedColumns", []),
         "numBuckets": entry.derived_dataset.properties.get("numBuckets"),
         "schema": entry.derived_dataset.properties.get("schemaJson", ""),
-        "indexLocation": entry.content.root.name,
+        "indexLocation": _index_location(entry),
         "state": entry.state,
         "kind": entry.kind,
     }
