@@ -7,16 +7,15 @@ from typing import Any, Dict
 from hyperspace_tpu.models.log_entry import IndexLogEntry
 
 
-def _index_location(entry: IndexLogEntry) -> str:
+def _index_location(entry: IndexLogEntry, infos) -> str:
     """Common directory of the index's data files (after incremental refresh
     the content can span several v__=N version dirs; their parent is the
     index root — ref: IndexStatistics commonPrefix, IndexStatistics.scala:70-96)."""
     import os
 
-    files = entry.content.files
-    if not files:
+    if not infos:
         return entry.content.root.name
-    return os.path.commonpath([os.path.dirname(f) for f in files])
+    return os.path.commonpath([os.path.dirname(fi.name) for fi in infos])
 
 
 def index_statistics(session, entry: IndexLogEntry, extended: bool = False) -> Dict[str, Any]:
@@ -27,7 +26,7 @@ def index_statistics(session, entry: IndexLogEntry, extended: bool = False) -> D
         "includedColumns": entry.derived_dataset.properties.get("includedColumns", []),
         "numBuckets": entry.derived_dataset.properties.get("numBuckets"),
         "schema": entry.derived_dataset.properties.get("schemaJson", ""),
-        "indexLocation": _index_location(entry),
+        "indexLocation": _index_location(entry, infos),
         "state": entry.state,
         "kind": entry.kind,
     }

@@ -1,0 +1,83 @@
+"""Cross-process optimistic concurrency on the operation log.
+
+The reference tests concurrent writers at thread level
+(IndexLogManagerImplTest races — SURVEY.md §5.2); separate OS processes
+exercise the temp-file + atomic-rename protocol with no shared in-process
+state at all: exactly one creator wins, losers fail with
+ConcurrentModificationException, and the surviving index is consistent.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import hyperspace_tpu as hst
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r'''
+import os, sys
+sys.path.insert(0, sys.argv[3])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import hyperspace_tpu as hst
+root, d = sys.argv[1], sys.argv[2]
+sess = hst.Session(conf={hst.keys.SYSTEM_PATH: os.path.join(root, "i"), hst.keys.NUM_BUCKETS: 4})
+hst.set_session(sess)
+hs = hst.Hyperspace(sess)
+df = sess.read_parquet(d)
+try:
+    hs.create_index(df, hst.CoveringIndexConfig("raceIdx", ["k"], ["v"]))
+    print("WIN")
+except Exception as e:
+    print("LOSE", type(e).__name__)
+'''
+
+
+def test_concurrent_creators_single_winner(tmp_path, session):
+    d = tmp_path / "data"
+    d.mkdir()
+    pq.write_table(
+        pa.table({"k": np.arange(20_000, dtype=np.int64), "v": np.arange(20_000.0)}),
+        d / "p.parquet",
+    )
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    sysdir = str(tmp_path)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), sysdir, str(d), REPO],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            text=True,
+        )
+        for _ in range(4)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        assert p.returncode == 0, f"worker crashed: {out!r}"
+        outs.append(out.strip())
+    wins = [o for o in outs if o == "WIN"]
+    losses = [o for o in outs if o.startswith("LOSE")]
+    assert len(wins) == 1, outs
+    assert len(losses) == 3, outs
+    # a worker losing the log-id race raises ConcurrentModificationException;
+    # one starting after the winner committed fails validate() with a plain
+    # "already exists" HyperspaceActionException — both are correct outcomes
+    assert all(
+        "ConcurrentModificationException" in o or "HyperspaceActionException" in o
+        for o in losses
+    ), outs
+
+    # the surviving index is consistent and usable from a fresh session
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: os.path.join(sysdir, "i"), hst.keys.NUM_BUCKETS: 4})
+    hs = hst.Hyperspace(sess)
+    df = sess.read_parquet(str(d))
+    sess.enable_hyperspace()
+    q = df.filter(hst.col("k") == 7).select("v")
+    assert "IndexScan" in q.optimized_plan().pretty()
+    assert len(q.collect()["v"]) == 1
