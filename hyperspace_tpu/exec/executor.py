@@ -205,6 +205,9 @@ class Executor:
         if isinstance(plan, L.Join):
             return self._exec_join(plan, with_file_names)
 
+        if isinstance(plan, L.Aggregate):
+            return self._exec_aggregate(plan, with_file_names)
+
         if isinstance(plan, (L.Union, L.BucketUnion)):
             return B.concat([self._exec(c, with_file_names) for c in plan.children()])
 
@@ -254,6 +257,57 @@ class Executor:
             except D.DeviceUnsupported:
                 pass
         return np.asarray(plan.condition.eval(child), dtype=bool)
+
+    def _exec_aggregate(self, plan: L.Aggregate, with_file_names: bool) -> B.Batch:
+        import pandas as pd
+
+        child = self._exec(plan.child, with_file_names)
+        child = {k: v for k, v in child.items() if k != INPUT_FILE_NAME}
+        n = B.num_rows(child)
+
+        def series(col_name: str) -> np.ndarray:
+            from hyperspace_tpu.plan.expr import get_column
+
+            got = child.get(col_name)
+            if got is None:
+                got = get_column(child, col_name)
+            if got is None:
+                raise KeyError(f"Aggregate input column {col_name!r} not found")
+            return got
+
+        _PD_FN = {"avg": "mean", "sum": "sum", "min": "min", "max": "max"}
+
+        if not plan.keys:
+            out: B.Batch = {}
+            for name, fn, col_name in plan.aggs:
+                if fn == "count":
+                    out[name] = np.asarray([n if col_name is None else int(pd.Series(series(col_name)).count())])
+                else:
+                    s = pd.Series(series(col_name))
+                    out[name] = np.asarray([getattr(s, _PD_FN[fn])()])
+            return out
+
+        frame_cols = {k: series(k) for k in plan.keys}  # series(): dotted keys too
+        for name, fn, col_name in plan.aggs:
+            if col_name is not None and col_name not in frame_cols:
+                frame_cols[col_name] = series(col_name)
+        df = pd.DataFrame(frame_cols)
+        grouped = df.groupby(plan.keys, dropna=False, sort=False)
+        out = {}
+        pieces = {}
+        for name, fn, col_name in plan.aggs:
+            if fn == "count" and col_name is None:
+                pieces[name] = grouped.size()
+            elif fn == "count":
+                pieces[name] = grouped[col_name].count()
+            else:
+                pieces[name] = getattr(grouped[col_name], _PD_FN[fn])()
+        result = pd.DataFrame(pieces).reset_index()
+        for k in plan.keys:
+            out[k] = result[k].to_numpy()
+        for name, _, _ in plan.aggs:
+            out[name] = result[name].to_numpy()
+        return out
 
     def _exec_join(self, plan: L.Join, with_file_names: bool) -> B.Batch:
         import pandas as pd

@@ -267,6 +267,44 @@ class IndexScan(LogicalPlan):
         )
 
 
+class Aggregate(LogicalPlan):
+    """Hash aggregation: ``keys`` group-by columns (empty = global) and
+    ``aggs`` as (output name, fn, input column) with fn in
+    count/sum/min/max/avg — the slice of aggregation the dataframe facade
+    offers around indexed scans (the reference delegates aggregation to
+    Spark; index rewrites apply beneath this node untouched)."""
+
+    FNS = ("count", "sum", "min", "max", "avg")
+
+    def __init__(self, keys: List[str], aggs: List[tuple], child: LogicalPlan):
+        self.keys = list(keys)
+        self.aggs = [tuple(a) for a in aggs]
+        for _, fn, _ in self.aggs:
+            if fn not in self.FNS:
+                raise ValueError(f"Unsupported aggregate fn {fn!r}; one of {self.FNS}")
+        seen = set(self.keys)
+        for name, _, _ in self.aggs:
+            if name in seen:
+                raise ValueError(f"Duplicate aggregate output name {name!r} (collides with a key or another aggregate)")
+            seen.add(name)
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.keys + [name for name, _, _ in self.aggs]
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Aggregate":
+        (child,) = children
+        return Aggregate(self.keys, self.aggs, child)
+
+    def describe(self) -> str:
+        parts = [f"{name}={fn}({col_ or '*'})" for name, fn, col_ in self.aggs]
+        return f"Aggregate(keys={self.keys}, [{', '.join(parts)}])"
+
+
 class Repartition(LogicalPlan):
     """Hash-repartition child rows into ``bucket_spec`` buckets — injected on
     top of appended-data scans so hybrid scan can merge with index buckets.

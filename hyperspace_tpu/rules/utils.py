@@ -289,5 +289,9 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         return plan
     if isinstance(plan, L.Union):
         return plan.with_children([prune_columns(c, needed) for c in plan.children()])
+    if isinstance(plan, L.Aggregate):
+        child_needed = set(plan.keys) | {c for _, _, c in plan.aggs if c is not None}
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, child_needed)])
     # unknown node: keep children un-pruned (safe)
     return plan

@@ -1,0 +1,159 @@
+"""Aggregation over (possibly index-rewritten) plans.
+
+The reference delegates aggregation to Spark SQL around its indexed scans;
+here the dataframe facade provides group_by/agg directly, and index rewrites
+apply beneath the Aggregate node untouched (ScoreBasedIndexPlanOptimizer
+recurses through it — rules/score.py).
+"""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+@pytest.fixture()
+def data(tmp_path):
+    d = tmp_path / "agg"
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(2):
+        pq.write_table(
+            pa.table(
+                {
+                    "dept": rng.integers(0, 8, 1500).astype(np.int64),
+                    "region": np.array([f"r{v}" for v in rng.integers(0, 3, 1500)]),
+                    "amount": np.round(rng.uniform(0, 100, 1500), 4),
+                    "qty": rng.integers(1, 10, 1500).astype(np.int64),
+                }
+            ),
+            d / f"p{i}.parquet",
+        )
+    return str(d)
+
+
+def as_pandas(batch):
+    return pd.DataFrame({k: v for k, v in batch.items()})
+
+
+class TestAggregates:
+    def test_global_aggregates(self, session, data):
+        df = session.read_parquet(data)
+        out = df.agg(total=("amount", "sum"), n=("*", "count"), hi=("amount", "max"))
+        got = out.collect()
+        ref = df.to_pandas()
+        assert got["n"][0] == len(ref)
+        assert np.isclose(got["total"][0], ref["amount"].sum())
+        assert np.isclose(got["hi"][0], ref["amount"].max())
+
+    def test_group_by_aggregates_match_pandas(self, session, data):
+        df = session.read_parquet(data)
+        out = df.group_by("dept").agg(
+            total=("amount", "sum"), n=("*", "count"), avg_q=("qty", "avg")
+        ).collect()
+        ref = (
+            df.to_pandas()
+            .groupby("dept")
+            .agg(total=("amount", "sum"), n=("amount", "size"), avg_q=("qty", "mean"))
+            .reset_index()
+            .sort_values("dept")
+        )
+        got = as_pandas(out).sort_values("dept").reset_index(drop=True)
+        assert np.array_equal(got["dept"].to_numpy(), ref["dept"].to_numpy())
+        assert np.allclose(got["total"].to_numpy(), ref["total"].to_numpy())
+        assert np.array_equal(got["n"].to_numpy(), ref["n"].to_numpy())
+        assert np.allclose(got["avg_q"].to_numpy(), ref["avg_q"].to_numpy())
+
+    def test_multi_key_and_string_key_grouping(self, session, data):
+        df = session.read_parquet(data)
+        out = as_pandas(df.group_by("dept", "region").count().collect())
+        ref = df.to_pandas().groupby(["dept", "region"]).size().reset_index(name="count")
+        merged = out.merge(ref, on=["dept", "region"], suffixes=("_got", "_ref"))
+        assert len(merged) == len(ref) == len(out)
+        assert np.array_equal(merged["count_got"].to_numpy(), merged["count_ref"].to_numpy())
+
+    def test_shorthand_methods(self, session, data):
+        df = session.read_parquet(data)
+        got = df.group_by("dept").sum("qty").collect()
+        ref = df.to_pandas().groupby("dept")["qty"].sum()
+        for d, v in zip(got["dept"], got["sum(qty)"]):
+            assert v == ref[d]
+
+    def test_index_rewrite_fires_below_aggregate(self, session, hs, data):
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(data)
+        hs.create_index(df, hst.CoveringIndexConfig("aggIdx", ["dept"], ["amount"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("dept") == 3).group_by("dept").agg(total=("amount", "sum"))
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert scans, plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert np.allclose(np.sort(on["total"]), np.sort(off["total"]))
+
+    def test_aggregate_over_indexed_join(self, session, hs, data, tmp_path):
+        rroot = tmp_path / "r"
+        rroot.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "dept": np.arange(8, dtype=np.int64),
+                    "budget": np.round(np.linspace(100, 800, 8), 2),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf = session.read_parquet(data)
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("aggJL", ["dept"], ["amount"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("aggJR", ["dept"], ["budget"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=["dept"]).group_by("dept").agg(
+            spend=("amount", "sum"), budget=("budget", "max")
+        )
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert len(scans) == 2, plan.pretty()
+        on = as_pandas(q.collect()).sort_values("dept").reset_index(drop=True)
+        session.disable_hyperspace()
+        off = as_pandas(q.collect()).sort_values("dept").reset_index(drop=True)
+        session.enable_hyperspace()
+        assert np.allclose(on["spend"], off["spend"])
+        assert np.allclose(on["budget"], off["budget"])
+
+    def test_invalid_fn_rejected(self, session, data):
+        df = session.read_parquet(data)
+        with pytest.raises(ValueError, match="Unsupported aggregate"):
+            df.group_by("dept").agg(x=("amount", "median"))
+        with pytest.raises(ValueError, match="only \\('\\*', 'count'\\)"):
+            df.agg(total=("*", "sum"))
+        with pytest.raises(ValueError, match="Duplicate aggregate output"):
+            df.group_by("dept").agg(dept=("amount", "sum"))
+
+    def test_group_by_nested_key(self, session, tmp_path):
+        d = tmp_path / "nestedagg"
+        d.mkdir()
+        t = pa.table(
+            {
+                "nested": pa.array([{"city": f"c{i % 3}"} for i in range(60)]),
+                "v": np.arange(60, dtype=np.int64),
+            }
+        )
+        pq.write_table(t, d / "p.parquet")
+        df = session.read_parquet(str(d))
+        out = as_pandas(df.group_by("nested.city").sum("v").collect())
+        assert len(out) == 3
+        assert out["sum(v)"].sum() == np.arange(60).sum()

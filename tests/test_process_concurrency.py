@@ -51,15 +51,15 @@ def test_concurrent_creators_single_winner(tmp_path, session):
         subprocess.Popen(
             [sys.executable, str(worker), sysdir, str(d), REPO],
             stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL,
+            stderr=subprocess.PIPE,
             text=True,
         )
         for _ in range(4)
     ]
     outs = []
     for p in procs:
-        out, _ = p.communicate(timeout=180)
-        assert p.returncode == 0, f"worker crashed: {out!r}"
+        out, err = p.communicate(timeout=180)
+        assert p.returncode == 0, f"worker crashed: stdout={out!r} stderr={err[-2000:]!r}"
         outs.append(out.strip())
     wins = [o for o in outs if o == "WIN"]
     losses = [o for o in outs if o.startswith("LOSE")]
