@@ -400,6 +400,23 @@ def _cached_predicate_jit(skeleton: str, fn):
     return jitted
 
 
+def _dry_codecs(batch: B.Batch, refs) -> Dict[str, ColumnCodec]:
+    """Dtype-kind-only codecs for the pre-transfer support check (string
+    bounds resolve to 0; values are discarded)."""
+    out: Dict[str, ColumnCodec] = {}
+    for r in refs:
+        kind = batch[r].dtype.kind
+        if kind in ("U", "S", "O"):
+            out[r] = ColumnCodec("string", uniques=np.empty(0, dtype=str))
+        elif kind == "M":
+            out[r] = ColumnCodec("datetime", unit=np.datetime_data(batch[r].dtype)[0])
+        elif kind in ("i", "u", "b", "f"):
+            out[r] = ColumnCodec("numeric")
+        else:
+            raise DeviceUnsupported(f"unsupported column dtype {batch[r].dtype}")
+    return out
+
+
 def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) -> np.ndarray:
     """Evaluate ``condition`` on device over the referenced columns of
     ``batch``; returns the host bool mask. Raises DeviceUnsupported when the
@@ -438,20 +455,8 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
     if missing:
         # reject unsupported predicates BEFORE encoding/transferring the
         # missing columns — an unsupported shape must not cost HBM space or
-        # a wasted upload. Dry codecs carry only the dtype kind (string
-        # bounds resolve to 0 here; values are discarded).
-        dry_codecs: Dict[str, ColumnCodec] = {}
-        for r in refs:
-            kind = batch[r].dtype.kind
-            if kind in ("U", "S", "O"):
-                dry_codecs[r] = ColumnCodec("string", uniques=np.empty(0, dtype=str))
-            elif kind == "M":
-                dry_codecs[r] = ColumnCodec("datetime", unit=np.datetime_data(batch[r].dtype)[0])
-            elif kind in ("i", "u", "b", "f"):
-                dry_codecs[r] = ColumnCodec("numeric")
-            else:
-                raise DeviceUnsupported(f"unsupported column dtype {batch[r].dtype}")
-        compile_predicate(condition, dry_codecs)
+        # a wasted upload
+        compile_predicate(condition, _dry_codecs(batch, refs))
 
         for r in missing:
             arr, codec = encode_column(batch[r])
@@ -466,6 +471,148 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
     jitted = _cached_predicate_jit(predicate_skeleton(condition, codecs), fn)
     mask = jitted(dev_cols, lit_values)
     return np.asarray(mask)[:n]
+
+
+# --------------------------------------------------------------------------
+# fused filter + global aggregate (only scalars leave the device)
+# --------------------------------------------------------------------------
+
+_AGG_FNS = ("count", "sum", "min", "max", "avg")
+
+
+def device_filtered_aggregate(
+    session,
+    batch: B.Batch,
+    condition: Optional[Expr],
+    aggs: List[Tuple[str, str, Optional[str]]],
+    scan_key=None,
+) -> Optional[Dict[str, np.ndarray]]:
+    """Global aggregates over (optionally filtered) device-resident columns
+    in ONE fused program: predicate mask, validity mask for padding, and the
+    reductions all execute on device; only per-aggregate scalars transfer
+    back. ``aggs`` as in plan.Aggregate ((out name, fn, input col)).
+
+    Raises DeviceUnsupported outside the device language (string aggregate
+    inputs, unsupported predicate shapes, ...)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = B.num_rows(batch)
+    if n == 0:
+        return None  # empty-input semantics (NaN mins etc.) stay host-side
+
+    agg_inputs = sorted({c for _, fn, c in aggs if c is not None})
+    for _, fn, c in aggs:
+        if fn not in _AGG_FNS:
+            raise DeviceUnsupported(f"unsupported aggregate fn {fn!r}")
+        # datetimes stay host-side: float64 reduction would lose ns precision
+        if c is not None and batch[c].dtype.kind not in ("i", "u", "f", "b"):
+            raise DeviceUnsupported(f"aggregate over non-numeric column {c!r}")
+    refs = sorted(condition.references()) if condition is not None else []
+    if not refs and not agg_inputs:
+        # nothing to put on device (count(*) with no predicate): the program
+        # would see an empty column dict and derive total=0 — host handles it
+        raise DeviceUnsupported("no device-resident columns involved")
+    for r in refs + agg_inputs:
+        if r not in batch:
+            raise DeviceUnsupported(f"column {r!r} missing from batch")
+
+    mesh = session.mesh
+    n_dev = mesh.devices.size
+    axis = mesh.axis_names[0]
+    sharding = NamedSharding(mesh, P(axis))
+
+    # dry-check the predicate before any upload
+    if condition is not None:
+        compile_predicate(condition, _dry_codecs(batch, refs))
+
+    dev_cols: Dict[str, "jax.Array"] = {}
+    codecs: Dict[str, ColumnCodec] = {}
+    for r in sorted(set(refs) | set(agg_inputs)):
+        ckey = (scan_key, r, n_dev) if scan_key is not None else None
+        cached = _device_cache_get(ckey) if ckey is not None else None
+        if cached is not None and cached[2] == n:
+            dev_cols[r], codecs[r] = cached[0], cached[1]
+            continue
+        arr, codec = encode_column(batch[r])
+        if codec.kind == "string":
+            raise DeviceUnsupported("string aggregate/predicate columns stay host-side here")
+        padded = _pad_to_multiple(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
+        dev = jax.device_put(padded, sharding)
+        dev_cols[r] = dev
+        codecs[r] = codec
+        if ckey is not None:
+            _device_cache_put(ckey, (dev, codec, n), int(padded.nbytes))
+
+    if condition is not None:
+        pred_fn, lit_values = compile_predicate(condition, codecs)
+        skeleton = "agg:" + predicate_skeleton(condition, codecs)
+    else:
+        pred_fn, lit_values = None, ()
+        skeleton = "agg:<none>"
+    agg_spec = tuple((fn, c) for _, fn, c in aggs)
+    skeleton += "|" + repr(agg_spec)
+
+    def program(cols, lits, n_valid):
+        total = next(iter(cols.values())).shape[0]
+        valid = jnp.arange(total) < n_valid
+        mask = valid if pred_fn is None else (pred_fn(cols, lits) & valid)
+        cnt = mask.sum()
+        outs = []
+        valids = []  # per-aggregate non-null match count (NaN-skipping)
+        for fn, c in agg_spec:
+            if fn == "count":
+                if c is None or not jnp.issubdtype(cols[c].dtype, jnp.floating):
+                    outs.append(cnt.astype(jnp.int64))
+                else:
+                    # count(col) skips nulls (NaN), like the host path
+                    outs.append((mask & ~jnp.isnan(cols[c])).sum().astype(jnp.int64))
+                valids.append(cnt)
+                continue
+            x = cols[c]
+            is_int = jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_
+            # pandas semantics: NaNs are skipped, not propagated
+            m = mask if is_int else (mask & ~jnp.isnan(x))
+            valids.append(m.sum())
+            if fn == "sum":
+                # integer sums stay int64 (host-path parity; exact)
+                z = x.astype(jnp.int64) if is_int else x.astype(jnp.float64)
+                outs.append(jnp.where(m, z, z.dtype.type(0)).sum())
+            elif fn == "avg":
+                xf = x.astype(jnp.float64)
+                outs.append(jnp.where(m, xf, 0.0).sum() / jnp.maximum(m.sum(), 1))
+            elif fn == "min":
+                if is_int:
+                    outs.append(jnp.where(m, x.astype(jnp.int64), jnp.iinfo(jnp.int64).max).min())
+                else:
+                    outs.append(jnp.where(m, x.astype(jnp.float64), jnp.inf).min())
+            else:  # max
+                if is_int:
+                    outs.append(jnp.where(m, x.astype(jnp.int64), jnp.iinfo(jnp.int64).min).max())
+                else:
+                    outs.append(jnp.where(m, x.astype(jnp.float64), -jnp.inf).max())
+        return tuple(outs), tuple(valids)
+
+    jitted = _cached_predicate_jit(skeleton, program)
+    outs, valids = jitted(dev_cols, lit_values, np.int64(n))
+    outs = [np.asarray(o) for o in outs]
+    valids = [int(v) for v in valids]
+
+    result: Dict[str, np.ndarray] = {}
+    for (name, fn, c), val, n_valid in zip(aggs, outs, valids):
+        if fn == "count":
+            result[name] = np.asarray([int(val)])
+        elif fn in ("min", "max", "avg") and n_valid == 0:
+            # no non-null matches: host pandas yields NaN (all-NaN groups too)
+            result[name] = np.asarray([np.nan])
+        else:
+            src = batch[c]
+            if fn in ("sum", "min", "max") and src.dtype.kind in ("i", "u", "b"):
+                result[name] = np.asarray([int(val)])
+            else:
+                result[name] = np.asarray([float(val)])
+    return result
 
 
 # --------------------------------------------------------------------------

@@ -261,7 +261,25 @@ class Executor:
     def _exec_aggregate(self, plan: L.Aggregate, with_file_names: bool) -> B.Batch:
         import pandas as pd
 
-        child = self._exec(plan.child, with_file_names)
+        # fused device path for global aggregates over an (optionally
+        # filtered) index/file scan: predicate + reductions run in one jitted
+        # program over HBM-resident columns; only scalars transfer back
+        child = None
+        if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
+            got, scan_batch, filter_node = self._try_device_aggregate(plan)
+            if got is not None:
+                return got
+            if scan_batch is not None:
+                # the device gate already materialized the scan — reuse it
+                # instead of re-reading parquet on the host fallback
+                if filter_node is not None:
+                    mask = self._filter_mask(filter_node, scan_batch)
+                    child = B.mask_rows(scan_batch, mask)
+                else:
+                    child = scan_batch
+
+        if child is None:
+            child = self._exec(plan.child, with_file_names)
         child = {k: v for k, v in child.items() if k != INPUT_FILE_NAME}
         n = B.num_rows(child)
 
@@ -308,6 +326,33 @@ class Executor:
         for name, _, _ in plan.aggs:
             out[name] = result[name].to_numpy()
         return out
+
+    def _try_device_aggregate(self, plan: L.Aggregate):
+        """Returns (result, scan_batch, filter_node): result=None means the
+        caller runs the host path — reusing scan_batch (the materialized
+        scan, pre-filter) when it was already read for the gate."""
+        node = plan.child
+        filter_node = None
+        if isinstance(node, L.Filter):
+            filter_node = node
+            node = node.child
+        if not isinstance(node, (L.IndexScan, L.FileScan)):
+            return None, None, None
+        try:
+            from hyperspace_tpu.exec import device as D
+        except ImportError:
+            return None, None, None
+        batch = self._exec(node, with_file_names=False)
+        if B.num_rows(batch) < self.session.conf.device_exec_min_rows:
+            return None, batch, filter_node
+        try:
+            condition = filter_node.condition if filter_node is not None else None
+            got = D.device_filtered_aggregate(
+                self.session, batch, condition, plan.aggs, scan_key=_scan_identity(node)
+            )
+            return got, batch, filter_node
+        except D.DeviceUnsupported:
+            return None, batch, filter_node
 
     def _exec_join(self, plan: L.Join, with_file_names: bool) -> B.Batch:
         import pandas as pd

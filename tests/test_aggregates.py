@@ -143,6 +143,116 @@ class TestAggregates:
         with pytest.raises(ValueError, match="Duplicate aggregate output"):
             df.group_by("dept").agg(dept=("amount", "sum"))
 
+    def test_device_fused_filter_aggregate(self, session, hs, data):
+        """Global aggregates over a filtered index scan run as one fused
+        device program (only scalars come back); results match the host
+        path bit-for-bit on counts/int sums and to fp tolerance otherwise."""
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(data)
+        hs.create_index(df, hst.CoveringIndexConfig("devAgg", ["dept"], ["amount", "qty"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("dept") == 3).agg(
+            n=("*", "count"),
+            total=("amount", "sum"),
+            qsum=("qty", "sum"),
+            lo=("amount", "min"),
+            hi=("amount", "max"),
+            mean=("amount", "avg"),
+        )
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+        dev = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 1 << 40)
+        host = q.collect()
+        assert dev["n"][0] == host["n"][0]
+        assert dev["qsum"][0] == host["qsum"][0]  # int sum exact
+        for k in ("total", "lo", "hi", "mean"):
+            assert np.isclose(dev[k][0], host[k][0]), k
+
+    def test_device_aggregate_with_nulls(self, session, hs, tmp_path):
+        d = tmp_path / "nullagg"
+        d.mkdir()
+        vals = np.array([1.0, np.nan, 3.0, np.nan, 5.0] * 40)
+        pq.write_table(
+            pa.table({"g": np.tile(np.arange(4, dtype=np.int64), 50), "x": vals}),
+            d / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("nullAgg", ["g"], ["x"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("g") == 1).agg(
+            nx=("x", "count"), total=("x", "sum"), mean=("x", "avg")
+        )
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+        dev = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 1 << 40)
+        host = q.collect()
+        assert dev["nx"][0] == host["nx"][0]  # NaNs skipped in count(col)
+        assert np.isclose(dev["total"][0], host["total"][0])
+        assert np.isclose(dev["mean"][0], host["mean"][0])
+
+    def test_device_aggregate_empty_match(self, session, hs, data):
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(data)
+        hs.create_index(df, hst.CoveringIndexConfig("emptyAgg", ["dept"], ["amount"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("dept") == 999).agg(n=("*", "count"), lo=("amount", "min"))
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+        dev = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 1 << 40)
+        host = q.collect()
+        assert dev["n"][0] == host["n"][0] == 0
+        assert np.isnan(dev["lo"][0]) and np.isnan(host["lo"][0])
+
+    def test_device_aggregate_all_nan_match(self, session, hs, tmp_path):
+        """Filter matches rows whose aggregate column is entirely NaN: the
+        device path must yield NaN for min/max/avg (pandas semantics), not
+        inf/-inf/0."""
+        d = tmp_path / "allnan"
+        d.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "g": np.array([1] * 10 + [2] * 10, dtype=np.int64),
+                    "x": np.array([np.nan] * 10 + [5.0] * 10),
+                }
+            ),
+            d / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("allNanAgg", ["g"], ["x"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("g") == 1).agg(
+            lo=("x", "min"), hi=("x", "max"), mean=("x", "avg"), total=("x", "sum")
+        )
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+        dev = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 1 << 40)
+        host = q.collect()
+        for k in ("lo", "hi", "mean"):
+            assert np.isnan(dev[k][0]) and np.isnan(host[k][0]), k
+        assert dev["total"][0] == host["total"][0] == 0.0
+
+    def test_device_declines_bare_count_star(self, session, hs, data):
+        """count(*) with no predicate has no device-resident columns — the
+        device path declines (a zero-column program would report 0 rows) and
+        the host answers from the already-read batch."""
+        from hyperspace_tpu.exec import device as D
+        from hyperspace_tpu.plan import logical as L
+
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(data)
+        batch = {"dept": np.arange(10, dtype=np.int64)}
+        with pytest.raises(D.DeviceUnsupported):
+            D.device_filtered_aggregate(session, batch, None, [("n", "count", None)])
+        # end to end: correct count either way
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+        n_dev = df.agg(n=("*", "count")).collect()["n"][0]
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 1 << 40)
+        n_host = df.agg(n=("*", "count")).collect()["n"][0]
+        assert n_dev == n_host == 3000
+
     def test_group_by_nested_key(self, session, tmp_path):
         d = tmp_path / "nestedagg"
         d.mkdir()
