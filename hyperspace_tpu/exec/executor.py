@@ -208,6 +208,41 @@ class Executor:
         if isinstance(plan, L.Aggregate):
             return self._exec_aggregate(plan, with_file_names)
 
+        if isinstance(plan, L.Sort):
+            child = self._exec(plan.child, with_file_names)
+            from hyperspace_tpu.plan.expr import get_column
+
+            order = np.arange(B.num_rows(child))
+            # least-significant key first: stable argsorts compose into the
+            # lexicographic order over all keys. Keys sort by rank (np.unique
+            # codes): negation-safe for every dtype, and missing values
+            # (NaN/None) rank last in BOTH directions like pandas.
+            for name, asc in reversed(plan.keys):
+                arr = get_column(child, name)
+                if arr is None:
+                    raise KeyError(f"Sort key {name!r} not found")
+                arr = arr[order]
+                if arr.dtype == object:
+                    missing = np.array(
+                        [v is None or (isinstance(v, float) and v != v) for v in arr], dtype=bool
+                    )
+                    conv = np.where(missing, "", arr.astype(str))
+                elif arr.dtype.kind == "f":
+                    missing = np.isnan(arr)
+                    conv = np.where(missing, 0.0, arr)
+                else:
+                    missing = np.zeros(arr.shape[0], dtype=bool)
+                    conv = arr
+                _, codes = np.unique(conv, return_inverse=True)
+                keyvals = (codes if asc else -codes).astype(np.int64)
+                keyvals[missing] = np.iinfo(np.int64).max
+                order = order[np.argsort(keyvals, kind="stable")]
+            return {k: v[order] for k, v in child.items()}
+
+        if isinstance(plan, L.Limit):
+            child = self._exec(plan.child, with_file_names)
+            return {k: v[: plan.n] for k, v in child.items()}
+
         if isinstance(plan, (L.Union, L.BucketUnion)):
             return B.concat([self._exec(c, with_file_names) for c in plan.children()])
 

@@ -72,6 +72,24 @@ class DataFrame:
         """Global aggregates: ``df.agg(total=("v", "sum"), n=("*", "count"))``."""
         return GroupedData(self, []).agg(**aggs)
 
+    def order_by(self, *keys: TUnion[str, Col], ascending: TUnion[bool, List[bool]] = True) -> "DataFrame":
+        names = []
+        for k in keys:
+            name = k.name if isinstance(k, Col) else str(k)
+            r = resolve_column(name, self.plan.output_columns)
+            if r is None:
+                raise ValueError(f"Column {name!r} not found among {self.plan.output_columns}")
+            names.append(r)
+        asc = [ascending] * len(names) if isinstance(ascending, bool) else list(ascending)
+        if len(asc) != len(names):
+            raise ValueError("ascending must be a bool or match the number of sort keys")
+        return DataFrame(L.Sort(list(zip(names, asc)), self.plan), self.session)
+
+    orderBy = sort = order_by
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(L.Limit(n, self.plan), self.session)
+
     # --- actions -----------------------------------------------------------
     def optimized_plan(self) -> L.LogicalPlan:
         if self.session.hyperspace_enabled:

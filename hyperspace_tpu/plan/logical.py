@@ -305,6 +305,51 @@ class Aggregate(LogicalPlan):
         return f"Aggregate(keys={self.keys}, [{', '.join(parts)}])"
 
 
+class Sort(LogicalPlan):
+    """Order-by over (column, ascending) keys; host-side stable lexsort."""
+
+    def __init__(self, keys: List[tuple], child: LogicalPlan):
+        self.keys = [tuple(k) for k in keys]  # (column, ascending)
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.child.output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Sort":
+        (child,) = children
+        return Sort(self.keys, child)
+
+    def describe(self) -> str:
+        parts = [f"{c} {'ASC' if asc else 'DESC'}" for c, asc in self.keys]
+        return f"Sort({', '.join(parts)})"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, n: int, child: LogicalPlan):
+        if n < 0:
+            raise ValueError("limit must be non-negative")
+        self.n = int(n)
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.child.output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Limit":
+        (child,) = children
+        return Limit(self.n, child)
+
+    def describe(self) -> str:
+        return f"Limit({self.n})"
+
+
 class Repartition(LogicalPlan):
     """Hash-repartition child rows into ``bucket_spec`` buckets — injected on
     top of appended-data scans so hybrid scan can merge with index buckets.

@@ -293,5 +293,12 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         child_needed = set(plan.keys) | {c for _, _, c in plan.aggs if c is not None}
         (child,) = plan.children()
         return plan.with_children([prune_columns(child, child_needed)])
+    if isinstance(plan, L.Sort):
+        child_needed = None if needed is None else set(needed) | {c for c, _ in plan.keys}
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, child_needed)])
+    if isinstance(plan, L.Limit):
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, needed)])
     # unknown node: keep children un-pruned (safe)
     return plan

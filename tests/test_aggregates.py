@@ -134,6 +134,86 @@ class TestAggregates:
         assert np.allclose(on["spend"], off["spend"])
         assert np.allclose(on["budget"], off["budget"])
 
+    def test_order_by_and_limit(self, session, data):
+        df = session.read_parquet(data)
+        out = as_pandas(
+            df.group_by("dept")
+            .agg(total=("amount", "sum"))
+            .order_by("total", ascending=False)
+            .limit(3)
+            .collect()
+        )
+        ref = (
+            df.to_pandas()
+            .groupby("dept")["amount"]
+            .sum()
+            .sort_values(ascending=False)
+            .head(3)
+        )
+        assert len(out) == 3
+        assert np.allclose(out["total"].to_numpy(), ref.to_numpy())
+        assert np.array_equal(out["dept"].to_numpy(), ref.index.to_numpy())
+
+    def test_order_by_multi_key_mixed_direction_stable(self, session, tmp_path):
+        d = tmp_path / "sortd"
+        d.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "a": np.array([2, 1, 2, 1, 2, 1], dtype=np.int64),
+                    "b": np.array(["x", "y", "x", "y", "z", "x"]),
+                    "i": np.arange(6, dtype=np.int64),
+                }
+            ),
+            d / "p.parquet",
+        )
+        df = session.read_parquet(str(d))
+        out = as_pandas(df.order_by("a", "b", ascending=[True, False]).collect())
+        ref = (
+            df.to_pandas()
+            .sort_values(["a", "b"], ascending=[True, False], kind="stable")
+            .reset_index(drop=True)
+        )
+        assert np.array_equal(out["a"].to_numpy(), ref["a"].to_numpy())
+        assert np.array_equal(out["b"].to_numpy().astype(str), ref["b"].to_numpy().astype(str))
+        assert np.array_equal(out["i"].to_numpy(), ref["i"].to_numpy())  # stability
+
+    def test_order_by_nan_last_both_directions(self, session, tmp_path):
+        d = tmp_path / "nansort"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"x": np.array([1.0, np.nan, 3.0, np.nan, 2.0]), "i": np.arange(5, dtype=np.int64)}),
+            d / "p.parquet",
+        )
+        df = session.read_parquet(str(d))
+        asc = df.order_by("x").collect()["x"]
+        desc = df.order_by("x", ascending=False).collect()["x"]
+        assert np.array_equal(asc[:3], [1.0, 2.0, 3.0]) and np.isnan(asc[3:]).all()
+        assert np.array_equal(desc[:3], [3.0, 2.0, 1.0]) and np.isnan(desc[3:]).all()
+
+    def test_index_rewrite_survives_order_by_limit(self, session, hs, data):
+        """order_by/limit at the plan root must not block column pruning and
+        with it the covering-index rewrite underneath."""
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        df = session.read_parquet(data)
+        hs.create_index(df, hst.CoveringIndexConfig("sortIdx", ["dept"], ["amount"]))
+        session.enable_hyperspace()
+        q = (
+            df.filter(hst.col("dept") == 3)
+            .group_by("dept")
+            .agg(total=("amount", "sum"))
+            .order_by("total")
+            .limit(1)
+        )
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.IndexScan)]
+        assert scans, plan.pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert np.allclose(on["total"], off["total"])
+
     def test_invalid_fn_rejected(self, session, data):
         df = session.read_parquet(data)
         with pytest.raises(ValueError, match="Unsupported aggregate"):
