@@ -106,6 +106,18 @@ class Action:
             previous_properties=(prev.properties if prev is not None else None),
         )
 
+    def _cleanup_allocated_version(self) -> None:
+        """Best-effort removal of a data version dir claimed by a failed
+        action — it was never referenced by a committed log entry, and
+        leaving it would permanently bump the version sequence per failure."""
+        v = getattr(self, "_allocated_version", None)
+        if v is None or self.data_manager is None:
+            return
+        try:
+            self.data_manager.delete_version(v)
+        except OSError:
+            pass
+
     # --- protocol ----------------------------------------------------------
     def _emit(self, state: str, message: str = "") -> None:
         get_event_logger(self.session).log_event(
@@ -138,6 +150,7 @@ class Action:
         except NoChangesException:
             raise
         except Exception as e:
+            self._cleanup_allocated_version()
             self._emit("Failure", str(e))
             raise
         self._emit("Success")

@@ -55,6 +55,11 @@ class CreateAction(Action):
             )
         # columns resolve?
         resolve_columns_against_schema(self.index_config.referenced_columns, self.df.plan.relation.schema)
+        # Stable-state check only: a crashed creator's abandoned CREATING
+        # transient must not brick the name (the retry's own transient write
+        # races on the next log id, and allocate_version() gives every
+        # builder an exclusive data dir, so concurrent creators can neither
+        # share a version dir nor double-commit) (ref: CreateAction.scala:50-81).
         existing = self.log_manager.get_latest_stable_log()
         if existing is not None and existing.state != states.DOESNOTEXIST:
             raise HyperspaceActionException(
@@ -83,8 +88,7 @@ class CreateAction(Action):
         }
 
     def op(self) -> None:
-        latest_version = self.data_manager.get_latest_version()
-        self._data_version = 0 if latest_version is None else latest_version + 1
+        self._data_version = self._allocated_version = self.data_manager.allocate_version()
         data_path = self.data_manager.version_path(self._data_version)
         ctx = CreateContext(
             session=self.session,

@@ -84,8 +84,7 @@ class OptimizeAction(Action):
 
         index = registry.index_of_entry(self._entry)
         assert isinstance(index, CoveringIndex)
-        latest = self.data_manager.get_latest_version()
-        self._version = 0 if latest is None else latest + 1
+        self._version = self._allocated_version = self.data_manager.allocate_version()
         out_dir = self.data_manager.version_path(self._version)
 
         # Compaction must leave ONE file per optimized bucket, so chunking by

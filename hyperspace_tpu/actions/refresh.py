@@ -86,8 +86,7 @@ class _RefreshActionBase(Action):
         return registry.index_of_entry(self._entry)
 
     def _new_version_ctx(self) -> Tuple[CreateContext, int]:
-        latest = self.data_manager.get_latest_version()
-        version = 0 if latest is None else latest + 1
+        version = self._allocated_version = self.data_manager.allocate_version()
         ctx = CreateContext(
             session=self.session,
             index_data_path=self.data_manager.version_path(version),

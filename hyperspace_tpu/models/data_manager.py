@@ -39,6 +39,19 @@ class IndexDataManager:
         versions = self.get_all_versions()
         return versions[-1] if versions else None
 
+    def allocate_version(self) -> int:
+        """Claim the next data version by creating its directory exclusively;
+        two concurrent writers can never share a version dir (defense in
+        depth under the operation log's optimistic concurrency)."""
+        latest = self.get_latest_version()
+        version = 0 if latest is None else latest + 1
+        while True:
+            try:
+                os.makedirs(self.version_path(version), exist_ok=False)
+                return version
+            except FileExistsError:
+                version += 1
+
     def delete_version(self, version: int) -> None:
         delete_recursively(self.version_path(version))
 

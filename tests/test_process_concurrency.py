@@ -73,7 +73,8 @@ def test_concurrent_creators_single_winner(tmp_path, session):
         for o in losses
     ), outs
 
-    # the surviving index is consistent and usable from a fresh session
+    # the surviving index is consistent and usable from a fresh session —
+    # in particular no duplicated rows from two builders sharing a data dir
     sess = hst.Session(conf={hst.keys.SYSTEM_PATH: os.path.join(sysdir, "i"), hst.keys.NUM_BUCKETS: 4})
     hs = hst.Hyperspace(sess)
     df = sess.read_parquet(str(d))
@@ -81,3 +82,82 @@ def test_concurrent_creators_single_winner(tmp_path, session):
     q = df.filter(hst.col("k") == 7).select("v")
     assert "IndexScan" in q.optimized_plan().pretty()
     assert len(q.collect()["v"]) == 1
+
+
+def _write_sample(d, n=5000):
+    pq.write_table(
+        pa.table({"k": np.arange(n, dtype=np.int64), "v": np.arange(float(n))}),
+        os.path.join(str(d), "p.parquet"),
+    )
+
+
+def test_crashed_create_is_recoverable(tmp_path, session):
+    """An abandoned CREATING transient (creator died before any stable entry)
+    must not brick the index name: a retrying creator wins the next log id
+    and builds into its own exclusively-allocated version dir."""
+    import hyperspace_tpu.indexes.covering as cov
+
+    d = tmp_path / "data"
+    d.mkdir()
+    _write_sample(d)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    hs = hst.Hyperspace(session)
+    df = session.read_parquet(str(d))
+
+    calls = {"n": 0}
+    real_write = cov.CoveringIndex.write
+
+    def crashing_write(self, ctx, df_):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("simulated creator crash mid-build")
+        return real_write(self, ctx, df_)
+
+    cov.CoveringIndex.write = crashing_write
+    try:
+        import pytest as _pytest
+
+        with _pytest.raises(RuntimeError):
+            hs.create_index(df, hst.CoveringIndexConfig("crashIdx", ["k"], ["v"]))
+        # retry succeeds despite the abandoned CREATING transient
+        hs.create_index(df, hst.CoveringIndexConfig("crashIdx", ["k"], ["v"]))
+    finally:
+        cov.CoveringIndex.write = real_write
+    session.enable_hyperspace()
+    q = df.filter(hst.col("k") == 7).select("v")
+    assert "IndexScan" in q.optimized_plan().pretty()
+    assert len(q.collect()["v"]) == 1
+
+
+def test_failed_action_cleans_allocated_version_dir(tmp_path, session):
+    """A failed build deletes the version dir it claimed — repeated failures
+    must not accumulate orphan v__=N dirs."""
+    import hyperspace_tpu.indexes.covering as cov
+
+    d = tmp_path / "data2"
+    d.mkdir()
+    _write_sample(d)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    hs = hst.Hyperspace(session)
+    df = session.read_parquet(str(d))
+
+    real_write = cov.CoveringIndex.write
+
+    def failing_write(self, ctx, df_):
+        raise RuntimeError("boom")
+
+    cov.CoveringIndex.write = failing_write
+    try:
+        import pytest as _pytest
+
+        for _ in range(3):
+            with _pytest.raises(RuntimeError):
+                hs.create_index(df, hst.CoveringIndexConfig("leakIdx", ["k"], ["v"]))
+    finally:
+        cov.CoveringIndex.write = real_write
+    sysp = session.conf.get(hst.keys.SYSTEM_PATH)
+    idx_dir = os.path.join(sysp, "leakIdx")
+    version_dirs = [n for n in os.listdir(idx_dir) if n.startswith("v__=")] if os.path.isdir(idx_dir) else []
+    assert version_dirs == [], version_dirs
+    # and the name still works afterwards
+    hs.create_index(df, hst.CoveringIndexConfig("leakIdx", ["k"], ["v"]))
