@@ -146,6 +146,11 @@ class Action:
                 raise ConcurrentModificationException(
                     f"Failed to commit final state for index {self.index_name!r}."
                 )
+            # the final entry is committed: the allocated data version is now
+            # referenced, so a failure past this point (e.g. latestStable
+            # write) must NOT delete it — readers fall back to scanning the
+            # log and would find the ACTIVE entry pointing at deleted files
+            self._allocated_version = None
             self.log_manager.create_latest_stable_log(self.base_id + 2)
         except NoChangesException:
             raise

@@ -63,6 +63,15 @@ def _read_files(
     from the file bytes — to each file's rows."""
     from hyperspace_tpu.exec.io import read_parquet_batch
 
+    if not files:
+        # every file pruned (e.g. data-skipping removed all of them): empty
+        # batch with the requested columns; dtype-less object arrays compare
+        # fine against any literal on zero rows
+        cols = list(columns or [])
+        if with_file_names:
+            cols.append(INPUT_FILE_NAME)
+        return {c: np.empty(0, dtype=object) for c in cols}
+
     part_cols = set()
     if partition_values:
         for v in partition_values.values():
@@ -78,16 +87,18 @@ def _read_files(
             file_columns = [c for c in columns if c not in part_cols]
 
     def read_one(f: str) -> B.Batch:
+        from hyperspace_tpu.sources import formats as F
+
         if file_columns is not None and not file_columns:
             # every requested column is a partition column: the file is never
             # decoded, but its row count still shapes the output
             b: B.Batch = {}
-            n = pads.dataset([f], format=file_format).count_rows()
+            n = F.count_rows(f, file_format)
         elif file_format == "parquet":
             b = read_parquet_batch([f], file_columns)
             n = B.num_rows(b)
         else:
-            b = B.table_to_batch(pads.dataset([f], format=file_format).to_table(columns=file_columns))
+            b = B.table_to_batch(F.read_table(f, file_format, file_columns))
             n = B.num_rows(b)
         if attach:
             from hyperspace_tpu.sources import partitions as P
@@ -104,7 +115,9 @@ def _read_files(
         return B.concat([read_one(f) for f in files])
     if file_format == "parquet":
         return read_parquet_batch(list(files), columns)
-    t = pads.dataset(files, format=file_format).to_table(columns=columns)
+    from hyperspace_tpu.sources import formats as F
+
+    t = F.open_dataset(list(files), file_format).to_table(columns=columns)
     return B.table_to_batch(t)
 
 

@@ -291,7 +291,9 @@ class DataSkippingIndex(Index):
                 b = read_parquet_batch([fi.name], file_cols)
                 n = len(next(iter(b.values()))) if b else 0
             else:
-                t = pads.dataset([fi.name], format=relation.physical_format).to_table(columns=file_cols)
+                from hyperspace_tpu.sources import formats as F
+
+                t = F.read_table(fi.name, relation.physical_format, file_cols)
                 b = {c: t.column(c).to_numpy(zero_copy_only=False) for c in file_cols}
                 n = len(next(iter(b.values()))) if b else 0
             if part_cols:

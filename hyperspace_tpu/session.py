@@ -45,6 +45,15 @@ class Session:
     def read_json(self, *paths, **options) -> "DataFrame":  # noqa: F821
         return self.read(list(paths), "json", **options)
 
+    def read_orc(self, *paths, **options) -> "DataFrame":  # noqa: F821
+        return self.read(list(paths), "orc", **options)
+
+    def read_avro(self, *paths, **options) -> "DataFrame":  # noqa: F821
+        return self.read(list(paths), "avro", **options)
+
+    def read_text(self, *paths, **options) -> "DataFrame":  # noqa: F821
+        return self.read(list(paths), "text", **options)
+
     def read_delta(self, path, version: Optional[int] = None) -> "DataFrame":  # noqa: F821
         from hyperspace_tpu.plan.dataframe import DataFrame
         from hyperspace_tpu.plan.logical import Scan

@@ -23,10 +23,13 @@ from hyperspace_tpu.sources.interfaces import (
     FileBasedSourceProvider,
 )
 from hyperspace_tpu.sources.signatures import file_based_signature
-
-SUPPORTED_FORMATS = ("parquet", "csv", "json")
-
-_EXTENSIONS = {".parquet": "parquet", ".csv": "csv", ".json": "json"}
+from hyperspace_tpu.sources.formats import (
+    MATERIALIZED_FORMATS,
+    SUPPORTED_FORMATS,
+    read_format_schema,
+    read_table,
+    tables_to_dataset,
+)
 
 
 def _list_data_files(root: str) -> List[str]:
@@ -93,9 +96,17 @@ class DefaultFileBasedRelation(FileBasedRelation):
     def schema(self) -> pa.Schema:
         # arrow_dataset() carries the hive partitioning, so its schema
         # already includes the partition fields (the path-derived value
-        # shadows any same-named column in the file bytes)
+        # shadows any same-named column in the file bytes); avro/text resolve
+        # from file headers alone — no record data is decoded for the schema
         if self._schema is None:
-            self._schema = self.arrow_dataset().schema
+            if self._file_format in MATERIALIZED_FORMATS:
+                s = read_format_schema(self._files, self._file_format)
+                for field in self._partition_arrow_fields():
+                    if field.name not in s.names:
+                        s = s.append(field)
+                self._schema = s
+            else:
+                self._schema = self.arrow_dataset().schema
         return self._schema
 
     @property
@@ -128,6 +139,8 @@ class DefaultFileBasedRelation(FileBasedRelation):
 
     def arrow_dataset(self, files: Optional[List[str]] = None) -> pads.Dataset:
         target = files if files is not None else self._files
+        if self._file_format in MATERIALIZED_FORMATS:
+            return self._materialized_dataset(target)
         if self._part_cols:
             part = pads.partitioning(pa.schema(self._partition_arrow_fields()), flavor="hive")
             return pads.dataset(
@@ -137,6 +150,22 @@ class DefaultFileBasedRelation(FileBasedRelation):
                 partition_base_dir=self._root_paths[0],
             )
         return pads.dataset(target, format=self._file_format)
+
+    def _materialized_dataset(self, target: List[str]) -> pads.Dataset:
+        """Avro/text: decode to in-memory tables, attaching hive-partition
+        columns (constant per file, absent from the file bytes) so the schema
+        matches what the native path's hive partitioning would expose."""
+        tables = []
+        for f in target:
+            t = read_table(f, self._file_format)
+            if self._part_cols:
+                vals = self.partition_values_for(f)
+                for field in self._partition_arrow_fields():
+                    t = t.append_column(
+                        field, pa.array([vals.get(field.name)] * t.num_rows, type=field.type)
+                    )
+            tables.append(t)
+        return tables_to_dataset(tables)
 
     def all_file_infos(self) -> List[FileInfo]:
         return [FileInfo.from_path(f) for f in self._files]

@@ -245,6 +245,26 @@ def _matches(schema: Any, value: Any, names: Dict[str, Any]) -> bool:
 # --------------------------------------------------------------------------
 
 
+def read_schema(path: str) -> Dict[str, Any]:
+    """Parse only the container header (magic + metadata map) — no record
+    blocks are read, so this is O(header) regardless of file size."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path!r} is not an Avro container file")
+        meta: Dict[str, bytes] = {}
+        while True:
+            count = _read_long(f)
+            if count == 0:
+                break
+            if count < 0:
+                _read_long(f)
+                count = -count
+            for _ in range(count):
+                k = _read_bytes(f).decode("utf-8")
+                meta[k] = _read_bytes(f)
+        return json.loads(meta["avro.schema"].decode("utf-8"))
+
+
 def read_container(path: str) -> Tuple[Dict[str, Any], List[Any]]:
     """Read an Avro container file; returns (schema, records)."""
     with open(path, "rb") as f:

@@ -1,0 +1,74 @@
+"""Failure-path safety of the action FSM (ref: actions/Action.scala:84-105;
+recovery semantics SURVEY.md §5.3): a failure AFTER the final log entry is
+committed must not delete the data version that entry references — readers
+fall back to scanning the log for the latest stable entry."""
+
+import os
+
+import numpy as np
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.models.log_manager import IndexLogManager
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+def test_late_failure_keeps_committed_data(session, hs, sample_parquet, monkeypatch):
+    df = session.read_parquet(sample_parquet)
+
+    real = IndexLogManager.create_latest_stable_log
+
+    def boom(self, log_id):
+        raise OSError("disk hiccup writing latestStable")
+
+    monkeypatch.setattr(IndexLogManager, "create_latest_stable_log", boom)
+    with pytest.raises(OSError):
+        hs.create_index(df, hst.CoveringIndexConfig("lateFail", ["c1"], ["c2"]))
+    monkeypatch.setattr(IndexLogManager, "create_latest_stable_log", real)
+
+    # the ACTIVE entry at base+2 was committed before the failure: fallback
+    # scan must find it, and every data file it references must still exist
+    entry = hs._manager.get_index("lateFail")
+    assert entry is not None and entry.state == "ACTIVE"
+    for f in entry.content.files:
+        assert os.path.exists(f), f"committed index file deleted: {f}"
+
+    # and the index is actually usable
+    session.enable_hyperspace()
+    q = df.filter(hst.col("c1") == 7).select("c2")
+    plan = q.optimized_plan()
+    assert "IndexScan" in plan.pretty()
+    session.disable_hyperspace()
+    baseline = np.sort(q.collect()["c2"])
+    session.enable_hyperspace()
+    np.testing.assert_array_equal(np.sort(q.collect()["c2"]), baseline)
+
+
+def test_early_failure_still_cleans_up(session, hs, sample_parquet, monkeypatch):
+    """The pre-commit cleanup behavior is preserved: op() failure removes the
+    allocated (never-referenced) data version."""
+    from hyperspace_tpu.actions.create import CreateAction
+
+    df = session.read_parquet(sample_parquet)
+
+    real_op = CreateAction.op
+
+    def failing_op(self):
+        real_op(self)
+        raise RuntimeError("op failed after writing data")
+
+    monkeypatch.setattr(CreateAction, "op", failing_op)
+    with pytest.raises(RuntimeError):
+        hs.create_index(df, hst.CoveringIndexConfig("earlyFail", ["c1"], ["c2"]))
+    monkeypatch.setattr(CreateAction, "op", real_op)
+
+    assert hs._manager.get_index("earlyFail") is None
+    # the allocated v__=0 dir was removed
+    sysdir = session.conf.system_path
+    idx_root = os.path.join(sysdir, "earlyFail")
+    if os.path.isdir(idx_root):
+        assert not any(d.startswith("v__=") for d in os.listdir(idx_root))
