@@ -18,7 +18,13 @@ import pyarrow.dataset as pads
 
 from hyperspace_tpu.exec import batch as B
 from hyperspace_tpu.plan import logical as L
-from hyperspace_tpu.plan.expr import INPUT_FILE_NAME, Expr, InputFileName, extract_equi_join_keys
+from hyperspace_tpu.plan.expr import (
+    INPUT_FILE_NAME,
+    Expr,
+    InputFileName,
+    as_bool_mask,
+    extract_equi_join_keys,
+)
 
 
 def _scan_identity(scan):
@@ -151,7 +157,7 @@ def _prune_partitions(scan: L.Scan, condition) -> Optional[List[str]]:
         file_batch[c] = arr
     mask = np.ones(len(files), dtype=bool)
     for t in terms:
-        mask &= np.asarray(t.eval(file_batch), dtype=bool)
+        mask &= as_bool_mask(t.eval(file_batch))
     return [f for f, keep in zip(files, mask) if keep]
 
 
@@ -304,7 +310,7 @@ class Executor:
                 )
             except D.DeviceUnsupported:
                 pass
-        return np.asarray(plan.condition.eval(child), dtype=bool)
+        return as_bool_mask(plan.condition.eval(child))
 
     def _exec_aggregate(self, plan: L.Aggregate, with_file_names: bool) -> B.Batch:
         import pandas as pd

@@ -127,7 +127,13 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
     from hyperspace_tpu import native
 
     def _dataset_read() -> B.Batch:
-        ds = pads.dataset(files, format="parquet")
+        try:
+            # unify per-file schemas so evolved columns survive regardless of
+            # file order (a bare dataset takes the FIRST fragment's schema)
+            unified = pa.unify_schemas([pq.read_schema(f) for f in files])
+            ds = pads.dataset(files, format="parquet", schema=unified)
+        except (OSError, pa.ArrowInvalid, pa.ArrowTypeError):
+            ds = pads.dataset(files, format="parquet")
         cols = columns
         if columns is not None and any("." in c and c not in ds.schema.names for c in columns):
             # nested struct paths (hybrid scan's appended-file side of a
@@ -159,6 +165,15 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
                     cols[c] = pc.field(*resolve_path(strip_nested_prefix(c)))
         t = ds.to_table(columns=cols)
         return B.table_to_batch(t)
+
+    # fully-cached scan with an explicit projection: every cached batch holds
+    # exactly ``columns``, so concatenation is schema-safe and the pq schema
+    # pre-scan can be skipped. With columns=None per-file schemas may differ
+    # (cached entries then have heterogeneous keys), so that case still goes
+    # through the pre-scan below before trusting the cache.
+    cached = [_io_cache_get(_io_cache_key(f, columns)) for f in files]
+    if columns is not None and cached and all(b is not None for b in cached):
+        return cached[0] if len(cached) == 1 else B.concat(cached)
 
     # pre-scan schemas; any inconsistency -> unified dataset read
     try:
@@ -193,9 +208,9 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
 
     # decode files concurrently (pyarrow and the native decoder release the
     # GIL); list order — bucket sortedness — is preserved by mapping, not by
-    # completion. Fully-cached reads skip the pool: no decode to parallelize.
-    cached = [_io_cache_get(_io_cache_key(f, columns)) for f in files]
-    if all(b is not None for b in cached):
+    # completion. Fully-cached reads (here: the columns=None case, now known
+    # schema-consistent) skip the pool: no decode to parallelize.
+    if cached and all(b is not None for b in cached):
         batches = cached
     elif len(files) > 1:
         batches = list(_decode_pool().map(read_one, files, schemas))

@@ -168,22 +168,33 @@ class Content:
         return [fi.name for fi in self.file_infos()]
 
     def file_infos(self) -> List[FileInfo]:
-        """Leaf files with absolute-path names."""
-        out: List[FileInfo] = []
+        """Leaf files with absolute-path names.
 
-        def walk(node: Directory, prefix: str) -> None:
-            base = os.path.join(prefix, node.name) if prefix else node.name
-            for f in node.files:
-                out.append(FileInfo(os.path.join(base, f.name), f.size, f.modified_time, f.file_id))
-            for s in node.subdirs:
-                walk(s, base)
+        The tree is never mutated after construction (merge/refresh build new
+        Content objects), so the walk is memoized — the optimizer touches this
+        on every candidate index per query, and re-joining every path
+        dominated the rewrite pass before caching."""
+        cached = self.__dict__.get("_file_infos")
+        if cached is None:
+            out: List[FileInfo] = []
 
-        walk(self.root, "")
-        return out
+            def walk(node: Directory, prefix: str) -> None:
+                base = os.path.join(prefix, node.name) if prefix else node.name
+                for f in node.files:
+                    out.append(FileInfo(os.path.join(base, f.name), f.size, f.modified_time, f.file_id))
+                for s in node.subdirs:
+                    walk(s, base)
+
+            walk(self.root, "")
+            cached = self.__dict__["_file_infos"] = out
+        return list(cached)
 
     @property
     def total_size(self) -> int:
-        return sum(f.size for f in self.file_infos())
+        cached = self.__dict__.get("_total_size")
+        if cached is None:
+            cached = self.__dict__["_total_size"] = sum(f.size for f in self.file_infos())
+        return cached
 
     def merge(self, other: "Content") -> "Content":
         return Content(self.root.merge(other.root))

@@ -90,6 +90,17 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self.plan), self.session)
 
+    def as_scalar(self) -> Expr:
+        """This one-column frame as a scalar-subquery expression, usable as a
+        comparison operand: ``df.filter(col("a") == other.select("b").as_scalar())``.
+        Index rewrites apply inside the subquery (ref: the reference's
+        `subquery` explain golden, src/test/resources/expected/spark-2.4/subquery.txt)."""
+        from hyperspace_tpu.plan.expr import ScalarSubquery
+
+        return ScalarSubquery(self.plan, self.session)
+
+    asScalar = as_scalar
+
     # --- actions -----------------------------------------------------------
     def optimized_plan(self) -> L.LogicalPlan:
         if self.session.hyperspace_enabled:

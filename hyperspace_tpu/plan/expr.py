@@ -168,6 +168,9 @@ class Expr:
         return BinaryOp("%", self, _wrap(other))
 
     def isin(self, *values: Any) -> "Expr":
+        if len(values) == 1 and hasattr(values[0], "plan") and hasattr(values[0], "session"):
+            # col.isin(df): uncorrelated IN-subquery over a one-column frame
+            return InSubquery(self, values[0].plan, values[0].session)
         if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
             values = tuple(values[0])
         return In(self, [(_wrap(v)) for v in values])
@@ -245,6 +248,27 @@ class BinaryOp(Expr):
         l = self.left.eval(batch)
         r = self.right.eval(batch)
         op = self.op
+        if l is EMPTY_SCALAR or r is EMPTY_SCALAR:
+            # a zero-row scalar subquery is SQL NULL: comparisons yield NULL
+            # (three-valued), arithmetic propagates as NaN
+            other = r if l is EMPTY_SCALAR else l
+            shape = () if other is EMPTY_SCALAR else np.shape(other)
+            if op in ("=", "!=", "<", "<=", ">", ">=", "AND", "OR"):
+                return NullableBool.all_null(shape)
+            return np.full(shape, np.nan)
+        if op == "AND":
+            return _kleene_and(l, r)
+        if op == "OR":
+            return _kleene_or(l, r)
+        if isinstance(l, NullableBool) or isinstance(r, NullableBool):
+            # boolean-typed NULL compared with = / != : stay null-aware
+            lv, lu = _parts(l)
+            rv, ru = _parts(r)
+            if op == "=":
+                return NullableBool(lv == rv, lu | ru)
+            if op == "!=":
+                return NullableBool(lv != rv, lu | ru)
+            raise ValueError(f"Operator {op!r} undefined for boolean NULL operands")
         if op == "=":
             return np.asarray(l == r)
         if op == "!=":
@@ -257,10 +281,6 @@ class BinaryOp(Expr):
             return np.asarray(l > r)
         if op == ">=":
             return np.asarray(l >= r)
-        if op == "AND":
-            return np.logical_and(l, r)
-        if op == "OR":
-            return np.logical_or(l, r)
         if op == "+":
             return l + r
         if op == "-":
@@ -285,7 +305,7 @@ class Not(Expr):
         return (self.child,)
 
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        return np.logical_not(self.child.eval(batch))
+        return _kleene_not(self.child.eval(batch))
 
     def __repr__(self) -> str:
         return f"(NOT {self.child!r})"
@@ -300,6 +320,10 @@ class IsNull(Expr):
 
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         v = self.child.eval(batch)
+        if v is EMPTY_SCALAR:
+            return np.ones((), dtype=bool)  # IS NULL on a zero-row scalar subquery
+        if isinstance(v, NullableBool):
+            return np.array(v.unknown)  # IS NULL of a three-valued boolean
         if v.dtype.kind == "f":
             return np.isnan(v)
         if v.dtype == object:
@@ -325,6 +349,140 @@ class In(Expr):
 
     def __repr__(self) -> str:
         return f"({self.child!r} IN {[v.value for v in self.values]!r})"
+
+
+#: sentinel returned by a scalar subquery with zero rows (SQL NULL)
+EMPTY_SCALAR = object()
+
+
+class NullableBool:
+    """Three-valued boolean result (Kleene logic): ``value`` where known,
+    ``unknown`` marking SQL-NULL positions. Produced by comparisons against a
+    zero-row scalar subquery; collapses to plain False at filter time
+    (``as_bool_mask``), so NOT/AND/OR over NULL behave as SQL requires
+    (NOT NULL = NULL, NULL OR TRUE = TRUE, NULL AND FALSE = FALSE)."""
+
+    def __init__(self, value: np.ndarray, unknown: np.ndarray):
+        self.value = np.asarray(value, dtype=bool)
+        self.unknown = np.asarray(unknown, dtype=bool)
+
+    @classmethod
+    def all_null(cls, shape) -> "NullableBool":
+        return cls(np.zeros(shape, dtype=bool), np.ones(shape, dtype=bool))
+
+
+def as_bool_mask(x) -> np.ndarray:
+    """Collapse an eval result to a definite boolean mask (NULL -> False)."""
+    if isinstance(x, NullableBool):
+        return x.value & ~x.unknown
+    return np.asarray(x, dtype=bool)
+
+
+def _kleene_not(x):
+    if isinstance(x, NullableBool):
+        return NullableBool(~x.value, x.unknown)
+    return np.logical_not(x)
+
+
+def _parts(x):
+    if isinstance(x, NullableBool):
+        return x.value, x.unknown
+    v = np.asarray(x, dtype=bool)
+    return v, np.zeros(v.shape, dtype=bool)
+
+
+def _kleene_and(l, r):
+    if not isinstance(l, NullableBool) and not isinstance(r, NullableBool):
+        return np.logical_and(l, r)
+    lv, lu = _parts(l)
+    rv, ru = _parts(r)
+    known_false = (~lu & ~lv) | (~ru & ~rv)
+    unknown = (lu | ru) & ~known_false
+    return NullableBool(lv & rv & ~unknown, unknown)
+
+
+def _kleene_or(l, r):
+    if not isinstance(l, NullableBool) and not isinstance(r, NullableBool):
+        return np.logical_or(l, r)
+    lv, lu = _parts(l)
+    rv, ru = _parts(r)
+    known_true = (~lu & lv) | (~ru & rv)
+    unknown = (lu | ru) & ~known_true
+    return NullableBool(known_true, unknown)
+
+
+class SubqueryExpr(Expr):
+    """Uncorrelated subquery carrying an inner relational plan.
+
+    The reference delegates subquery planning to Spark and its rules rewrite
+    the *inner* scans transparently (explain golden
+    src/test/resources/expected/spark-2.4/subquery.txt); here the IR carries
+    the inner plan itself and ``ApplyHyperspace`` recurses into it, so index
+    rewrites apply inside subqueries exactly as they do at top level.
+    Correlated subqueries are out of scope (as are they for the reference's
+    rules, which never see the correlation)."""
+
+    def __init__(self, plan, session):
+        self.plan = plan
+        self.session = session
+
+    def with_plan(self, plan) -> "SubqueryExpr":
+        return type(self)(plan, self.session)
+
+    def _values(self) -> np.ndarray:
+        from hyperspace_tpu.exec.executor import Executor
+
+        out_cols = list(self.plan.output_columns)
+        if len(out_cols) != 1:
+            raise ValueError(f"subquery must return exactly one column, got {out_cols!r}")
+        return Executor(self.session).execute(self.plan, required_columns=out_cols)[out_cols[0]]
+
+    def plan_summary(self) -> str:
+        nodes: List[str] = []
+
+        def walk(p) -> None:
+            nodes.append(p.describe())
+            for c in p.children():
+                walk(c)
+
+        walk(self.plan)
+        return " / ".join(nodes)
+
+
+class ScalarSubquery(SubqueryExpr):
+    """Single-value subquery usable as a comparison operand
+    (``col("a") == df2.filter(...).select("b").as_scalar()``)."""
+
+    def eval(self, batch: Dict[str, np.ndarray]):
+        v = self._values()
+        if len(v) > 1:
+            raise ValueError(f"scalar subquery returned {len(v)} rows, expected at most 1")
+        if len(v) == 0:
+            return EMPTY_SCALAR
+        return np.asarray(v[0])
+
+    def __repr__(self) -> str:
+        return f"scalar-subquery[{self.plan_summary()}]"
+
+
+class InSubquery(SubqueryExpr):
+    """Semi-join membership test (``col("a").isin(df2.select("b"))``)."""
+
+    def __init__(self, child: Expr, plan, session):
+        super().__init__(plan, session)
+        self.child = child
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def with_plan(self, plan) -> "InSubquery":
+        return InSubquery(self.child, plan, self.session)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        return np.isin(self.child.eval(batch), self._values())
+
+    def __repr__(self) -> str:
+        return f"({self.child!r} IN subquery[{self.plan_summary()}])"
 
 
 def _wrap(x: Any) -> Expr:
@@ -397,4 +555,6 @@ def rewrite_columns(e: Expr, mapping: Dict[str, str]) -> Expr:
         return IsNull(rewrite_columns(e.child, mapping))
     if isinstance(e, In):
         return In(rewrite_columns(e.child, mapping), list(e.values))
+    if isinstance(e, InSubquery):
+        return InSubquery(rewrite_columns(e.child, mapping), e.plan, e.session)
     return e

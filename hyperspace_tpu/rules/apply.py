@@ -2,22 +2,44 @@
 
 Fetch ACTIVE indexes, collect per-scan candidates, run the score-based
 rewrite; swallow all exceptions so index application can never break a query
-(ref: HS/index/rules/ApplyHyperspace.scala:31-66).
+(ref: HS/index/rules/ApplyHyperspace.scala:31-66). Recurses into uncorrelated
+subquery expressions so indexes apply inside subqueries too (the reference
+gets this for free from Catalyst walking the whole tree; explain golden
+src/test/resources/expected/spark-2.4/subquery.txt).
 """
 
 from __future__ import annotations
 
 import logging
-from typing import List, Optional
+from typing import List, Optional, Tuple
 
 from hyperspace_tpu.models import states
 from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import BinaryOp, Expr, IsNull, Not, SubqueryExpr
 from hyperspace_tpu.rules.candidate import collect_candidates
 from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.rules.score import ScoreBasedIndexPlanOptimizer
 from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
 
 logger = logging.getLogger(__name__)
+
+
+def iter_subquery_plans(plan: L.LogicalPlan):
+    """Yield the inner plan of every subquery expression in ``plan``
+    (recursively, including subqueries nested in subqueries)."""
+    for node in L.collect(plan, lambda p: isinstance(p, L.Filter)):
+        for sub in _collect_subqueries(node.condition):
+            yield sub.plan
+            yield from iter_subquery_plans(sub.plan)
+
+
+def _collect_subqueries(e: Expr) -> List[SubqueryExpr]:
+    out: List[SubqueryExpr] = []
+    if isinstance(e, SubqueryExpr):
+        out.append(e)
+    for c in e.children():
+        out.extend(_collect_subqueries(c))
+    return out
 
 
 class ApplyHyperspace:
@@ -34,27 +56,88 @@ class ApplyHyperspace:
             return plan
 
     def apply_with_score(self, plan: L.LogicalPlan):
+        new_plan, score = self._rewrite(plan)
+        if score == 0:
+            return plan, 0
+        used = set(
+            s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))
+        )
+        for sub_plan in iter_subquery_plans(new_plan):
+            used.update(
+                s.entry.name for s in L.collect(sub_plan, lambda p: isinstance(p, L.IndexScan))
+            )
+        get_event_logger(self.session).log_event(
+            HyperspaceIndexUsageEvent(index_names=sorted(used), plan_summary=new_plan.describe())
+        )
+        return new_plan, score
+
+    def _rewrite(self, plan: L.LogicalPlan) -> Tuple[L.LogicalPlan, int]:
         original = plan
         indexes = self.session.index_manager.get_indexes([states.ACTIVE])
         if not indexes:
             return original, 0
+        plan, sub_score = self._rewrite_subqueries(plan)
         # normalize: push required columns down to the scans (Catalyst runs
         # ColumnPruning before the reference's rules; this IR does it here)
         from hyperspace_tpu.rules.utils import prune_columns
 
-        plan = prune_columns(plan)
-        candidates = collect_candidates(self.ctx, plan, indexes)
-        if not candidates:
-            return original, 0
-        new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(plan, candidates)
-        if score == 0:
+        pruned = prune_columns(plan)
+        candidates = collect_candidates(self.ctx, pruned, indexes)
+        if candidates:
+            new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(pruned, candidates)
+        else:
+            new_plan, score = plan, 0
+        if score == 0 and sub_score == 0:
             # nothing rewritten — hand back the untouched user plan so explain
             # shows no spurious diff and execution shape is unchanged
             return original, 0
-        used = sorted(
-            {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
-        )
-        get_event_logger(self.session).log_event(
-            HyperspaceIndexUsageEvent(index_names=used, plan_summary=new_plan.describe())
-        )
-        return new_plan, score
+        return (new_plan if score > 0 else plan), score + sub_score
+
+    # --- subquery recursion ------------------------------------------------
+    def _rewrite_subqueries(self, plan: L.LogicalPlan) -> Tuple[L.LogicalPlan, int]:
+        """Rebuild Filter conditions whose subquery expressions gain index
+        rewrites. Expression and plan nodes are only copied along changed
+        paths; untouched subtrees keep their identity (and their tags)."""
+        total = 0
+
+        def rewrite_expr(e: Expr) -> Expr:
+            nonlocal total
+            if isinstance(e, SubqueryExpr):
+                new_inner_plan, score = self._rewrite(e.plan)
+                new_e = e
+                if score > 0:
+                    total += score
+                    new_e = e.with_plan(new_inner_plan)
+                if hasattr(e, "child"):
+                    new_child = rewrite_expr(e.child)
+                    if new_child is not e.child:
+                        if new_e is e:
+                            new_e = e.with_plan(e.plan)
+                        new_e.child = new_child
+                return new_e
+            if isinstance(e, BinaryOp):
+                nl, nr = rewrite_expr(e.left), rewrite_expr(e.right)
+                if nl is not e.left or nr is not e.right:
+                    return BinaryOp(e.op, nl, nr)
+                return e
+            if isinstance(e, Not):
+                nc = rewrite_expr(e.child)
+                return Not(nc) if nc is not e.child else e
+            if isinstance(e, IsNull):
+                nc = rewrite_expr(e.child)
+                return IsNull(nc) if nc is not e.child else e
+            return e
+
+        def walk(p: L.LogicalPlan) -> L.LogicalPlan:
+            children = list(p.children())
+            new_children = [walk(c) for c in children]
+            q = p
+            if any(nc is not c for nc, c in zip(new_children, children)):
+                q = p.with_children(new_children)
+            if isinstance(q, L.Filter):
+                new_cond = rewrite_expr(q.condition)
+                if new_cond is not q.condition:
+                    q = L.Filter(new_cond, q.child)
+            return q
+
+        return walk(plan), total

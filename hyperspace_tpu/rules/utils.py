@@ -99,8 +99,13 @@ def index_files_for_buckets(entry: IndexLogEntry, buckets: Optional[List[int]]) 
     files = entry.content.files
     if buckets is None:
         return files
+    # bucket ids are parsed from file names once per Content (immutable after
+    # load); re-running the regex per query dominated bucket-pruned rewrites
+    pairs = entry.content.__dict__.get("_file_buckets")
+    if pairs is None or len(pairs) != len(files):
+        pairs = entry.content.__dict__["_file_buckets"] = [(f, bucket_of_file(f)) for f in files]
     allowed = set(buckets)
-    return [f for f in files if bucket_of_file(f) in allowed]
+    return [f for f, b in pairs if b in allowed]
 
 
 def transform_plan_to_use_index(

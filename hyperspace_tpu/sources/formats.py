@@ -163,7 +163,19 @@ def open_dataset(files: List[str], file_format: str) -> pads.Dataset:
 def count_rows(path: str, file_format: str) -> int:
     if file_format in ARROW_NATIVE_FORMATS:
         return pads.dataset([path], format=file_format).count_rows()
-    return read_table(path, file_format).num_rows
+    if file_format == "avro":
+        # block headers carry record counts; no payload is decompressed
+        from hyperspace_tpu.utils.avro import count_records
+
+        return count_records(path)
+    if file_format == "text":
+        with open(path, "rb") as f:
+            data = f.read()
+        n = data.count(b"\n")
+        if data and not data.endswith(b"\n"):
+            n += 1  # last line without trailing newline is still a row
+        return n
+    raise ValueError(f"Unsupported file format: {file_format!r}")
 
 
 def read_format_schema(files: List[str], file_format: str) -> pa.Schema:

@@ -265,6 +265,35 @@ def read_schema(path: str) -> Dict[str, Any]:
         return json.loads(meta["avro.schema"].decode("utf-8"))
 
 
+def count_records(path: str) -> int:
+    """Total record count from block headers only: each block starts with
+    (count, byte-size); payloads are seeked past, never decompressed."""
+    with open(path, "rb") as f:
+        if f.read(4) != MAGIC:
+            raise ValueError(f"{path!r} is not an Avro container file")
+        while True:  # skip metadata map
+            count = _read_long(f)
+            if count == 0:
+                break
+            if count < 0:
+                _read_long(f)
+                count = -count
+            for _ in range(count):
+                _read_bytes(f)
+                _read_bytes(f)
+        f.read(16)  # sync marker
+        total = 0
+        while True:
+            try:
+                n = _read_long(f)
+            except EOFError:
+                break
+            size = _read_long(f)
+            f.seek(size + 16, 1)  # payload + sync marker
+            total += n
+        return total
+
+
 def read_container(path: str) -> Tuple[Dict[str, Any], List[Any]]:
     """Read an Avro container file; returns (schema, records)."""
     with open(path, "rb") as f:

@@ -213,6 +213,21 @@ class TestFormatHelpers:
         t1 = F.read_avro_table(f1, ["a", "b"])
         assert t1.column("b").to_pylist() == [None]
 
+    def test_avro_count_without_decoding(self, tmp_path):
+        from hyperspace_tpu.utils.avro import count_records, write_container
+
+        s = {"type": "record", "name": "r", "fields": [{"name": "a", "type": "long"}]}
+        p = str(tmp_path / "f.avro")
+        write_container(p, s, [{"a": i} for i in range(137)])
+        assert count_records(p) == 137
+        assert F.count_rows(p, "avro") == 137
+
+    def test_text_count_rows_no_trailing_newline(self, tmp_path):
+        p = str(tmp_path / "f.txt")
+        with open(p, "wb") as f:
+            f.write(b"a\nb\nc")  # no trailing newline
+        assert F.count_rows(p, "text") == 3
+
     def test_avro_schema_without_decoding_records(self, tmp_path):
         from hyperspace_tpu.utils.avro import read_schema, write_container
 

@@ -145,3 +145,45 @@ def test_index_files_are_native_decodable(tmp_path):
             assert np.all(k[1:] >= k[:-1])  # sorted within bucket
             total += nf.num_rows
     assert total == n
+
+
+def test_schema_evolution_cached_reads(tmp_path):
+    """A multi-file columns=None read over files with different schemas must
+    null-fill via the dataset path, including when per-file cache entries
+    already exist from earlier single-file reads (the fully-cached fast path
+    is only taken for explicit projections, where batches are homogeneous)."""
+    from hyperspace_tpu.exec.io import clear_io_cache
+
+    clear_io_cache()
+    fa = str(tmp_path / "a.parquet")
+    fb = str(tmp_path / "b.parquet")
+    pq.write_table(pa.table({"a": pa.array([1, 2], pa.int64()), "b": pa.array([10.0, 20.0])}), fa)
+    pq.write_table(pa.table({"a": pa.array([3], pa.int64())}), fb)
+
+    # warm per-file cache entries under (file, None)
+    read_parquet_batch([fa], None)
+    read_parquet_batch([fb], None)
+
+    got = read_parquet_batch([fa, fb], None)
+    assert got["a"].tolist() == [1, 2, 3]
+    assert got["b"][:2].tolist() == [10.0, 20.0] and np.isnan(got["b"][2])
+
+    # reversed order must not silently drop the evolved column either
+    got = read_parquet_batch([fb, fa], None)
+    assert sorted(got.keys()) == ["a", "b"]
+    clear_io_cache()
+
+
+def test_projected_cached_reads_concat(tmp_path):
+    from hyperspace_tpu.exec.io import clear_io_cache
+
+    clear_io_cache()
+    fa = str(tmp_path / "c.parquet")
+    fb = str(tmp_path / "d.parquet")
+    pq.write_table(pa.table({"a": pa.array([1, 2], pa.int64())}), fa)
+    pq.write_table(pa.table({"a": pa.array([3], pa.int64())}), fb)
+    read_parquet_batch([fa], ["a"])
+    read_parquet_batch([fb], ["a"])
+    got = read_parquet_batch([fa, fb], ["a"])  # fully-cached fast path
+    assert got["a"].tolist() == [1, 2, 3]
+    clear_io_cache()

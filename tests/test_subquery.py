@@ -1,0 +1,187 @@
+"""Uncorrelated subquery support: scalar subqueries as comparison operands and
+IN-subqueries, with index rewrites applied INSIDE the subquery plan (ref:
+explain golden src/test/resources/expected/spark-2.4/subquery.txt — the
+reference rewrites the subquery's inner scan to a covering-index scan)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.rules.apply import iter_subquery_plans
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+@pytest.fixture()
+def two_tables(tmp_path):
+    rng = np.random.default_rng(3)
+    n = 800
+    main = pa.table(
+        {
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.standard_normal(n),
+        }
+    )
+    # dim table: one row per id
+    dim = pa.table(
+        {
+            "id": np.arange(50, dtype=np.int64),
+            "tag": np.array([f"t{i % 7}" for i in range(50)]),
+        }
+    )
+    mroot, droot = tmp_path / "main", tmp_path / "dim"
+    mroot.mkdir(), droot.mkdir()
+    for i in range(2):
+        pq.write_table(main.slice(i * 400, 400), mroot / f"p{i}.parquet")
+    pq.write_table(dim, droot / "p0.parquet")
+    return str(mroot), str(droot)
+
+
+def subquery_plans(plan):
+    return list(iter_subquery_plans(plan))
+
+
+class TestScalarSubquery:
+    def test_results_and_inner_rewrite(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+
+        scalar = dim.filter(hst.col("tag") == "t3").filter(hst.col("id") == 3).select("id").as_scalar()
+        q = main.filter(hst.col("k") == scalar).select("v")
+        baseline = np.sort(q.collect()["v"])
+
+        hs.create_index(dim, hst.CoveringIndexConfig("dimIdx", ["tag"], ["id"]))
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        inner = subquery_plans(plan)
+        assert inner, "subquery plan must be discoverable in the optimized tree"
+        assert any(
+            isinstance(p, L.IndexScan) for sp in inner for p in L.collect(sp, lambda x: True)
+        ), plan.pretty()
+        np.testing.assert_array_equal(np.sort(q.collect()["v"]), baseline)
+
+    def test_outer_and_inner_rewrites_compose(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        scalar = dim.filter(hst.col("id") == 7).select("id").as_scalar()
+        q = main.filter(hst.col("k") == scalar).select("v")
+        baseline = np.sort(q.collect()["v"])
+
+        hs.create_index(dim, hst.CoveringIndexConfig("dimIdx2", ["id"], []))
+        hs.create_index(main, hst.CoveringIndexConfig("mainIdx", ["k"], ["v"]))
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        # outer rewritten to IndexScan
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda x: True))
+        # inner rewritten too
+        inner = subquery_plans(plan)
+        assert any(
+            isinstance(p, L.IndexScan) for sp in inner for p in L.collect(sp, lambda x: True)
+        )
+        np.testing.assert_array_equal(np.sort(q.collect()["v"]), baseline)
+
+    def test_empty_scalar_matches_nothing(self, session, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        scalar = dim.filter(hst.col("id") == 9999).select("id").as_scalar()
+        got = main.filter(hst.col("k") == scalar).select("v").collect()
+        assert got["v"].shape[0] == 0
+
+    def test_null_three_valued_logic(self, session, two_tables):
+        """SQL NULL semantics: NOT(k = NULL) is NULL -> selects nothing;
+        NULL OR true-predicate keeps the rows the true side matches;
+        NULL AND anything selects nothing; IS NULL on the null scalar is true."""
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        null_scalar = dim.filter(hst.col("id") == 9999).select("id").as_scalar()
+
+        assert main.filter(~(hst.col("k") == null_scalar)).collect()["k"].shape[0] == 0
+
+        with_or = main.filter((hst.col("k") == null_scalar) | (hst.col("k") == 3)).collect()
+        expected = main.filter(hst.col("k") == 3).collect()
+        assert with_or["k"].shape[0] == expected["k"].shape[0] > 0
+
+        with_and = main.filter((hst.col("k") == null_scalar) & (hst.col("k") == 3)).collect()
+        assert with_and["k"].shape[0] == 0
+
+        is_null = main.filter((hst.col("k") == null_scalar).is_null()).collect()
+        assert is_null["k"].shape[0] == main.collect()["k"].shape[0]
+
+    def test_multi_row_scalar_raises(self, session, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        scalar = dim.select("id").as_scalar()  # 50 rows
+        with pytest.raises(ValueError, match="scalar subquery"):
+            main.filter(hst.col("k") == scalar).collect()
+
+    def test_multi_column_subquery_raises(self, session, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        scalar = dim.as_scalar()  # two columns
+        with pytest.raises(ValueError, match="one column"):
+            main.filter(hst.col("k") == scalar).collect()
+
+
+class TestInSubquery:
+    def test_results_and_inner_rewrite(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+
+        members = dim.filter(hst.col("tag") == "t2").select("id")
+        q = main.filter(hst.col("k").isin(members)).select("v")
+        baseline = np.sort(q.collect()["v"])
+        assert baseline.shape[0] > 0
+
+        hs.create_index(dim, hst.CoveringIndexConfig("dimTag", ["tag"], ["id"]))
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        inner = subquery_plans(plan)
+        assert any(
+            isinstance(p, L.IndexScan) for sp in inner for p in L.collect(sp, lambda x: True)
+        ), plan.pretty()
+        np.testing.assert_array_equal(np.sort(q.collect()["v"]), baseline)
+
+    def test_case_insensitive_outer_column(self, session, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        members = dim.filter(hst.col("tag") == "t2").select("id")
+        got = main.filter(hst.col("K").isin(members)).select("v").collect()
+        expected = main.filter(hst.col("k").isin(members)).select("v").collect()
+        np.testing.assert_array_equal(np.sort(got["v"]), np.sort(expected["v"]))
+
+    def test_plain_isin_list_unchanged(self, session, two_tables):
+        mroot, _ = two_tables
+        main = session.read_parquet(mroot)
+        got = main.filter(hst.col("k").isin([1, 2, 3])).collect()
+        assert set(np.unique(got["k"])) <= {1, 2, 3}
+
+    def test_disabled_hyperspace_leaves_subquery_alone(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        hs.create_index(dim, hst.CoveringIndexConfig("dimTag2", ["tag"], ["id"]))
+        q = main.filter(hst.col("k").isin(dim.filter(hst.col("tag") == "t1").select("id")))
+        session.disable_hyperspace()
+        plan = q.optimized_plan()
+        assert not any(
+            isinstance(p, L.IndexScan)
+            for sp in subquery_plans(plan)
+            for p in L.collect(sp, lambda x: True)
+        )
+
+
+class TestExplainShowsSubquery:
+    def test_pretty_contains_subquery_and_index(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        hs.create_index(dim, hst.CoveringIndexConfig("dimIdx3", ["id"], []))
+        session.enable_hyperspace()
+        q = main.filter(hst.col("k") == dim.filter(hst.col("id") == 4).select("id").as_scalar())
+        text = q.optimized_plan().pretty()
+        assert "scalar-subquery" in text
+        assert "dimIdx3" in text, text
