@@ -166,8 +166,11 @@ class Executor:
         self.session = session
 
     def execute(self, plan: L.LogicalPlan, required_columns: Optional[List[str]] = None) -> B.Batch:
-        with_file_names = _plan_needs_file_names(plan)
-        batch = self._exec(plan, with_file_names)
+        from hyperspace_tpu.plan.expr import subquery_scope
+
+        with subquery_scope():  # each subquery runs once per outermost execute
+            with_file_names = _plan_needs_file_names(plan)
+            batch = self._exec(plan, with_file_names)
         if required_columns is not None:
             batch = B.select(batch, required_columns)
         elif INPUT_FILE_NAME in batch:

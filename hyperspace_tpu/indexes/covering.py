@@ -295,26 +295,117 @@ def write_bucketed(
     from hyperspace_tpu.ops.sort import bucket_sort_build, padded_size
 
     timing = os.environ.get("HS_BUILD_TIMING", "") == "1"
-    marks = {}
-
-    def mark(name, t0):
-        if timing:
-            marks[name] = round(_time.perf_counter() - t0, 3)
-        return _time.perf_counter()
 
     os.makedirs(out_dir, exist_ok=True)
     n = table.num_rows
     if n == 0:
         return []
 
+    def _launch(chunk: pa.Table) -> dict:
+        """Host encode + device program dispatch + async d2h start. Returns
+        the in-flight state; nothing here blocks on the device."""
+        marks = {}
+        t = _time.perf_counter()
+        batch = table_to_batch(chunk.select(bucket_sort_columns))
+        keys, kinds, host_hashes = encode.encode_sort_columns(
+            [batch[c] for c in bucket_sort_columns]
+        )
+        if timing:
+            marks["encode_keys"] = round(_time.perf_counter() - t, 3)
+        t = _time.perf_counter()
+        cn = chunk.num_rows
+        np2 = padded_size(cn)
+        dev_keys = [jax.device_put(np.pad(k, (0, np2 - cn))) for k in keys]
+        dev_hashes = [jax.device_put(np.pad(h, (0, np2 - cn))) for h in host_hashes]
+        perm, counts = bucket_sort_build(dev_keys, dev_hashes, kinds, num_buckets, cn)
+        counts.copy_to_host_async()
+        # the permutation comes back in pieces so bucket writes can start
+        # while later pieces are still in flight (device->host is the narrow
+        # link — on a tunneled chip by far the narrowest)
+        n_pieces = min(8, max(1, np2 // (1 << 18)))
+        piece_len = np2 // n_pieces
+        pieces = [perm[i * piece_len : (i + 1) * piece_len] for i in range(n_pieces)]
+        for p in pieces:
+            p.copy_to_host_async()
+        if timing:
+            marks["pad_upload_launch"] = round(_time.perf_counter() - t, 3)
+        return {"chunk": chunk, "np2": np2, "counts": counts, "pieces": pieces, "marks": marks}
+
+    def _finish(state: dict, chunk_payload_fn) -> List[str]:
+        """Drain the permutation and write the per-bucket sorted parquet
+        files; host-heavy, overlapped with the NEXT chunk's device work."""
+        chunk, np2 = state["chunk"], state["np2"]
+        marks = state["marks"]
+        t = _time.perf_counter()
+        if chunk_payload_fn is not None:
+            payload = chunk_payload_fn()
+            if payload is not None:
+                for name in payload.column_names:
+                    chunk = chunk.append_column(payload.schema.field(name), payload.column(name))
+        if timing:
+            marks["payload_decode"] = round(_time.perf_counter() - t, 3)
+        t = _time.perf_counter()
+        if column_order:
+            chunk = chunk.select(column_order)
+        # single-chunk columns so per-bucket takes don't re-resolve chunk
+        # offsets (a numpy-gather variant measured equal within noise; arrow
+        # take keeps string/date columns on one code path)
+        chunk = chunk.combine_chunks()
+        if timing:
+            marks["combine_chunks"] = round(_time.perf_counter() - t, 3)
+        t = _time.perf_counter()
+        counts_np = np.asarray(state["counts"])
+        boundaries = np.concatenate([[0], np.cumsum(counts_np)])
+        if timing:
+            marks["counts_wait"] = round(_time.perf_counter() - t, 3)
+        t = _time.perf_counter()
+
+        def _take_write(b: int, lo: int, hi: int) -> str:
+            path = os.path.join(out_dir, _bucket_file_name(b))
+            # uncompressed PLAIN is the index-file dialect: the native decoder
+            # (hyperspace_tpu/native) mmaps these and memcpys column chunks
+            # into device-feedable buffers with zero decompression work
+            rows = chunk.take(pa.array(perm_np[lo:hi]))
+            pq.write_table(rows, path, use_dictionary=False, compression="NONE")
+            return path
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        perm_np = np.empty(np2, dtype=np.int32)
+        arrived = 0
+        next_piece = 0
+        futures = []
+        pieces = state["pieces"]
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            for b in range(num_buckets):
+                lo, hi = int(boundaries[b]), int(boundaries[b + 1])
+                if hi <= lo:
+                    continue
+                while arrived < hi:
+                    piece = np.asarray(pieces[next_piece])  # blocks for this piece only
+                    perm_np[arrived : arrived + piece.shape[0]] = piece
+                    arrived += piece.shape[0]
+                    next_piece += 1
+                futures.append(ex.submit(_take_write, b, lo, hi))
+            out = [f.result() for f in futures]
+        if timing:
+            marks["perm_drain_take_write"] = round(_time.perf_counter() - t, 3)
+            # stderr: bench.py's stdout contract is exactly one JSON line.
+            # (Coarse wall-clock marks complement session.profile()'s XLA
+            # traces for machines without trace tooling; labels match.)
+            import sys as _sys
+
+            print(f"HS_BUILD_TIMING rows={chunk.num_rows} {marks}", file=_sys.stderr, flush=True)
+        return out
+
     if batch_rows is not None and batch_rows > 0 and n > batch_rows:
-        # chunked build: each chunk runs the single-shot device program and
-        # writes its own sorted run per bucket, bounding device memory at
-        # ~batch_rows regardless of table size. Multi-run buckets are the
-        # same physical state incremental refresh produces (UpdateMode.Merge)
-        # — the join path re-sorts them lazily and optimize compacts them.
-        # payload decodes lazily on first use, so chunk 0's device launch
-        # still overlaps it (per-chunk slices are zero-copy afterwards)
+        # chunked build, software-pipelined one chunk deep: chunk k+1's
+        # device program (and its d2h transfers) runs while chunk k's host
+        # side drains and writes parquet. Each chunk writes its own sorted
+        # run per bucket — the multi-run state incremental refresh also
+        # produces (UpdateMode.Merge); the join path re-sorts lazily and
+        # optimize compacts. Peak device footprint is two chunks
+        # (~2x batchRows rows); payload decodes lazily per chunk slice.
         payload_cell: List[Optional[pa.Table]] = []
 
         def full_payload() -> Optional[pa.Table]:
@@ -322,101 +413,28 @@ def write_bucketed(
                 payload_cell.append(payload_fn() if payload_fn is not None else None)
             return payload_cell[0]
 
+        def payload_for(off: int):
+            if payload_fn is None:
+                return None
+
+            def chunk_payload_fn():
+                p = full_payload()
+                return p.slice(off, batch_rows) if p is not None else None
+
+            return chunk_payload_fn
+
         paths: List[str] = []
+        in_flight: Optional[tuple] = None
         for off in range(0, n, batch_rows):
-            chunk_payload_fn = None
-            if payload_fn is not None:
-                def chunk_payload_fn(off=off):
-                    p = full_payload()
-                    return p.slice(off, batch_rows) if p is not None else None
-            paths.extend(
-                write_bucketed(
-                    table.slice(off, batch_rows),
-                    bucket_sort_columns,
-                    num_buckets,
-                    out_dir,
-                    payload_fn=chunk_payload_fn,
-                    column_order=column_order,
-                )
-            )
+            state = _launch(table.slice(off, batch_rows))
+            if in_flight is not None:
+                paths.extend(_finish(*in_flight))
+            in_flight = (state, payload_for(off))
+        if in_flight is not None:
+            paths.extend(_finish(*in_flight))
         return paths
 
-    t = _time.perf_counter()
-    batch = table_to_batch(table.select(bucket_sort_columns))
-    keys, kinds, host_hashes = encode.encode_sort_columns(
-        [batch[c] for c in bucket_sort_columns]
-    )
-    t = mark("encode_keys", t)
-    np2 = padded_size(n)
-    dev_keys = [jax.device_put(np.pad(k, (0, np2 - n))) for k in keys]
-    dev_hashes = [jax.device_put(np.pad(h, (0, np2 - n))) for h in host_hashes]
-    perm, counts = bucket_sort_build(dev_keys, dev_hashes, kinds, num_buckets, n)
-    counts.copy_to_host_async()
-    t = mark("pad_upload_launch", t)
-    # the permutation comes back in pieces so bucket writes can start while
-    # later pieces are still in flight (device->host is the narrow link)
-    n_pieces = min(8, max(1, np2 // (1 << 18)))
-    piece_len = np2 // n_pieces
-    pieces = [perm[i * piece_len : (i + 1) * piece_len] for i in range(n_pieces)]
-    for p in pieces:
-        p.copy_to_host_async()
-
-    # -- overlapped with the device->host transfer ---------------------------
-    if payload_fn is not None:
-        payload = payload_fn()
-        if payload is not None:
-            for name in payload.column_names:
-                table = table.append_column(payload.schema.field(name), payload.column(name))
-    t = mark("payload_decode", t)
-    if column_order:
-        table = table.select(column_order)
-
-    # single-chunk columns so per-bucket takes don't re-resolve chunk offsets
-    # (a numpy-gather variant measured equal within noise; arrow take keeps
-    # string/date columns on one code path)
-    table = table.combine_chunks()
-    t = mark("combine_chunks", t)
-
-    counts_np = np.asarray(counts)
-    boundaries = np.concatenate([[0], np.cumsum(counts_np)])
-    t = mark("counts_wait", t)
-
-    def _take_write(b: int, lo: int, hi: int) -> str:
-        path = os.path.join(out_dir, _bucket_file_name(b))
-        # uncompressed PLAIN is the index-file dialect: the native decoder
-        # (hyperspace_tpu/native) mmaps these and memcpys column chunks into
-        # device-feedable buffers with zero decompression work
-        rows = table.take(pa.array(perm_np[lo:hi]))
-        pq.write_table(rows, path, use_dictionary=False, compression="NONE")
-        return path
-
-    from concurrent.futures import ThreadPoolExecutor
-
-    perm_np = np.empty(np2, dtype=np.int32)
-    arrived = 0
-    next_piece = 0
-    futures = []
-    with ThreadPoolExecutor(max_workers=8) as ex:
-        for b in range(num_buckets):
-            lo, hi = int(boundaries[b]), int(boundaries[b + 1])
-            if hi <= lo:
-                continue
-            while arrived < hi:
-                chunk = np.asarray(pieces[next_piece])  # blocks for this piece only
-                perm_np[arrived : arrived + chunk.shape[0]] = chunk
-                arrived += chunk.shape[0]
-                next_piece += 1
-            futures.append(ex.submit(_take_write, b, lo, hi))
-        out = [f.result() for f in futures]
-    mark("perm_drain_take_write", t)
-    if timing:
-        # stderr: bench.py's stdout contract is exactly one JSON line.
-        # (Coarse wall-clock marks complement session.profile()'s XLA traces
-        # for machines without trace tooling; stage labels match.)
-        import sys as _sys
-
-        print(f"HS_BUILD_TIMING rows={n} {marks}", file=_sys.stderr, flush=True)
-    return out
+    return _finish(_launch(table), payload_fn)
 
 
 class CoveringIndexConfig(IndexConfig):

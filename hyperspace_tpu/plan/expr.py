@@ -13,6 +13,8 @@ Device-side evaluation compiles the same tree to jnp ops (see exec/device.py).
 
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Set
 
 import numpy as np
@@ -250,12 +252,18 @@ class BinaryOp(Expr):
         op = self.op
         if l is EMPTY_SCALAR or r is EMPTY_SCALAR:
             # a zero-row scalar subquery is SQL NULL: comparisons yield NULL
-            # (three-valued), arithmetic propagates as NaN
+            # (three-valued), arithmetic propagates as NaN; a boolean NULL in
+            # AND/OR still Kleene-combines with the other side below
             other = r if l is EMPTY_SCALAR else l
             shape = () if other is EMPTY_SCALAR else np.shape(other)
-            if op in ("=", "!=", "<", "<=", ">", ">=", "AND", "OR"):
-                return NullableBool.all_null(shape)
-            return np.full(shape, np.nan)
+            null = NullableBool.all_null(shape)
+            if op in ("AND", "OR"):
+                l = null if l is EMPTY_SCALAR else l
+                r = null if r is EMPTY_SCALAR else r
+            elif op in ("=", "!=", "<", "<=", ">", ">="):
+                return null
+            else:
+                return np.full(shape, np.nan)
         if op == "AND":
             return _kleene_and(l, r)
         if op == "OR":
@@ -354,6 +362,27 @@ class In(Expr):
 #: sentinel returned by a scalar subquery with zero rows (SQL NULL)
 EMPTY_SCALAR = object()
 
+# Per-execution subquery memoization: one outer collect() may evaluate the
+# same condition more than once (partition pruning, then the row filter);
+# the scope caches each subquery's result for the duration of the OUTERMOST
+# execute so the inner plan runs once per query, never across queries (data
+# may change between collects).
+_subquery_scope = threading.local()
+
+
+@contextlib.contextmanager
+def subquery_scope():
+    depth = getattr(_subquery_scope, "depth", 0)
+    if depth == 0:
+        _subquery_scope.cache = {}
+    _subquery_scope.depth = depth + 1
+    try:
+        yield
+    finally:
+        _subquery_scope.depth -= 1
+        if _subquery_scope.depth == 0:
+            _subquery_scope.cache = None
+
 
 class NullableBool:
     """Three-valued boolean result (Kleene logic): ``value`` where known,
@@ -432,10 +461,16 @@ class SubqueryExpr(Expr):
     def _values(self) -> np.ndarray:
         from hyperspace_tpu.exec.executor import Executor
 
+        cache = getattr(_subquery_scope, "cache", None)
+        if cache is not None and id(self) in cache:
+            return cache[id(self)]
         out_cols = list(self.plan.output_columns)
         if len(out_cols) != 1:
             raise ValueError(f"subquery must return exactly one column, got {out_cols!r}")
-        return Executor(self.session).execute(self.plan, required_columns=out_cols)[out_cols[0]]
+        vals = Executor(self.session).execute(self.plan, required_columns=out_cols)[out_cols[0]]
+        if cache is not None:
+            cache[id(self)] = vals
+        return vals
 
     def plan_summary(self) -> str:
         nodes: List[str] = []

@@ -169,10 +169,16 @@ def count_rows(path: str, file_format: str) -> int:
 
         return count_records(path)
     if file_format == "text":
+        n = 0
+        last = b""
         with open(path, "rb") as f:
-            data = f.read()
-        n = data.count(b"\n")
-        if data and not data.endswith(b"\n"):
+            while True:  # stream: bounded memory on arbitrarily large files
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                n += chunk.count(b"\n")
+                last = chunk[-1:]
+        if last and last != b"\n":
             n += 1  # last line without trailing newline is still a row
         return n
     raise ValueError(f"Unsupported file format: {file_format!r}")

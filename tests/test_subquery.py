@@ -113,6 +113,45 @@ class TestScalarSubquery:
         is_null = main.filter((hst.col("k") == null_scalar).is_null()).collect()
         assert is_null["k"].shape[0] == main.collect()["k"].shape[0]
 
+    def test_null_scalar_as_boolean_operand(self, session, two_tables):
+        """A NULL boolean scalar Kleene-combines in AND/OR: NULL OR TRUE
+        keeps the true side's rows; NULL AND anything keeps none."""
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        null_bool = dim.filter(hst.col("id") == 9999).select("id").as_scalar()
+
+        with_or = main.filter(null_bool | (hst.col("k") == 3)).collect()
+        expected = main.filter(hst.col("k") == 3).collect()
+        assert with_or["k"].shape[0] == expected["k"].shape[0] > 0
+
+        with_and = main.filter(null_bool & (hst.col("k") == 3)).collect()
+        assert with_and["k"].shape[0] == 0
+
+    def test_subquery_executes_once_per_collect(self, session, two_tables, monkeypatch):
+        from hyperspace_tpu.plan.expr import SubqueryExpr
+
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        calls = {"n": 0}
+        real = SubqueryExpr._values
+
+        def counting(self):
+            cache = None
+            import hyperspace_tpu.plan.expr as E
+
+            cache = getattr(E._subquery_scope, "cache", None)
+            if cache is None or id(self) not in cache:
+                calls["n"] += 1
+            return real(self)
+
+        monkeypatch.setattr(SubqueryExpr, "_values", counting)
+        scalar = dim.filter(hst.col("id") == 7).select("id").as_scalar()
+        main.filter(hst.col("k") == scalar).collect()
+        assert calls["n"] == 1, f"inner plan ran {calls['n']} times in one collect"
+        # a second collect re-executes (no cross-query staleness)
+        main.filter(hst.col("k") == scalar).collect()
+        assert calls["n"] == 2
+
     def test_multi_row_scalar_raises(self, session, two_tables):
         mroot, droot = two_tables
         main, dim = session.read_parquet(mroot), session.read_parquet(droot)
