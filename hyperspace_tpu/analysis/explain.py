@@ -78,7 +78,13 @@ def _pretty_highlighted(plan: L.LogicalPlan, other_subtrees: set, mode: DisplayM
 
 
 def _operator_counts(plan: L.LogicalPlan) -> Counter:
-    return Counter(type(p).__name__ for p in L.collect(plan, lambda p: True))
+    from hyperspace_tpu.rules.apply import plans_including_subqueries
+
+    return Counter(
+        type(p).__name__
+        for sub in plans_including_subqueries(plan)
+        for p in L.collect(sub, lambda x: True)
+    )
 
 
 def physical_operator_stats(plan_with: L.LogicalPlan, plan_without: L.LogicalPlan) -> List[Tuple[str, int, int]]:
@@ -92,19 +98,26 @@ def physical_operator_stats(plan_with: L.LogicalPlan, plan_without: L.LogicalPla
 
 
 def _used_indexes(plan: L.LogicalPlan) -> List[str]:
-    used = {s.entry.name for s in L.collect(plan, lambda p: isinstance(p, L.IndexScan))}
-    used |= {
-        s.via_index
-        for s in L.collect(plan, lambda p: isinstance(p, L.FileScan))
-        if s.via_index
-    }
+    from hyperspace_tpu.rules.apply import plans_including_subqueries
+
+    used = set()
+    for p in plans_including_subqueries(plan):
+        used |= {s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))}
+        used |= {
+            s.via_index
+            for s in L.collect(p, lambda x: isinstance(x, L.FileScan))
+            if s.via_index
+        }
     return sorted(used)
 
 
 def _bucket_summary(plan: L.LogicalPlan) -> List[str]:
+    from hyperspace_tpu.rules.apply import plans_including_subqueries
+
     out = []
-    for node in L.collect(plan, lambda p: isinstance(p, (L.IndexScan, L.BucketUnion))):
-        out.append(node.describe())
+    for p in plans_including_subqueries(plan):
+        for node in L.collect(p, lambda x: isinstance(x, (L.IndexScan, L.BucketUnion))):
+            out.append(node.describe())
     return out
 
 

@@ -25,11 +25,21 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
         missing = index_name not in {e.name for e in indexes}
         if missing:
             return f"Index {index_name!r} does not exist or is not ACTIVE."
+    from hyperspace_tpu.rules.apply import plans_including_subqueries
+
     plan = df.plan
     new_plan = applier.apply(plan)
-    applied = {s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))}
-
-    scans = L.collect(plan, lambda p: isinstance(p, L.Scan))
+    applied = set()
+    scans = []
+    for p in plans_including_subqueries(new_plan):
+        applied |= {s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))}
+        applied |= {
+            s.via_index
+            for s in L.collect(p, lambda x: isinstance(x, L.FileScan))
+            if s.via_index
+        }
+    for p in plans_including_subqueries(plan):
+        scans.extend(L.collect(p, lambda x: isinstance(x, L.Scan)))
     # unique scans by plan key; disambiguate label collisions across distinct
     # scans (two datasets can share a directory basename)
     by_key = {}

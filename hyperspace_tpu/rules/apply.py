@@ -33,6 +33,13 @@ def iter_subquery_plans(plan: L.LogicalPlan):
             yield from iter_subquery_plans(sub.plan)
 
 
+def plans_including_subqueries(plan: L.LogicalPlan) -> List[L.LogicalPlan]:
+    """``plan`` plus every subquery inner plan it carries — the single
+    traversal helper every analysis over "the whole query" must use, so a new
+    subquery host (if one is ever added) is handled in one place."""
+    return [plan, *iter_subquery_plans(plan)]
+
+
 def _collect_subqueries(e: Expr) -> List[SubqueryExpr]:
     out: List[SubqueryExpr] = []
     if isinstance(e, SubqueryExpr):
@@ -59,12 +66,10 @@ class ApplyHyperspace:
         new_plan, score = self._rewrite(plan)
         if score == 0:
             return plan, 0
-        used = set(
-            s.entry.name for s in L.collect(new_plan, lambda p: isinstance(p, L.IndexScan))
-        )
-        for sub_plan in iter_subquery_plans(new_plan):
+        used = set()
+        for p in plans_including_subqueries(new_plan):
             used.update(
-                s.entry.name for s in L.collect(sub_plan, lambda p: isinstance(p, L.IndexScan))
+                s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))
             )
         get_event_logger(self.session).log_event(
             HyperspaceIndexUsageEvent(index_names=sorted(used), plan_summary=new_plan.describe())

@@ -404,3 +404,32 @@ class TestHybridAndRefresh:
         fscans = [p for p in L.collect(plan, lambda p: True) if isinstance(p, L.FileScan)]
         assert len(fscans) == 1 and len(fscans[0].files) == 1
         assert len(q.collect()["v"]) == 50
+
+
+def test_why_not_reports_applied_dataskipping_index(session, tmp_path):
+    """why_not and explain must agree: an applied data-skipping index (a
+    FileScan rewrite carrying via_index, not an IndexScan) shows up in both
+    reports' applied/used lists."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+
+    root = tmp_path / "dsdata"
+    root.mkdir()
+    for i in range(4):
+        lo = i * 100
+        pq.write_table(
+            pa.table({"v": np.arange(lo, lo + 100, dtype=np.int64)}),
+            root / f"p{i}.parquet",
+        )
+    hs = hst.Hyperspace(session)
+    df = session.read_parquet(str(root))
+    hs.create_index(df, hst.DataSkippingIndexConfig("dsWhy", hst.MinMaxSketch("v")))
+    session.enable_hyperspace()
+    q = df.filter(hst.col("v") == 123)
+    assert "dsWhy" in hs.explain(q).split("Indexes used:")[1]
+    report = hs.why_not(q)
+    line = [l for l in report.splitlines() if l.startswith("Applied indexes:")][0]
+    assert "dsWhy" in line, report

@@ -94,6 +94,44 @@ def test_golden_explain_self_join(golden_env):
     _check("selfJoin.txt", _normalize(hs.explain(q, verbose=True), roots))
 
 
+def test_golden_explain_subquery(golden_env):
+    """(ref: src/test/resources/expected/spark-2.4/subquery.txt — index
+    applied INSIDE the scalar subquery's plan)"""
+    sess, hs, df, roots = golden_env
+    scalar = df.filter(hst.col("clicks") == 3).limit(1).select("query").as_scalar()
+    q = df.filter(hst.col("query") == scalar).select("imprs")
+    _check("subquery.txt", _normalize(hs.explain(q, verbose=True), roots))
+
+
+def test_golden_explain_self_join_iceberg(tmp_path):
+    """(ref: src/test/resources/expected/spark-2.4/selfJoin_Iceberg.txt)"""
+    from hyperspace_tpu.sources.iceberg import write_iceberg_table
+
+    rng = np.random.default_rng(12345)
+    n = 500
+    table = pa.table(
+        {
+            "k": rng.integers(0, 50, n).astype(np.int64),
+            "v": rng.integers(0, 500, n).astype(np.int64),
+        }
+    )
+    root = str(tmp_path / "ice")
+    write_iceberg_table(table, root)
+    sysp = tmp_path / "indexes"
+    sysp.mkdir()
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: str(sysp), hst.keys.NUM_BUCKETS: 8})
+    hst.set_session(sess)
+    try:
+        hs = hst.Hyperspace(sess)
+        df = sess.read_iceberg(root)
+        hs.create_index(df, hst.CoveringIndexConfig("iceJoinIndex", ["k"], ["v"]))
+        sess.enable_hyperspace()
+        q = df.join(df, on=["k"]).select("v")
+        _check("selfJoin_Iceberg.txt", _normalize(hs.explain(q, verbose=True), [tmp_path]))
+    finally:
+        hst.set_session(None)
+
+
 def test_golden_why_not_all_index(golden_env):
     sess, hs, df, roots = golden_env
     q = df.filter(hst.col("score") > 0).select("query")

@@ -214,6 +214,30 @@ class TestInSubquery:
         )
 
 
+class TestWhyNotSeesSubquery:
+    def test_applied_inside_subquery_reported(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        hs.create_index(dim, hst.CoveringIndexConfig("dimWhy", ["tag"], ["id"]))
+        session.enable_hyperspace()
+        q = main.filter(hst.col("k").isin(dim.filter(hst.col("tag") == "t1").select("id")))
+        report = hs.why_not(q)
+        assert "dimWhy" in report and "(applied)" not in report.split("dimWhy")[0]
+        assert "dimWhy" in report.split("Applied indexes:")[1].splitlines()[0]
+
+    def test_subquery_scan_disqualification_reported(self, session, hs, two_tables):
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        # index does not cover column `id`, so it cannot apply inside the
+        # subquery — whyNot must report a reason against the dim scan
+        hs.create_index(dim, hst.CoveringIndexConfig("dimNarrow", ["tag"], []))
+        session.enable_hyperspace()
+        q = main.filter(hst.col("k").isin(dim.filter(hst.col("tag") == "t1").select("id")))
+        report = hs.why_not(q)
+        assert "dimNarrow" in report
+        assert "Scan(dim)" in report  # the subquery's scan label appears
+
+
 class TestExplainShowsSubquery:
     def test_pretty_contains_subquery_and_index(self, session, hs, two_tables):
         mroot, droot = two_tables
