@@ -98,17 +98,9 @@ def physical_operator_stats(plan_with: L.LogicalPlan, plan_without: L.LogicalPla
 
 
 def _used_indexes(plan: L.LogicalPlan) -> List[str]:
-    from hyperspace_tpu.rules.apply import plans_including_subqueries
+    from hyperspace_tpu.rules.apply import used_index_names
 
-    used = set()
-    for p in plans_including_subqueries(plan):
-        used |= {s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))}
-        used |= {
-            s.via_index
-            for s in L.collect(p, lambda x: isinstance(x, L.FileScan))
-            if s.via_index
-        }
-    return sorted(used)
+    return used_index_names(plan)
 
 
 def _bucket_summary(plan: L.LogicalPlan) -> List[str]:

@@ -25,19 +25,12 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
         missing = index_name not in {e.name for e in indexes}
         if missing:
             return f"Index {index_name!r} does not exist or is not ACTIVE."
-    from hyperspace_tpu.rules.apply import plans_including_subqueries
+    from hyperspace_tpu.rules.apply import plans_including_subqueries, used_index_names
 
     plan = df.plan
     new_plan = applier.apply(plan)
-    applied = set()
+    applied = set(used_index_names(new_plan))
     scans = []
-    for p in plans_including_subqueries(new_plan):
-        applied |= {s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))}
-        applied |= {
-            s.via_index
-            for s in L.collect(p, lambda x: isinstance(x, L.FileScan))
-            if s.via_index
-        }
     for p in plans_including_subqueries(plan):
         scans.extend(L.collect(p, lambda x: isinstance(x, L.Scan)))
     # unique scans by plan key; disambiguate label collisions across distinct

@@ -878,13 +878,25 @@ def _expand_join_pairs(
     if total == 0:
         return out
 
-    # pass 2: gather into the preallocated columns
+    # pass 2: gather into the preallocated columns. Pair expansion runs in
+    # the native kernel (one C walk filling both index arrays); numpy index
+    # arithmetic is the fallback when the toolchain is absent.
+    from hyperspace_tpu import native
+
+    def expand(lo_b, counts, chunk_total):
+        try:
+            # int64 hi: expand_pairs itself guards the int32 range and
+            # rejects oversize buckets back to the numpy path
+            return native.expand_pairs(lo_b, np.asarray(lo_b, dtype=np.int64) + counts, chunk_total)
+        except native.NativeUnsupported:
+            ll = counts.shape[0]
+            lidx = np.repeat(np.arange(ll), counts)
+            offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+            ridx = np.arange(chunk_total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
+            return lidx, ridx
+
     for b, lo_b, counts, off, chunk_total in chunks:
-        ll = counts.shape[0]
-        lidx = np.repeat(np.arange(ll), counts)
-        # right indices: for row i, lo[i] .. hi[i]-1
-        offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
-        ridx = np.arange(chunk_total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
+        lidx, ridx = expand(lo_b, counts, chunk_total)
         for name in out_cols:
             src, col, is_left = sources[name]
             arr = src[b][col]
@@ -953,9 +965,15 @@ def host_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
     for b, batch in rbuckets.items():
         rkeys_by_bucket[b] = _join_key_of(batch, rkey)
 
+    from hyperspace_tpu import native
+
     def span_of(b: int):
         lk = lkeys_by_bucket[b]
         rk = rkeys_by_bucket[b]
-        return np.searchsorted(rk, lk, side="left"), np.searchsorted(rk, lk, side="right")
+        try:
+            # single O(n+m) merge walk in C over the pre-sorted runs
+            return native.merge_spans(lk, rk)
+        except native.NativeUnsupported:
+            return np.searchsorted(rk, lk, side="left"), np.searchsorted(rk, lk, side="right")
 
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)

@@ -78,6 +78,24 @@ def _load() -> ctypes.CDLL:
         except OSError as e:
             _load_failed = f"cannot load libhs_native: {e}"
             raise NativeUnsupported(_load_failed)
+        try:
+            _wire_symbols(lib)
+        except AttributeError:
+            # stale prebuilt .so missing newer symbols: rebuild once, then
+            # give up via NativeUnsupported (callers fall back) rather than
+            # leaking AttributeError through every native call site
+            try:
+                _build()
+                lib = ctypes.CDLL(_SO_PATH)
+                _wire_symbols(lib)
+            except (NativeUnsupported, OSError, AttributeError) as e:
+                _load_failed = f"libhs_native is stale and rebuild failed: {e}"
+                raise NativeUnsupported(_load_failed)
+        _lib = lib
+        return lib
+
+
+def _wire_symbols(lib: ctypes.CDLL) -> None:
         lib.hsn_open.restype = ctypes.c_void_p
         lib.hsn_open.argtypes = [ctypes.c_char_p]
         lib.hsn_close.argtypes = [ctypes.c_void_p]
@@ -108,8 +126,23 @@ def _load() -> ctypes.CDLL:
             ctypes.c_void_p,
             ctypes.c_void_p,
         ]
-        _lib = lib
-        return lib
+        lib.hsn_merge_spans.restype = None
+        lib.hsn_merge_spans.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        lib.hsn_expand_pairs.restype = ctypes.c_int64
+        lib.hsn_expand_pairs.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
 
 
 # parquet physical types
@@ -254,3 +287,56 @@ def is_available() -> bool:
         return True
     except NativeUnsupported:
         return False
+
+
+def merge_spans(left_keys: np.ndarray, right_keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Per left row, the [lo, hi) span of equal keys in ``right_keys``.
+
+    Both arrays must be ascending int64 (the index dialect's per-bucket
+    sortedness). One O(n+m) merge walk in C, replacing two binary-search
+    passes. Raises NativeUnsupported when the library is unavailable or a
+    side exceeds int32 indexing."""
+    lib = _load()
+    lk = np.ascontiguousarray(left_keys, dtype=np.int64)
+    rk = np.ascontiguousarray(right_keys, dtype=np.int64)
+    if rk.shape[0] >= 2**31 or lk.shape[0] >= 2**31:
+        raise NativeUnsupported("bucket exceeds int32 indexing")
+    lo = np.empty(lk.shape[0], dtype=np.int32)
+    hi = np.empty(lk.shape[0], dtype=np.int32)
+    lib.hsn_merge_spans(
+        lk.ctypes.data_as(ctypes.c_void_p),
+        lk.shape[0],
+        rk.ctypes.data_as(ctypes.c_void_p),
+        rk.shape[0],
+        lo.ctypes.data_as(ctypes.c_void_p),
+        hi.ctypes.data_as(ctypes.c_void_p),
+    )
+    return lo, hi
+
+
+def expand_pairs(lo: np.ndarray, hi: np.ndarray, total: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Expand per-left-row spans into (left, right) gather index arrays of
+    length ``total`` (= sum(hi - lo)). Raises NativeUnsupported past int32
+    range (callers fall back to the int64 numpy expansion)."""
+    lib = _load()
+    n = int(np.shape(lo)[0])
+    if (
+        n >= 2**31
+        or total >= 2**31
+        or (n and int(np.max(hi)) >= 2**31)
+    ):
+        raise NativeUnsupported("join bucket exceeds int32 indexing")
+    lo32 = np.ascontiguousarray(lo, dtype=np.int32)
+    hi32 = np.ascontiguousarray(hi, dtype=np.int32)
+    lidx = np.empty(total, dtype=np.int32)
+    ridx = np.empty(total, dtype=np.int32)
+    written = lib.hsn_expand_pairs(
+        lo32.ctypes.data_as(ctypes.c_void_p),
+        hi32.ctypes.data_as(ctypes.c_void_p),
+        lo32.shape[0],
+        lidx.ctypes.data_as(ctypes.c_void_p),
+        ridx.ctypes.data_as(ctypes.c_void_p),
+    )
+    if written != total:
+        raise NativeUnsupported(f"expand_pairs wrote {written} of {total} pairs")
+    return lidx, ridx

@@ -40,6 +40,22 @@ def plans_including_subqueries(plan: L.LogicalPlan) -> List[L.LogicalPlan]:
     return [plan, *iter_subquery_plans(plan)]
 
 
+def used_index_names(plan: L.LogicalPlan) -> List[str]:
+    """Names of every index an (optimized) plan uses: covering-index scans
+    plus data-skipping rewrites (FileScans tagged via_index), across the main
+    plan and all subquery plans. Shared by telemetry, explain, and whyNot so
+    the three reports can never disagree."""
+    used = set()
+    for p in plans_including_subqueries(plan):
+        used |= {s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))}
+        used |= {
+            s.via_index
+            for s in L.collect(p, lambda x: isinstance(x, L.FileScan))
+            if s.via_index
+        }
+    return sorted(used)
+
+
 def _collect_subqueries(e: Expr) -> List[SubqueryExpr]:
     out: List[SubqueryExpr] = []
     if isinstance(e, SubqueryExpr):
@@ -66,13 +82,10 @@ class ApplyHyperspace:
         new_plan, score = self._rewrite(plan)
         if score == 0:
             return plan, 0
-        used = set()
-        for p in plans_including_subqueries(new_plan):
-            used.update(
-                s.entry.name for s in L.collect(p, lambda x: isinstance(x, L.IndexScan))
-            )
         get_event_logger(self.session).log_event(
-            HyperspaceIndexUsageEvent(index_names=sorted(used), plan_summary=new_plan.describe())
+            HyperspaceIndexUsageEvent(
+                index_names=used_index_names(new_plan), plan_summary=new_plan.describe()
+            )
         )
         return new_plan, score
 

@@ -751,4 +751,48 @@ int64_t hsn_read_binary(void* hp, int32_t col, int64_t* offsets, uint8_t* data,
   return row;
 }
 
+// ---------------------------------------------------------------------------
+// Sorted-merge join kernels (host side of the shuffle-free bucketed SMJ).
+// Both key arrays must be ascending (the index dialect guarantees per-bucket
+// sortedness). One O(n+m) walk replaces two O(n log m) binary-search passes,
+// and pair expansion fills the gather indices without intermediate arrays.
+// ---------------------------------------------------------------------------
+
+// Per left row, the [lo, hi) span of equal keys on the right.
+void hsn_merge_spans(const int64_t* lk, int64_t n, const int64_t* rk, int64_t m,
+                     int32_t* lo, int32_t* hi) {
+  int64_t r = 0;
+  int64_t i = 0;
+  while (i < n) {
+    const int64_t key = lk[i];
+    while (r < m && rk[r] < key) r++;
+    int64_t r2 = r;
+    while (r2 < m && rk[r2] == key) r2++;
+    int64_t i2 = i;
+    while (i2 < n && lk[i2] == key) i2++;
+    for (int64_t j = i; j < i2; j++) {
+      lo[j] = static_cast<int32_t>(r);
+      hi[j] = static_cast<int32_t>(r2);
+    }
+    i = i2;
+    r = r2;
+  }
+}
+
+// Expand spans into (left row, right row) gather indices. `lidx`/`ridx` must
+// hold sum(hi-lo) elements; returns the number written.
+int64_t hsn_expand_pairs(const int32_t* lo, const int32_t* hi, int64_t n,
+                         int32_t* lidx, int32_t* ridx) {
+  int64_t off = 0;
+  for (int64_t i = 0; i < n; i++) {
+    const int32_t a = lo[i], b = hi[i];
+    for (int32_t r = a; r < b; r++) {
+      lidx[off] = static_cast<int32_t>(i);
+      ridx[off] = r;
+      off++;
+    }
+  }
+  return off;
+}
+
 }  // extern "C"

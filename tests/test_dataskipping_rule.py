@@ -433,3 +433,44 @@ def test_why_not_reports_applied_dataskipping_index(session, tmp_path):
     report = hs.why_not(q)
     line = [l for l in report.splitlines() if l.startswith("Applied indexes:")][0]
     assert "dsWhy" in line, report
+
+
+def test_usage_event_reports_applied_dataskipping_index(tmp_path):
+    """Telemetry must agree with explain/whyNot: a data-skipping rewrite
+    (FileScan via_index, no IndexScan node) counts as index usage."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.telemetry.events import CollectingEventLogger
+
+    root = tmp_path / "dsdata2"
+    root.mkdir()
+    for i in range(3):
+        pq.write_table(
+            pa.table({"v": np.arange(i * 100, i * 100 + 100, dtype=np.int64)}),
+            root / f"p{i}.parquet",
+        )
+    sysp = tmp_path / "sys"
+    sysp.mkdir()
+    sess = hst.Session(
+        conf={
+            hst.keys.SYSTEM_PATH: str(sysp),
+            hst.keys.EVENT_LOGGER_CLASS: "hyperspace_tpu.telemetry.events.CollectingEventLogger",
+        }
+    )
+    hst.set_session(sess)
+    try:
+        hs = hst.Hyperspace(sess)
+        df = sess.read_parquet(str(root))
+        hs.create_index(df, hst.DataSkippingIndexConfig("dsEvt", hst.MinMaxSketch("v")))
+        session_logger = hst.telemetry.events.get_event_logger(sess)
+        assert isinstance(session_logger, CollectingEventLogger)
+        session_logger.events.clear()
+        sess.enable_hyperspace()
+        df.filter(hst.col("v") == 42).collect()
+        usage = [e for e in session_logger.events if type(e).__name__ == "HyperspaceIndexUsageEvent"]
+        assert usage and "dsEvt" in usage[-1].index_names, [e.__dict__ for e in usage]
+    finally:
+        hst.set_session(None)

@@ -187,3 +187,32 @@ def test_projected_cached_reads_concat(tmp_path):
     got = read_parquet_batch([fa, fb], ["a"])  # fully-cached fast path
     assert got["a"].tolist() == [1, 2, 3]
     clear_io_cache()
+
+
+def test_merge_spans_matches_searchsorted():
+    rng = np.random.default_rng(11)
+    lk = np.sort(rng.integers(0, 500, 2000)).astype(np.int64)
+    rk = np.sort(rng.integers(0, 500, 3000)).astype(np.int64)
+    lo, hi = native.merge_spans(lk, rk)
+    np.testing.assert_array_equal(lo, np.searchsorted(rk, lk, side="left"))
+    np.testing.assert_array_equal(hi, np.searchsorted(rk, lk, side="right"))
+    # no-match and empty-side edges
+    lo, hi = native.merge_spans(np.array([1, 5], dtype=np.int64), np.array([2, 3], dtype=np.int64))
+    assert (hi - lo).tolist() == [0, 0]
+    lo, hi = native.merge_spans(np.array([], dtype=np.int64), rk)
+    assert lo.shape == (0,)
+
+
+def test_expand_pairs_matches_numpy():
+    rng = np.random.default_rng(12)
+    n = 500
+    lo = rng.integers(0, 50, n).astype(np.int32)
+    counts = rng.integers(0, 5, n).astype(np.int64)
+    hi = (lo + counts).astype(np.int32)
+    total = int(counts.sum())
+    lidx, ridx = native.expand_pairs(lo, hi, total)
+    exp_l = np.repeat(np.arange(n), counts)
+    offsets = np.concatenate([[0], np.cumsum(counts)[:-1]])
+    exp_r = np.arange(total) - np.repeat(offsets, counts) + np.repeat(lo, counts)
+    np.testing.assert_array_equal(lidx, exp_l)
+    np.testing.assert_array_equal(ridx, exp_r)
