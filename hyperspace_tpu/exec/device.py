@@ -628,23 +628,33 @@ def _strip_projects(plan: L.LogicalPlan) -> Tuple[L.LogicalPlan, Optional[List[s
     return plan, cols
 
 
-def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.IndexScan, L.IndexScan, List[str], List[str]]]:
-    """If both join children are (projected) IndexScans bucketed on exactly the
-    join keys with equal bucket counts, return (left_scan, right_scan, lkeys,
-    rkeys); else None (ref: JoinIndexRanker's equal-bucket preference,
-    HS/index/covering/JoinIndexRanker.scala:52-92)."""
+def _side_bucket_spec(node: L.LogicalPlan) -> Optional[L.BucketSpec]:
+    """The bucket layout a join side arrives in, looking through the
+    layout-preserving wrappers (Project/Filter). Covers plain IndexScans AND
+    hybrid-scan sides (BucketUnion of index minus deletes + re-bucketed
+    appends — ref: CoveringIndexRuleUtils.scala:146-288)."""
+    spec = getattr(node, "bucket_spec", None)
+    if spec is not None:
+        return spec
+    if isinstance(node, (L.Project, L.Filter)):
+        return _side_bucket_spec(node.child)
+    return None
+
+
+def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.LogicalPlan, L.LogicalPlan, List[str], List[str]]]:
+    """If both join children arrive bucketed on exactly the join keys with
+    equal bucket counts — index scans or hybrid-scan BucketUnions — return
+    (left_side, right_side, lkeys, rkeys); else None (ref: JoinIndexRanker's
+    equal-bucket preference, HS/index/covering/JoinIndexRanker.scala:52-92)."""
     pairs = extract_equi_join_keys(plan.condition)
     if not pairs:
         return None
-    lchild, _ = _strip_projects(plan.left)
-    rchild, _ = _strip_projects(plan.right)
-    if not isinstance(lchild, L.IndexScan) or not isinstance(rchild, L.IndexScan):
-        return None
-    lspec, rspec = lchild.bucket_spec, rchild.bucket_spec
+    lspec = _side_bucket_spec(plan.left)
+    rspec = _side_bucket_spec(plan.right)
     if lspec is None or rspec is None or lspec.num_buckets != rspec.num_buckets:
         return None
-    lcols = set(lchild.columns)
-    rcols = set(rchild.columns)
+    lcols = set(plan.left.output_columns)
+    rcols = set(plan.right.output_columns)
     lkeys, rkeys = [], []
     for a, b in pairs:
         if a in lcols and b in rcols:
@@ -662,7 +672,7 @@ def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.IndexScan, L.IndexSc
 
     if norm(lspec.bucket_columns) != norm(lkeys) or norm(rspec.bucket_columns) != norm(rkeys):
         return None
-    return lchild, rchild, lkeys, rkeys
+    return plan.left, plan.right, lkeys, rkeys
 
 
 def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str] = None) -> Dict[int, B.Batch]:
@@ -694,11 +704,80 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str]
         if rename:
             batch = {o: batch[fc] for o, fc in zip(columns, file_cols)}
         if sort_key is not None and len(files) > 1:
-            k = batch[sort_key]
-            if k.size > 1 and np.any(k[1:] < k[:-1]):
-                batch = B.take(batch, np.argsort(k, kind="stable"))
+            batch = _sort_bucket(batch, sort_key)
         out[b] = batch
     return out
+
+
+def _sort_bucket(batch: B.Batch, sort_key: str) -> B.Batch:
+    k = batch[sort_key]
+    if k.size > 1 and np.any(k[1:] < k[:-1]):
+        return B.take(batch, np.argsort(k, kind="stable"))
+    return batch
+
+
+def _side_buckets(
+    session, node: L.LogicalPlan, columns: List[str], sort_key: str
+) -> Dict[int, B.Batch]:
+    """Per-bucket batches of one join side, each sorted on ``sort_key``.
+
+    Handles the full hybrid-scan shape: IndexScan leaves, lineage NOT-IN
+    Filters (evaluated per bucket — layout preserving), Repartition of
+    appended files (host re-bucketing with the SAME hash as the index build,
+    so rows land in their index bucket), and BucketUnion (per-bucket concat
+    of sorted runs, re-sorted once)."""
+    node, _proj = _strip_projects(node)
+    if isinstance(node, L.IndexScan):
+        return _read_buckets(node, columns, sort_key=sort_key)
+    if isinstance(node, L.Filter):
+        refs = [c for c in node.condition.references()]
+        inner_cols = list(dict.fromkeys(list(columns) + refs))
+        from hyperspace_tpu.plan.expr import as_bool_mask, contains_input_file_name
+
+        if contains_input_file_name(node.condition):
+            raise DeviceUnsupported("input_file_name() predicate on a join side")
+        buckets = _side_buckets(session, node.child, inner_cols, sort_key)
+        out: Dict[int, B.Batch] = {}
+        for b, batch in buckets.items():
+            mask = as_bool_mask(node.condition.eval(batch))
+            kept = B.mask_rows(batch, mask)  # order-preserving: stays sorted
+            out[b] = {c: kept[c] for c in columns}
+        return out
+    if isinstance(node, L.Repartition):
+        from hyperspace_tpu.exec.executor import Executor
+        from hyperspace_tpu.ops.encode import hash_input_uint32
+        from hyperspace_tpu.ops.hashing import bucket_ids_np
+
+        spec = node.bucket_spec
+        batch = Executor(session).execute(node.child, required_columns=list(columns))
+        try:
+            key_cols = [batch[c] for c in spec.bucket_columns]
+        except KeyError as e:
+            raise DeviceUnsupported(f"bucket column missing from appended side: {e}")
+        nb = spec.num_buckets
+        ids = bucket_ids_np([hash_input_uint32(c) for c in key_cols], nb)
+        order = np.argsort(ids, kind="stable")
+        sorted_ids = ids[order]
+        bounds = np.searchsorted(sorted_ids, np.arange(nb + 1))
+        out = {}
+        for b in range(nb):
+            lo, hi = int(bounds[b]), int(bounds[b + 1])
+            if hi > lo:
+                idx = order[lo:hi]
+                out[b] = _sort_bucket({c: batch[c][idx] for c in columns}, sort_key)
+        return out
+    if isinstance(node, L.BucketUnion):
+        parts = [_side_buckets(session, c, columns, sort_key) for c in node.children()]
+        keys = set()
+        for p in parts:
+            keys |= set(p)
+        out = {}
+        for b in keys:
+            batches = [p[b] for p in parts if b in p]
+            merged = batches[0] if len(batches) == 1 else B.concat(batches)
+            out[b] = _sort_bucket(merged, sort_key) if len(batches) > 1 else merged
+        return out
+    raise DeviceUnsupported(f"join side {type(node).__name__} is not a bucketed shape")
 
 
 @lru_cache(maxsize=32)
@@ -753,6 +832,13 @@ def _file_num_rows(path: str) -> int:
     return got
 
 
+def _side_files(node: L.LogicalPlan) -> List[str]:
+    files: List[str] = []
+    for p in L.collect(node, lambda x: isinstance(x, (L.IndexScan, L.FileScan))):
+        files.extend(p.files)
+    return files
+
+
 def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     """Single entry point for the bucketed-SMJ paths: one compatibility
     analysis, then device or host spans by the input-rows threshold.
@@ -762,8 +848,8 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
     total = 0
-    for scan in (compat[0], compat[1]):
-        for f in scan.files:
+    for side in (compat[0], compat[1]):
+        for f in _side_files(side):
             try:
                 total += _file_num_rows(f)
             except OSError:
@@ -774,7 +860,7 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     return host_bucketed_join(session, plan, _compat=compat)
 
 
-def _bucketed_join_setup(plan: L.Join, compat=None):
+def _bucketed_join_setup(session, plan: L.Join, compat=None):
     """Shared validation + per-bucket decode for the bucketed SMJ paths.
 
     Returns (lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed).
@@ -783,7 +869,7 @@ def _bucketed_join_setup(plan: L.Join, compat=None):
         compat = join_sides_compatible(plan)
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
-    lscan, rscan, lkeys, rkeys = compat
+    lside, rside, lkeys, rkeys = compat
     if len(lkeys) != 1:
         raise DeviceUnsupported("device join supports single-key equi-joins (multi-key -> host)")
     lkey, rkey = lkeys[0], rkeys[0]
@@ -791,24 +877,33 @@ def _bucketed_join_setup(plan: L.Join, compat=None):
         raise DeviceUnsupported("device join handles inner joins (outer -> host)")
 
     # key dtype check from parquet metadata BEFORE any data is decoded — an
-    # unsupported key must not cost a full read on both sides
+    # unsupported key must not cost a full read on both sides. Hybrid sides
+    # check their underlying IndexScan leaf; sides with no index leaf fall to
+    # the per-batch dtype check in _join_key_of.
     import pyarrow as pa
     import pyarrow.parquet as pq
 
-    for scan, key in ((lscan, lkey), (rscan, rkey)):
-        if not scan.files:
-            raise DeviceUnsupported("empty index scan")
-        field = pq.read_schema(scan.files[0]).field(scan.file_column_of(key))
-        if not (pa.types.is_integer(field.type) or pa.types.is_temporal(field.type) or pa.types.is_boolean(field.type)):
-            raise DeviceUnsupported(f"device join requires integer/datetime keys; got {field.type}")
+    for side, key in ((lside, lkey), (rside, rkey)):
+        scans = L.collect(side, lambda x: isinstance(x, L.IndexScan))
+        scan = scans[0] if scans else None
+        if scan is not None and scan.files and key in scan.columns:
+            field = pq.read_schema(scan.files[0]).field(scan.file_column_of(key))
+            if not (
+                pa.types.is_integer(field.type)
+                or pa.types.is_temporal(field.type)
+                or pa.types.is_boolean(field.type)
+            ):
+                raise DeviceUnsupported(
+                    f"device join requires integer/datetime keys; got {field.type}"
+                )
 
     # decode only the columns the join output (plus keys) needs
     needed = set(plan.output_columns) | {n[:-2] for n in plan.output_columns if n.endswith("#r")}
-    lcols_needed = [c for c in lscan.columns if c in needed or c == lkey]
-    rcols_needed = [c for c in rscan.columns if c in needed or c == rkey]
-    lbuckets = _read_buckets(lscan, lcols_needed, sort_key=lkey)
-    rbuckets = _read_buckets(rscan, rcols_needed, sort_key=rkey)
-    nb = lscan.bucket_spec.num_buckets
+    lcols_needed = [c for c in lside.output_columns if c in needed or c == lkey]
+    rcols_needed = [c for c in rside.output_columns if c in needed or c == rkey]
+    lbuckets = _side_buckets(session, lside, lcols_needed, lkey)
+    rbuckets = _side_buckets(session, rside, rcols_needed, rkey)
+    nb = _side_bucket_spec(lside).num_buckets
     return lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed
 
 
@@ -917,7 +1012,7 @@ def device_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(plan, _compat)
+    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(session, plan, _compat)
 
     SENTINEL = np.int64(2**62)
     mesh = session.mesh
@@ -956,7 +1051,7 @@ def host_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
     (per-bucket ``np.searchsorted`` over the pre-sorted runs). Used below the
     device-dispatch row threshold, where a host<->device round trip would cost
     more than the span computation itself."""
-    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(plan, _compat)
+    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(session, plan, _compat)
 
     lkeys_by_bucket: Dict[int, np.ndarray] = {}
     rkeys_by_bucket: Dict[int, np.ndarray] = {}
