@@ -675,12 +675,12 @@ def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.LogicalPlan, L.Logic
     return plan.left, plan.right, lkeys, rkeys
 
 
-def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str] = None) -> Dict[int, B.Batch]:
+def _read_buckets(scan: L.IndexScan, columns: List[str], sort_keys: Optional[List[str]] = None) -> Dict[int, B.Batch]:
     """Read an IndexScan's files grouped per bucket id (file name carries the
     bucket; ref layout: part-<bucket>.parquet, indexes/covering.py).
 
-    Only ``columns`` are decoded. When ``sort_key`` is given, each bucket is
-    re-sorted on it if needed: a bucket holding several files (incremental
+    Only ``columns`` are decoded. When ``sort_keys`` is given, each bucket is
+    re-sorted on them if needed: a bucket holding several files (incremental
     refresh merges delta files into existing buckets, UpdateMode.Merge —
     ref: actions/RefreshIncrementalAction.scala:115-128) is only piecewise
     sorted after concatenation."""
@@ -703,23 +703,67 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_key: Optional[str]
         batch = read_parquet_batch(files, file_cols)
         if rename:
             batch = {o: batch[fc] for o, fc in zip(columns, file_cols)}
-        if sort_key is not None and len(files) > 1:
-            batch = _sort_bucket(batch, sort_key)
+        if sort_keys and len(files) > 1:
+            batch = _sort_bucket(batch, sort_keys)
         out[b] = batch
     return out
 
 
-def _sort_bucket(batch: B.Batch, sort_key: str) -> B.Batch:
-    k = batch[sort_key]
-    if k.size > 1 and np.any(k[1:] < k[:-1]):
-        return B.take(batch, np.argsort(k, kind="stable"))
-    return batch
+def _order_key_array(arr: np.ndarray) -> np.ndarray:
+    """An int64/float view of ``arr`` with the same ordering, null-safe:
+    strings factorize to codes (null -> -1, before everything — the same
+    order _composite_ranks uses), datetimes view their epoch. Raw object
+    comparisons would TypeError on None."""
+    if arr.dtype.kind in ("U", "S", "O"):
+        from hyperspace_tpu.ops.encode import factorize_strings
+
+        codes, _, _ = factorize_strings(arr)
+        return codes.astype(np.int64)
+    if arr.dtype.kind == "M":
+        return arr.view("int64")
+    return arr
+
+
+def _sort_bucket(batch: B.Batch, sort_keys: List[str]) -> B.Batch:
+    cols = [_order_key_array(batch[k]) for k in sort_keys]
+    if not cols or cols[0].size <= 1:
+        return batch
+    if len(cols) == 1:
+        k = cols[0]
+        if np.any(k[1:] < k[:-1]):
+            return B.take(batch, np.argsort(k, kind="stable"))
+        return batch
+    return B.take(batch, np.lexsort(cols[::-1]))  # first key primary
+
+
+def _composite_ranks(
+    l_arrs: List[np.ndarray], r_arrs: List[np.ndarray]
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Order-preserving dense int64 ranks of the composite key tuples, shared
+    across both sides: equal tuples (across sides) get equal ranks, and rank
+    order is the lexicographic tuple order. Lets multi-column and string join
+    keys reuse the single-int64 span machinery (native merge walk /
+    searchsorted) unchanged."""
+    n = l_arrs[0].shape[0]
+    # order-preserving int codes for strings: python-string comparisons
+    # inside lexsort dominate otherwise
+    cols = [_order_key_array(np.concatenate([la, ra])) for la, ra in zip(l_arrs, r_arrs)]
+    order = np.lexsort(cols[::-1])
+    change = np.zeros(order.shape[0], dtype=bool)
+    for c in cols:
+        cs = c[order]
+        if cs.shape[0] > 1:
+            change[1:] |= cs[1:] != cs[:-1]
+    ranks_sorted = np.cumsum(change.astype(np.int64))
+    ranks = np.empty(order.shape[0], dtype=np.int64)
+    ranks[order] = ranks_sorted
+    return ranks[:n], ranks[n:]
 
 
 def _side_buckets(
-    session, node: L.LogicalPlan, columns: List[str], sort_key: str
+    session, node: L.LogicalPlan, columns: List[str], sort_keys: List[str]
 ) -> Dict[int, B.Batch]:
-    """Per-bucket batches of one join side, each sorted on ``sort_key``.
+    """Per-bucket batches of one join side, each sorted on ``sort_keys``.
 
     Handles the full hybrid-scan shape: IndexScan leaves, lineage NOT-IN
     Filters (evaluated per bucket — layout preserving), Repartition of
@@ -728,7 +772,7 @@ def _side_buckets(
     of sorted runs, re-sorted once)."""
     node, _proj = _strip_projects(node)
     if isinstance(node, L.IndexScan):
-        return _read_buckets(node, columns, sort_key=sort_key)
+        return _read_buckets(node, columns, sort_keys=sort_keys)
     if isinstance(node, L.Filter):
         refs = [c for c in node.condition.references()]
         inner_cols = list(dict.fromkeys(list(columns) + refs))
@@ -736,7 +780,7 @@ def _side_buckets(
 
         if contains_input_file_name(node.condition):
             raise DeviceUnsupported("input_file_name() predicate on a join side")
-        buckets = _side_buckets(session, node.child, inner_cols, sort_key)
+        buckets = _side_buckets(session, node.child, inner_cols, sort_keys)
         out: Dict[int, B.Batch] = {}
         for b, batch in buckets.items():
             mask = as_bool_mask(node.condition.eval(batch))
@@ -764,10 +808,10 @@ def _side_buckets(
             lo, hi = int(bounds[b]), int(bounds[b + 1])
             if hi > lo:
                 idx = order[lo:hi]
-                out[b] = _sort_bucket({c: batch[c][idx] for c in columns}, sort_key)
+                out[b] = _sort_bucket({c: batch[c][idx] for c in columns}, sort_keys)
         return out
     if isinstance(node, L.BucketUnion):
-        parts = [_side_buckets(session, c, columns, sort_key) for c in node.children()]
+        parts = [_side_buckets(session, c, columns, sort_keys) for c in node.children()]
         keys = set()
         for p in parts:
             keys |= set(p)
@@ -775,7 +819,7 @@ def _side_buckets(
         for b in keys:
             batches = [p[b] for p in parts if b in p]
             merged = batches[0] if len(batches) == 1 else B.concat(batches)
-            out[b] = _sort_bucket(merged, sort_key) if len(batches) > 1 else merged
+            out[b] = _sort_bucket(merged, sort_keys) if len(batches) > 1 else merged
         return out
     raise DeviceUnsupported(f"join side {type(node).__name__} is not a bucketed shape")
 
@@ -839,25 +883,85 @@ def _side_files(node: L.LogicalPlan) -> List[str]:
     return files
 
 
+# composite-key rank encodings keyed on both sides' full identity, byte-capped
+# like every other cache (exec/io.py's _io_cache pattern)
+from hyperspace_tpu.utils.lru import BytesLRU
+
+_RANK_CACHE = BytesLRU(int(os.environ.get("HS_RANK_CACHE_BYTES", 1 << 29)))
+
+
+def _rank_cache_key(lside, rside, lkeys: List[str], rkeys: List[str]):
+    """Identity of a rank encoding: both sides' (file, mtime, size) sets, the
+    key names, AND the sides' plan text — ranks are computed over rows that
+    survive the sides' Filters (lineage NOT-IN, pushed predicates), so a
+    changed filter over identical files must miss. None (= don't cache) when
+    any file can't be stat'ed."""
+    parts = [tuple(lkeys), tuple(rkeys), lside.pretty(), rside.pretty()]
+    for side in (lside, rside):
+        files = []
+        for f in _side_files(side):
+            try:
+                st = os.stat(f)
+            except OSError:
+                return None
+            files.append((f, st.st_mtime_ns, st.st_size))
+        parts.append(tuple(files))
+    return tuple(parts)
+
+
+def _device_key_eligible(side: L.LogicalPlan, key: str) -> bool:
+    """Cheap (footer-only) check that a side's join key can ride the device
+    span program (int64-comparable). Sides without an index leaf carrying the
+    key are conservatively host-routed."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    scans = L.collect(side, lambda x: isinstance(x, L.IndexScan))
+    scan = scans[0] if scans else None
+    if scan is None or not scan.files or key not in scan.columns:
+        return False
+    try:
+        field = pq.read_schema(scan.files[0]).field(scan.file_column_of(key))
+    except (OSError, KeyError):
+        return False
+    return bool(
+        pa.types.is_integer(field.type)
+        or pa.types.is_temporal(field.type)
+        or pa.types.is_boolean(field.type)
+    )
+
+
 def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     """Single entry point for the bucketed-SMJ paths: one compatibility
-    analysis, then device or host spans by the input-rows threshold.
-    Raises DeviceUnsupported when the join isn't a compatible bucketed pair
-    (the executor then falls back to its generic merge join)."""
+    analysis, then device or host spans by the input-rows threshold (device
+    handles single int64-comparable keys; composite and string keys use the
+    host rank path). Raises DeviceUnsupported when the join isn't a
+    compatible bucketed pair (the executor then falls back to its generic
+    merge join)."""
     compat = join_sides_compatible(plan)
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
+    lside, rside, lkeys, rkeys = compat
     total = 0
-    for side in (compat[0], compat[1]):
+    for side in (lside, rside):
         for f in _side_files(side):
             try:
                 total += _file_num_rows(f)
             except OSError:
                 total = 0
                 break
-    if total >= session.conf.device_exec_min_rows:
-        return device_bucketed_join(session, plan, _compat=compat)
-    return host_bucketed_join(session, plan, _compat=compat)
+    setup = _bucketed_join_setup(session, plan, compat)
+    if (
+        total >= session.conf.device_exec_min_rows
+        and len(lkeys) == 1
+        and _device_key_eligible(lside, lkeys[0])
+        and _device_key_eligible(rside, rkeys[0])
+    ):
+        try:
+            return device_bucketed_join(session, plan, _compat=compat, _setup=setup)
+        except DeviceUnsupported:
+            pass  # e.g. a decoded batch outside the device language
+    return host_bucketed_join(session, plan, _compat=compat, _setup=setup)
 
 
 def _bucketed_join_setup(session, plan: L.Join, compat=None):
@@ -870,41 +974,17 @@ def _bucketed_join_setup(session, plan: L.Join, compat=None):
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
     lside, rside, lkeys, rkeys = compat
-    if len(lkeys) != 1:
-        raise DeviceUnsupported("device join supports single-key equi-joins (multi-key -> host)")
-    lkey, rkey = lkeys[0], rkeys[0]
     if plan.how != "inner":
         raise DeviceUnsupported("device join handles inner joins (outer -> host)")
 
-    # key dtype check from parquet metadata BEFORE any data is decoded — an
-    # unsupported key must not cost a full read on both sides. Hybrid sides
-    # check their underlying IndexScan leaf; sides with no index leaf fall to
-    # the per-batch dtype check in _join_key_of.
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    for side, key in ((lside, lkey), (rside, rkey)):
-        scans = L.collect(side, lambda x: isinstance(x, L.IndexScan))
-        scan = scans[0] if scans else None
-        if scan is not None and scan.files and key in scan.columns:
-            field = pq.read_schema(scan.files[0]).field(scan.file_column_of(key))
-            if not (
-                pa.types.is_integer(field.type)
-                or pa.types.is_temporal(field.type)
-                or pa.types.is_boolean(field.type)
-            ):
-                raise DeviceUnsupported(
-                    f"device join requires integer/datetime keys; got {field.type}"
-                )
-
     # decode only the columns the join output (plus keys) needs
     needed = set(plan.output_columns) | {n[:-2] for n in plan.output_columns if n.endswith("#r")}
-    lcols_needed = [c for c in lside.output_columns if c in needed or c == lkey]
-    rcols_needed = [c for c in rside.output_columns if c in needed or c == rkey]
-    lbuckets = _side_buckets(session, lside, lcols_needed, lkey)
-    rbuckets = _side_buckets(session, rside, rcols_needed, rkey)
+    lcols_needed = [c for c in lside.output_columns if c in needed or c in lkeys]
+    rcols_needed = [c for c in rside.output_columns if c in needed or c in rkeys]
+    lbuckets = _side_buckets(session, lside, lcols_needed, lkeys)
+    rbuckets = _side_buckets(session, rside, rcols_needed, rkeys)
     nb = _side_bucket_spec(lside).num_buckets
-    return lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed
+    return lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed
 
 
 def _expand_join_pairs(
@@ -999,7 +1079,7 @@ def _expand_join_pairs(
     return out
 
 
-def device_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
+def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Batch:
     """Execute a compatible bucketed equi-join on device.
 
     Per-bucket sorted runs of both sides are padded to rectangles, sharded over
@@ -1012,7 +1092,12 @@ def device_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(session, plan, _compat)
+    lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = (
+        _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
+    )
+    if len(lkeys) != 1:
+        raise DeviceUnsupported("device span program is single-key; composite keys -> host")
+    lkey, rkey = lkeys[0], rkeys[0]
 
     SENTINEL = np.int64(2**62)
     mesh = session.mesh
@@ -1046,19 +1131,50 @@ def device_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
 
 
-def host_bucketed_join(session, plan: L.Join, _compat=None) -> B.Batch:
-    """The same shuffle-free bucketed SMJ with spans computed host-side
-    (per-bucket ``np.searchsorted`` over the pre-sorted runs). Used below the
-    device-dispatch row threshold, where a host<->device round trip would cost
-    more than the span computation itself."""
-    lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed = _bucketed_join_setup(session, plan, _compat)
+def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Batch:
+    """The same shuffle-free bucketed SMJ with spans computed host-side over
+    the pre-sorted runs. Single int64-comparable keys feed the native merge
+    walk directly; composite and string keys are first encoded per bucket
+    into shared dense int64 ranks (order-preserving across both sides), then
+    use the identical span machinery. Used below the device-dispatch row
+    threshold and for every key shape the device program doesn't cover."""
+    lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = (
+        _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
+    )
 
+    single_int = len(lkeys) == 1
     lkeys_by_bucket: Dict[int, np.ndarray] = {}
     rkeys_by_bucket: Dict[int, np.ndarray] = {}
-    for b, batch in lbuckets.items():
-        lkeys_by_bucket[b] = _join_key_of(batch, lkey)
-    for b, batch in rbuckets.items():
-        rkeys_by_bucket[b] = _join_key_of(batch, rkey)
+    if single_int:
+        try:
+            for b, batch in lbuckets.items():
+                lkeys_by_bucket[b] = _join_key_of(batch, lkeys[0])
+            for b, batch in rbuckets.items():
+                rkeys_by_bucket[b] = _join_key_of(batch, rkeys[0])
+        except DeviceUnsupported:
+            single_int = False
+    if not single_int:
+        # rank-encode composite/string keys per bucket (both sides together,
+        # so equal tuples share a rank). The encoding depends only on the
+        # sides' immutable files + key names, so it is cached across queries
+        # (string factorization dominated repeated composite joins otherwise).
+        lside, rside = (_compat or join_sides_compatible(plan))[:2]
+        cache_key = _rank_cache_key(lside, rside, lkeys, rkeys)
+        cached = _RANK_CACHE.get(cache_key) if cache_key is not None else None
+        if cached is not None:
+            lkeys_by_bucket, rkeys_by_bucket = cached
+        else:
+            lkeys_by_bucket.clear()
+            rkeys_by_bucket.clear()
+            for b in set(lbuckets) & set(rbuckets):
+                lr, rr = _composite_ranks(
+                    [lbuckets[b][k] for k in lkeys], [rbuckets[b][k] for k in rkeys]
+                )
+                lkeys_by_bucket[b] = lr
+                rkeys_by_bucket[b] = rr
+            if cache_key is not None:
+                nbytes = sum(a.nbytes for d in (lkeys_by_bucket, rkeys_by_bucket) for a in d.values())
+                _RANK_CACHE.put(cache_key, (lkeys_by_bucket, rkeys_by_bucket), nbytes)
 
     from hyperspace_tpu import native
 
