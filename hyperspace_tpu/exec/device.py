@@ -974,8 +974,8 @@ def _bucketed_join_setup(session, plan: L.Join, compat=None):
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
     lside, rside, lkeys, rkeys = compat
-    if plan.how != "inner":
-        raise DeviceUnsupported("device join handles inner joins (outer -> host)")
+    if plan.how not in ("inner", "left", "right", "outer"):
+        raise DeviceUnsupported(f"unsupported join type {plan.how!r}")
 
     # decode only the columns the join output (plus keys) needs
     needed = set(plan.output_columns) | {n[:-2] for n in plan.output_columns if n.endswith("#r")}
@@ -1002,7 +1002,12 @@ def _expand_join_pairs(
 
     Two passes: spans/counts first, then gathers straight into preallocated
     output columns (a concat of per-bucket batches would copy the whole
-    result a second time)."""
+    result a second time). Outer joins (left/right/outer) emit unmatched rows
+    with the opposite side's columns null (index -1 in the gather arrays;
+    ints promote to float64 NaN, matching the pandas-merge fallback)."""
+    how = plan.how
+    keep_left = how in ("left", "outer")
+    keep_right = how in ("right", "outer")
     out_cols = plan.output_columns
     lout = list(lcols_needed)
     rout = list(rcols_needed)
@@ -1017,48 +1022,10 @@ def _expand_join_pairs(
             return rbuckets, name, False
         raise DeviceUnsupported(f"join output column {name!r} not found on either side")
 
-    # pass 1: spans + counts
-    chunks = []  # (bucket, lo, counts, out_offset, chunk_total)
-    total = 0
-    for b in range(nb):
-        if b not in lbuckets or b not in rbuckets:
-            continue
-        ll = B.num_rows(lbuckets[b])
-        if ll == 0 or B.num_rows(rbuckets[b]) == 0:
-            continue
-        lo_b, hi_b = span_of(b)
-        counts = (hi_b - lo_b).astype(np.int64)
-        chunk_total = int(counts.sum())
-        if chunk_total == 0:
-            continue
-        chunks.append((b, lo_b, counts, total, chunk_total))
-        total += chunk_total
-
-    sources = {name: column_source(name) for name in out_cols}
-    participating = [c[0] for c in chunks]
-
-    def out_dtype(name: str) -> np.dtype:
-        src, col, _ = sources[name]
-        # promote across participating buckets (a nullable int column decodes
-        # as float64 only in buckets whose files hold nulls), matching what
-        # np.concatenate of per-bucket results used to do
-        dtypes = [src[b][col].dtype for b in (participating or src) if col in src.get(b, {})]
-        if not dtypes:
-            raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
-        if any(dt == object for dt in dtypes):
-            return np.dtype(object)
-        return np.result_type(*dtypes)
-
-    out = {name: np.empty(total, dtype=out_dtype(name)) for name in out_cols}
-    if total == 0:
-        return out
-
-    # pass 2: gather into the preallocated columns. Pair expansion runs in
-    # the native kernel (one C walk filling both index arrays); numpy index
-    # arithmetic is the fallback when the toolchain is absent.
+    # pass 1: per-bucket gather index arrays; -1 marks a null (unmatched) row
     from hyperspace_tpu import native
 
-    def expand(lo_b, counts, chunk_total):
+    def expand_inner(lo_b, counts, chunk_total):
         try:
             # int64 hi: expand_pairs itself guards the int32 range and
             # rejects oversize buckets back to the numpy path
@@ -1070,12 +1037,111 @@ def _expand_join_pairs(
             ridx = np.arange(chunk_total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
             return lidx, ridx
 
-    for b, lo_b, counts, off, chunk_total in chunks:
-        lidx, ridx = expand(lo_b, counts, chunk_total)
+    pieces = []  # (bucket, lidx, ridx)
+    total = 0
+    has_null_left = has_null_right = False
+    for b in range(nb):
+        lb = lbuckets.get(b)
+        rb = rbuckets.get(b)
+        ll = B.num_rows(lb) if lb is not None else 0
+        rr = B.num_rows(rb) if rb is not None else 0
+        if ll and rr:
+            lo_b, hi_b = span_of(b)
+            counts = (hi_b - lo_b).astype(np.int64)
+            if keep_left:
+                # unmatched left rows expand as one (i, lo[i]) pair via the
+                # same native kernel, then get their right index nulled
+                counts_eff = np.maximum(counts, 1)
+                ct = int(counts_eff.sum())
+                lidx, ridx = expand_inner(np.asarray(lo_b), counts_eff, ct)
+                null_rows = np.repeat(counts == 0, counts_eff)
+                if null_rows.any():
+                    ridx = np.asarray(ridx, dtype=np.int64)
+                    ridx[null_rows] = -1
+                    has_null_right = True
+                pieces.append((b, lidx, ridx))
+                total += ct
+            else:
+                ct = int(counts.sum())
+                if ct:
+                    lidx, ridx = expand_inner(np.asarray(lo_b), counts, ct)
+                    pieces.append((b, lidx, ridx))
+                    total += ct
+            if keep_right:
+                # right rows covered by no span are unmatched
+                cover = np.zeros(rr + 1, dtype=np.int64)
+                sel = counts > 0
+                np.add.at(cover, np.asarray(lo_b)[sel], 1)
+                np.add.at(cover, np.asarray(hi_b)[sel], -1)
+                unmatched = np.nonzero(np.cumsum(cover[:-1]) == 0)[0]
+                if unmatched.size:
+                    pieces.append((b, np.full(unmatched.size, -1, dtype=np.int64), unmatched))
+                    total += unmatched.size
+                    has_null_left = True
+        elif ll and keep_left:
+            pieces.append((b, np.arange(ll), np.full(ll, -1, dtype=np.int64)))
+            total += ll
+            has_null_right = True
+        elif rr and keep_right:
+            pieces.append((b, np.full(rr, -1, dtype=np.int64), np.arange(rr)))
+            total += rr
+            has_null_left = True
+
+    sources = {name: column_source(name) for name in out_cols}
+    participating = sorted({p[0] for p in pieces})
+
+    def out_dtype(name: str) -> np.dtype:
+        src, col, is_left = sources[name]
+        # promote across participating buckets (a nullable int column decodes
+        # as float64 only in buckets whose files hold nulls), matching what
+        # np.concatenate of per-bucket results used to do
+        dtypes = [src[b][col].dtype for b in (participating or src) if col in src.get(b, {})]
+        if not dtypes:
+            raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
+        if any(dt == object for dt in dtypes):
+            return np.dtype(object)
+        dt = np.result_type(*dtypes)
+        nullable = (is_left and has_null_left) or (not is_left and has_null_right)
+        if nullable and dt.kind == "b":
+            return np.dtype(object)  # pandas merge: bool + NaN -> object
+        if nullable and dt.kind in ("i", "u"):
+            return np.dtype(np.float64)  # pandas-merge null promotion
+        return dt
+
+    out = {name: np.empty(total, dtype=out_dtype(name)) for name in out_cols}
+    if total == 0:
+        return out
+
+    def null_value(dt: np.dtype):
+        if dt.kind == "M":
+            return np.datetime64("NaT")
+        if dt == object:
+            return np.nan  # pandas merge fills object holes with NaN
+        return np.nan
+
+    # pass 2: gather into the preallocated columns
+    off = 0
+    for b, lidx, ridx in pieces:
+        ct = lidx.shape[0]
         for name in out_cols:
             src, col, is_left = sources[name]
-            arr = src[b][col]
-            out[name][off : off + chunk_total] = arr[lidx if is_left else ridx]
+            idx = lidx if is_left else ridx
+            arr = src.get(b, {}).get(col)
+            if arr is None or arr.shape[0] == 0:
+                # side absent for this bucket (or filtered to zero rows):
+                # every index here is -1 by construction
+                out[name][off : off + ct] = null_value(out[name].dtype)
+            else:
+                nulls = idx < 0
+                if nulls.any():
+                    vals = out[name][off : off + ct]
+                    vals[:] = arr[np.clip(idx, 0, arr.shape[0] - 1)].astype(
+                        out[name].dtype, copy=False
+                    )
+                    vals[nulls] = null_value(out[name].dtype)
+                else:
+                    out[name][off : off + ct] = arr[idx]
+        off += ct
     return out
 
 

@@ -150,7 +150,10 @@ def apply_join_index_rule(
     plan: L.LogicalPlan,
     candidates: Dict[int, Tuple[L.Scan, List[IndexLogEntry]]],
 ) -> Tuple[L.LogicalPlan, int]:
-    if not isinstance(plan, L.Join) or plan.how != "inner":
+    # any equi-join type qualifies — index substitution on the scan sides is
+    # join-type-agnostic (ref: JoinPlanNodeFilter matches JoinWithoutHint with
+    # a wildcard joinType, JoinIndexRule.scala:52-54)
+    if not isinstance(plan, L.Join) or plan.how not in ("inner", "left", "right", "outer"):
         return plan, 0
     pairs = extract_equi_join_keys(plan.condition)
     if not pairs:

@@ -351,6 +351,7 @@ class TestDeviceJoin:
 
         class FakeJoin:
             output_columns = ["k", "val"]
+            how = "inner"
 
         lbuckets = {
             0: {"k": np.array([1, 2], dtype=np.int64), "val": np.array([10, 20], dtype=np.int64)},
@@ -587,3 +588,138 @@ def test_composite_rank_cache_respects_filter_changes(session, tmp_path):
     plain = q2.collect()
     assert_batches_equal(second, plain)
     assert B.num_rows(second) < B.num_rows(first)
+
+
+class TestOuterBucketedJoin:
+    """left/right/full outer equi-joins ride the span path too; unmatched
+    rows null-fill the opposite side exactly like the pandas-merge fallback
+    (ints promote to float64 NaN)."""
+
+    @pytest.fixture()
+    def outer_env(self, session, hs, tmp_path):
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        lroot, rroot = tmp_path / "ol", tmp_path / "or"
+        lroot.mkdir(), rroot.mkdir()
+        # keys 0..9 on the left, 5..14 on the right: both sides have
+        # unmatched rows, and some buckets exist on only one side
+        pq.write_table(
+            pa.table({"k": np.arange(10, dtype=np.int64), "a": np.arange(10, dtype=np.int64) * 10}),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table({"k": np.arange(5, 15, dtype=np.int64), "b": np.arange(10, dtype=np.int64) * 7}),
+            rroot / "p.parquet",
+        )
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("oL", ["k"], ["a"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("oR", ["k"], ["b"]))
+        session.enable_hyperspace()
+        return ldf, rdf
+
+    @pytest.mark.parametrize("how,expected_rows", [("left", 10), ("right", 10), ("outer", 15), ("inner", 5)])
+    def test_outer_join_matches_pandas(self, session, outer_env, how, expected_rows):
+        ldf, rdf = outer_env
+        q = ldf.join(rdf, on="k", how=how).select("a", "b")
+        plan = q.optimized_plan()
+        joins = L.collect(plan, lambda p: isinstance(p, L.Join))
+        assert joins and D.join_sides_compatible(joins[0]) is not None
+        via_spans = D.dispatch_bucketed_join(session, joins[0])
+        assert B.num_rows(via_spans) == expected_rows
+        session.disable_hyperspace()
+        plain = q.collect()
+        session.enable_hyperspace()
+        assert_batches_equal({c: via_spans[c] for c in ("a", "b")}, plain)
+        # and the full query (with projection) agrees end to end
+        assert_batches_equal(q.collect(), plain)
+
+    def test_outer_join_null_duplication(self, session, hs, tmp_path):
+        """Duplicate matches + unmatched rows in one bucket."""
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        lroot, rroot = tmp_path / "dl", tmp_path / "dr"
+        lroot.mkdir(), rroot.mkdir()
+        pq.write_table(
+            pa.table({"k": np.array([1, 1, 2, 9], dtype=np.int64), "a": np.arange(4, dtype=np.int64)}),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table({"k": np.array([1, 1, 8], dtype=np.int64), "b": np.arange(3, dtype=np.int64)}),
+            rroot / "p.parquet",
+        )
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("dL", ["k"], ["a"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("dR", ["k"], ["b"]))
+        session.enable_hyperspace()
+        for how in ("left", "right", "outer"):
+            q = ldf.join(rdf, on="k", how=how).select("a", "b")
+            got = q.collect()
+            session.disable_hyperspace()
+            plain = q.collect()
+            session.enable_hyperspace()
+            assert_batches_equal(got, plain)
+
+
+def test_left_join_right_side_fully_deleted(session, tmp_path):
+    """Right side is a hybrid scan whose lineage NOT-IN filter empties every
+    bucket (source file deleted): the left join must null-fill, not crash."""
+    import os
+
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+    session.conf.set(hst.keys.HYBRID_SCAN_MAX_DELETED_RATIO, 1.0)
+    session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+    lroot, rroot = tmp_path / "fl", tmp_path / "fr"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table({"k": np.arange(6, dtype=np.int64), "a": np.arange(6, dtype=np.int64)}),
+        lroot / "p.parquet",
+    )
+    pq.write_table(
+        pa.table({"k": np.arange(6, dtype=np.int64), "b": np.arange(6, dtype=np.int64) * 2}),
+        rroot / "p0.parquet",
+    )
+    pq.write_table(
+        pa.table({"k": np.arange(6, 9, dtype=np.int64), "b": np.arange(3, dtype=np.int64)}),
+        rroot / "p1.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("flL", ["k"], ["a"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("flR", ["k"], ["b"]))
+    os.remove(str(rroot / "p0.parquet"))  # all left-matching right rows gone
+    session.enable_hyperspace()
+    rdf2 = session.read_parquet(str(rroot))
+    q = ldf.join(rdf2, on="k", how="left").select("a", "b")
+    got = q.collect()
+    session.disable_hyperspace()
+    plain = q.collect()
+    assert_batches_equal(got, plain)
+    assert np.isnan(got["b"]).all()  # nothing matches after the delete
+
+
+def test_outer_join_bool_payload_matches_pandas(session, tmp_path):
+    """Nullable bool columns promote to object True/False/NaN, matching the
+    pandas-merge fallback, so both execution paths agree."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    lroot, rroot = tmp_path / "bl", tmp_path / "br"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table({"k": np.array([1, 2, 9], dtype=np.int64), "a": np.arange(3, dtype=np.int64)}),
+        lroot / "p.parquet",
+    )
+    pq.write_table(
+        pa.table({"k": np.array([1, 2], dtype=np.int64), "flag": np.array([True, False])}),
+        rroot / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("bL", ["k"], ["a"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("bR", ["k"], ["flag"]))
+    session.enable_hyperspace()
+    q = ldf.join(rdf, on="k", how="left").select("a", "flag")
+    got = q.collect()
+    session.disable_hyperspace()
+    plain = q.collect()
+    assert got["flag"].dtype == plain["flag"].dtype == object
+    ga = sorted(got["flag"], key=str)
+    pa_ = sorted(plain["flag"], key=str)
+    assert [str(x) for x in ga] == [str(x) for x in pa_]
