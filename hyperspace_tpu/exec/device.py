@@ -1037,7 +1037,27 @@ def _expand_join_pairs(
             ridx = np.arange(chunk_total) - np.repeat(offsets, counts) + np.repeat(lo_b, counts)
             return lidx, ridx
 
-    pieces = []  # (bucket, lidx, ridx)
+    # pieces hold (bucket, row_count, maker) with maker() -> (lidx, ridx);
+    # expansion is deferred to pass 2 so only ONE bucket's index arrays are
+    # alive at a time (peak memory matters on large inner joins)
+    def matched_maker(lo_b, counts, keep_left_):
+        def make():
+            if keep_left_:
+                # unmatched left rows expand as one (i, lo[i]) pair via the
+                # same native kernel, then get their right index nulled
+                counts_eff = np.maximum(counts, 1)
+                ct = int(counts_eff.sum())
+                lidx, ridx = expand_inner(np.asarray(lo_b), counts_eff, ct)
+                null_rows = np.repeat(counts == 0, counts_eff)
+                if null_rows.any():
+                    ridx = np.asarray(ridx, dtype=np.int64)
+                    ridx[null_rows] = -1
+                return lidx, ridx
+            return expand_inner(np.asarray(lo_b), counts, int(counts.sum()))
+
+        return make
+
+    pieces = []  # (bucket, count, maker)
     total = 0
     has_null_left = has_null_right = False
     for b in range(nb):
@@ -1049,23 +1069,15 @@ def _expand_join_pairs(
             lo_b, hi_b = span_of(b)
             counts = (hi_b - lo_b).astype(np.int64)
             if keep_left:
-                # unmatched left rows expand as one (i, lo[i]) pair via the
-                # same native kernel, then get their right index nulled
-                counts_eff = np.maximum(counts, 1)
-                ct = int(counts_eff.sum())
-                lidx, ridx = expand_inner(np.asarray(lo_b), counts_eff, ct)
-                null_rows = np.repeat(counts == 0, counts_eff)
-                if null_rows.any():
-                    ridx = np.asarray(ridx, dtype=np.int64)
-                    ridx[null_rows] = -1
+                ct = int(np.maximum(counts, 1).sum())
+                if (counts == 0).any():
                     has_null_right = True
-                pieces.append((b, lidx, ridx))
+                pieces.append((b, ct, matched_maker(lo_b, counts, True)))
                 total += ct
             else:
                 ct = int(counts.sum())
                 if ct:
-                    lidx, ridx = expand_inner(np.asarray(lo_b), counts, ct)
-                    pieces.append((b, lidx, ridx))
+                    pieces.append((b, ct, matched_maker(lo_b, counts, False)))
                     total += ct
             if keep_right:
                 # right rows covered by no span are unmatched
@@ -1075,15 +1087,18 @@ def _expand_join_pairs(
                 np.add.at(cover, np.asarray(hi_b)[sel], -1)
                 unmatched = np.nonzero(np.cumsum(cover[:-1]) == 0)[0]
                 if unmatched.size:
-                    pieces.append((b, np.full(unmatched.size, -1, dtype=np.int64), unmatched))
+                    pieces.append(
+                        (b, unmatched.size,
+                         lambda u=unmatched: (np.full(u.size, -1, dtype=np.int64), u))
+                    )
                     total += unmatched.size
                     has_null_left = True
         elif ll and keep_left:
-            pieces.append((b, np.arange(ll), np.full(ll, -1, dtype=np.int64)))
+            pieces.append((b, ll, lambda n_=ll: (np.arange(n_), np.full(n_, -1, dtype=np.int64))))
             total += ll
             has_null_right = True
         elif rr and keep_right:
-            pieces.append((b, np.full(rr, -1, dtype=np.int64), np.arange(rr)))
+            pieces.append((b, rr, lambda n_=rr: (np.full(n_, -1, dtype=np.int64), np.arange(n_))))
             total += rr
             has_null_left = True
 
@@ -1115,14 +1130,15 @@ def _expand_join_pairs(
     def null_value(dt: np.dtype):
         if dt.kind == "M":
             return np.datetime64("NaT")
-        if dt == object:
-            return np.nan  # pandas merge fills object holes with NaN
-        return np.nan
+        if dt.kind == "m":
+            return np.timedelta64("NaT")
+        return np.nan  # float holes; pandas merge also fills object with NaN
 
-    # pass 2: gather into the preallocated columns
+    # pass 2: gather into the preallocated columns, expanding one bucket's
+    # index arrays at a time
     off = 0
-    for b, lidx, ridx in pieces:
-        ct = lidx.shape[0]
+    for b, ct, make in pieces:
+        lidx, ridx = make()
         for name in out_cols:
             src, col, is_left = sources[name]
             idx = lidx if is_left else ridx

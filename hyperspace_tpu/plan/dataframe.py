@@ -101,6 +101,12 @@ class DataFrame:
 
     asScalar = as_scalar
 
+    def create_or_replace_temp_view(self, name: str) -> None:
+        """Register this frame for ``session.sql()`` (Spark's temp-view role)."""
+        self.session.register_view(name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
     # --- actions -----------------------------------------------------------
     def optimized_plan(self) -> L.LogicalPlan:
         if self.session.hyperspace_enabled:

@@ -1,0 +1,495 @@
+"""Minimal SQL front-end over the relational IR.
+
+The reference's users drive Hyperspace through Spark SQL; this module gives
+the same entry point without Spark: ``session.sql("SELECT ...")`` parses a
+deliberately small dialect (exactly the plan shapes the optimizer rules
+accept — linear scans, CNF equi-joins, filters/projects/aggregates; ref:
+JoinPlanNodeFilter's own restrictions, HS/index/covering/JoinIndexRule.scala:135-155)
+and plans it onto DataFrame operations, so every index rewrite, explain, and
+whyNot surface applies to SQL queries unchanged.
+
+Supported grammar (case-insensitive keywords):
+
+    SELECT <*| item [, item ...]>
+    FROM <view> [AS] [alias]
+    [ [INNER|LEFT|RIGHT|FULL] [OUTER] JOIN <view> [alias] ON a = b [AND ...] ]*
+    [WHERE <predicate>]
+    [GROUP BY col [, col ...]]
+    [ORDER BY col [ASC|DESC] [, ...]]
+    [LIMIT n]
+
+    item      := col | qualified.col | SUM|MIN|MAX|AVG|COUNT '(' col | '*' ')'  [AS name]
+    predicate := comparisons (=, !=, <>, <, <=, >, >=), IN (...), IS [NOT] NULL,
+                 BETWEEN x AND y, NOT/AND/OR, arithmetic (+ - * / %),
+                 literals: 123, 1.5, 'text', DATE '2024-01-31'
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from hyperspace_tpu.plan.expr import Col, Expr, Lit, col, lit
+
+
+class SqlError(ValueError):
+    pass
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    \s*(
+        (?P<string>'(?:[^']|'')*')
+      | (?P<number>\d+\.\d+|\.\d+|\d+)
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)?)
+      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%)
+    )""",
+    re.VERBOSE,
+)
+
+_KEYWORDS = {
+    "select", "from", "where", "group", "by", "order", "limit", "join", "on",
+    "inner", "left", "right", "full", "outer", "and", "or", "not", "in", "is",
+    "null", "between", "as", "asc", "desc", "date", "count", "sum", "min",
+    "max", "avg",
+}
+
+_AGG_FNS = ("count", "sum", "min", "max", "avg")
+
+
+def _tokenize(text: str) -> List[Tuple[str, str]]:
+    out: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(text):
+        if text[pos].isspace():
+            pos += 1
+            continue
+        m = _TOKEN_RE.match(text, pos)
+        if not m or m.start(1) != pos:
+            raise SqlError(f"Cannot tokenize SQL at: {text[pos:pos+30]!r}")
+        pos = m.end(1)
+        if m.group("ident") is not None:
+            word = m.group("ident")
+            if "." not in word and word.lower() in _KEYWORDS:
+                out.append(("kw", word.lower()))
+            else:
+                out.append(("ident", word))
+        elif m.group("string") is not None:
+            out.append(("string", m.group("string")[1:-1].replace("''", "'")))
+        elif m.group("number") is not None:
+            out.append(("number", m.group("number")))
+        else:
+            out.append(("op", m.group("op")))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self, k: int = 0) -> Optional[Tuple[str, str]]:
+        j = self.i + k
+        return self.toks[j] if j < len(self.toks) else None
+
+    def next(self) -> Tuple[str, str]:
+        if self.i >= len(self.toks):
+            raise SqlError("Unexpected end of SQL")
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept_kw(self, *words: str) -> Optional[str]:
+        t = self.peek()
+        if t is not None and t[0] == "kw" and t[1] in words:
+            self.i += 1
+            return t[1]
+        return None
+
+    def expect_kw(self, word: str) -> None:
+        if self.accept_kw(word) is None:
+            raise SqlError(f"Expected {word.upper()} at {self._where()}")
+
+    def accept_op(self, *ops: str) -> Optional[str]:
+        t = self.peek()
+        if t is not None and t[0] == "op" and t[1] in ops:
+            self.i += 1
+            return t[1]
+        return None
+
+    def expect_op(self, op: str) -> None:
+        if self.accept_op(op) is None:
+            raise SqlError(f"Expected {op!r} at {self._where()}")
+
+    def expect_ident(self) -> str:
+        t = self.next()
+        if t[0] != "ident":
+            raise SqlError(f"Expected identifier, got {t[1]!r}")
+        return t[1]
+
+    def _where(self) -> str:
+        return " ".join(t[1] for t in self.toks[self.i : self.i + 4]) or "<end>"
+
+    def at_end(self) -> bool:
+        return self.i >= len(self.toks)
+
+
+# --- AST ------------------------------------------------------------------
+
+
+class SelectItem:
+    def __init__(self, name: Optional[str], alias: Optional[str], agg: Optional[Tuple[str, Optional[str]]]):
+        self.name = name            # column (possibly qualified) for plain items
+        self.alias = alias
+        self.agg = agg              # (fn, column-or-None-for-*) for aggregates
+
+
+class JoinClause:
+    def __init__(self, view: str, alias: str, how: str, on: List[Tuple[str, str]]):
+        self.view = view
+        self.alias = alias
+        self.how = how
+        self.on = on
+
+
+class Query:
+    def __init__(self):
+        self.items: Optional[List[SelectItem]] = None  # None = SELECT *
+        self.table = ""
+        self.alias = ""
+        self.joins: List[JoinClause] = []
+        self.where: Optional[Expr] = None
+        self.group_by: List[str] = []
+        self.order_by: List[Tuple[str, bool]] = []
+        self.limit: Optional[int] = None
+
+
+def parse(text: str) -> Query:
+    p = _Parser(_tokenize(text))
+    q = Query()
+    p.expect_kw("select")
+    if p.accept_op("*"):
+        q.items = None
+    else:
+        q.items = [_parse_item(p)]
+        while p.accept_op(","):
+            q.items.append(_parse_item(p))
+    p.expect_kw("from")
+    q.table = p.expect_ident()
+    q.alias = _maybe_alias(p) or q.table
+    while True:
+        how = _parse_join_type(p)
+        if how is None:
+            break
+        view = p.expect_ident()
+        alias = _maybe_alias(p) or view
+        p.expect_kw("on")
+        on = [_parse_on_eq(p)]
+        while p.accept_kw("and"):
+            on.append(_parse_on_eq(p))
+        q.joins.append(JoinClause(view, alias, how, on))
+    if p.accept_kw("where"):
+        q.where = _parse_or(p)
+    if p.accept_kw("group"):
+        p.expect_kw("by")
+        q.group_by = [_strip_qualifier(p.expect_ident())]
+        while p.accept_op(","):
+            q.group_by.append(_strip_qualifier(p.expect_ident()))
+    if p.accept_kw("order"):
+        p.expect_kw("by")
+        q.order_by = [_parse_order_item(p)]
+        while p.accept_op(","):
+            q.order_by.append(_parse_order_item(p))
+    if p.accept_kw("limit"):
+        t = p.next()
+        if t[0] != "number":
+            raise SqlError("LIMIT expects a number")
+        q.limit = int(t[1])
+    if not p.at_end():
+        raise SqlError(f"Unexpected trailing SQL: {p._where()}")
+    return q
+
+
+def _maybe_alias(p: _Parser) -> Optional[str]:
+    p.accept_kw("as")
+    t = p.peek()
+    if t is not None and t[0] == "ident" and "." not in t[1]:
+        p.i += 1
+        return t[1]
+    return None
+
+
+def _parse_join_type(p: _Parser) -> Optional[str]:
+    if p.accept_kw("join"):
+        return "inner"
+    for word, how in (("inner", "inner"), ("left", "left"), ("right", "right"), ("full", "outer")):
+        if p.accept_kw(word):
+            p.accept_kw("outer")
+            p.expect_kw("join")
+            return how
+    return None
+
+
+def _parse_item(p: _Parser) -> SelectItem:
+    t = p.peek()
+    if t is not None and t[0] == "kw" and t[1] in _AGG_FNS:
+        fn = p.next()[1]
+        p.expect_op("(")
+        if p.accept_op("*"):
+            arg = None
+            if fn != "count":
+                raise SqlError(f"{fn.upper()}(*) is not valid")
+        else:
+            arg = _strip_qualifier(p.expect_ident())
+        p.expect_op(")")
+        alias = _maybe_alias(p)
+        return SelectItem(None, alias, (fn, arg))
+    name = p.expect_ident()
+    alias = _maybe_alias(p)
+    return SelectItem(name, alias, None)
+
+
+def _parse_on_eq(p: _Parser) -> Tuple[str, str]:
+    a = p.expect_ident()
+    p.expect_op("=")
+    b = p.expect_ident()
+    return a, b
+
+
+def _parse_order_item(p: _Parser) -> Tuple[str, bool]:
+    name = _strip_qualifier(p.expect_ident())
+    if p.accept_kw("desc"):
+        return name, False
+    p.accept_kw("asc")
+    return name, True
+
+
+def _strip_qualifier(name: str) -> str:
+    return name.split(".", 1)[1] if "." in name else name
+
+
+# --- predicate parsing (precedence: OR < AND < NOT < cmp < +- < */%) ------
+
+
+def _parse_or(p: _Parser) -> Expr:
+    e = _parse_and(p)
+    while p.accept_kw("or"):
+        e = e | _parse_and(p)
+    return e
+
+
+def _parse_and(p: _Parser) -> Expr:
+    e = _parse_not(p)
+    while p.accept_kw("and"):
+        e = e & _parse_not(p)
+    return e
+
+
+def _parse_not(p: _Parser) -> Expr:
+    if p.accept_kw("not"):
+        return ~_parse_not(p)
+    return _parse_cmp(p)
+
+
+def _parse_cmp(p: _Parser) -> Expr:
+    left = _parse_sum(p)
+    if p.accept_kw("is"):
+        negate = p.accept_kw("not") is not None
+        p.expect_kw("null")
+        e = left.is_null()
+        return ~e if negate else e
+    if p.accept_kw("between"):
+        lo = _parse_sum(p)
+        p.expect_kw("and")
+        hi = _parse_sum(p)
+        return (left >= lo) & (left <= hi)
+    negate = False
+    if p.accept_kw("not"):
+        negate = True
+    if p.accept_kw("in"):
+        p.expect_op("(")
+        values = [_parse_literal_value(p)]
+        while p.accept_op(","):
+            values.append(_parse_literal_value(p))
+        p.expect_op(")")
+        e = left.isin(values)
+        return ~e if negate else e
+    if negate:
+        raise SqlError("NOT must be followed by IN here")
+    op = p.accept_op("=", "!=", "<>", "<=", ">=", "<", ">")
+    if op is None:
+        return left  # bare boolean expression
+    right = _parse_sum(p)
+    if op == "=":
+        return left == right
+    if op in ("!=", "<>"):
+        return left != right
+    return {"<": left < right, "<=": left <= right, ">": left > right, ">=": left >= right}[op]
+
+
+def _parse_sum(p: _Parser) -> Expr:
+    e = _parse_term(p)
+    while True:
+        op = p.accept_op("+", "-")
+        if op is None:
+            return e
+        rhs = _parse_term(p)
+        e = e + rhs if op == "+" else e - rhs
+
+
+def _parse_term(p: _Parser) -> Expr:
+    e = _parse_factor(p)
+    while True:
+        op = p.accept_op("*", "/", "%")
+        if op is None:
+            return e
+        rhs = _parse_factor(p)
+        e = {"*": e * rhs, "/": e / rhs, "%": e % rhs}[op]
+
+
+def _parse_factor(p: _Parser) -> Expr:
+    if p.accept_op("("):
+        e = _parse_or(p)
+        p.expect_op(")")
+        return e
+    if p.accept_op("-"):
+        return Lit(0) - _parse_factor(p)
+    t = p.peek()
+    if t is None:
+        raise SqlError("Unexpected end of expression")
+    if t[0] == "ident":
+        p.i += 1
+        return col(_strip_qualifier(t[1]))
+    return lit(_parse_literal_value(p))
+
+
+def _parse_literal_value(p: _Parser) -> Any:
+    t = p.next()
+    if t[0] == "number":
+        return float(t[1]) if "." in t[1] else int(t[1])
+    if t[0] == "string":
+        return t[1]
+    if t == ("kw", "date"):
+        s = p.next()
+        if s[0] != "string":
+            raise SqlError("DATE expects a quoted literal")
+        return np.datetime64(s[1])
+    if t == ("kw", "null"):
+        return None
+    if t[0] == "op" and t[1] == "-":
+        v = _parse_literal_value(p)
+        return -v
+    raise SqlError(f"Expected a literal, got {t[1]!r}")
+
+
+# --- planning -------------------------------------------------------------
+
+
+def plan_query(q: Query, views: Dict[str, "DataFrame"], session) -> "DataFrame":  # noqa: F821
+    if q.table not in views:
+        raise SqlError(f"Unknown table/view {q.table!r}; register with create_or_replace_temp_view")
+    df = views[q.table]
+    aliases = {q.alias.lower(): "left"}
+
+    for j in q.joins:
+        if j.view not in views:
+            raise SqlError(f"Unknown table/view {j.view!r}")
+        right = views[j.view]
+        condition: Optional[Expr] = None
+        left_cols = {c.lower() for c in df.plan.output_columns}
+        right_cols = {c.lower() for c in right.plan.output_columns}
+        for a, b in j.on:
+            an, bn = _resolve_side(a, b, j.alias, aliases, left_cols, right_cols)
+            term = col(an) == col(bn)
+            condition = term if condition is None else (condition & term)
+        df = df.join(right, on=condition, how=j.how)
+        aliases[j.alias.lower()] = "right"
+
+    if q.where is not None:
+        df = df.filter(q.where)
+
+    agg_items = [it for it in (q.items or []) if it.agg is not None]
+    if agg_items or q.group_by:
+        if q.items is None:
+            raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
+        aggs = {}
+        out_order: List[str] = []
+        for it in q.items:
+            if it.agg is not None:
+                fn, arg = it.agg
+                name = it.alias or (f"{fn}({arg})" if arg else "count")
+                aggs[name] = (arg if arg is not None else "*", fn)
+                out_order.append(name)
+            else:
+                plain = _strip_qualifier(it.name)
+                if plain.lower() not in {g.lower() for g in q.group_by}:
+                    raise SqlError(f"Column {plain!r} must appear in GROUP BY or an aggregate")
+                out_order.append(it.alias or plain)
+        if not aggs:
+            raise SqlError("GROUP BY requires at least one aggregate in SELECT")
+        df = df.group_by(*q.group_by).agg(**aggs) if q.group_by else df.agg(**aggs)
+        keyed = {k: k for k in df.plan.output_columns}
+        missing = [c for c in out_order if c not in keyed]
+        if missing:
+            raise SqlError(f"Unknown output columns {missing}")
+        df = df.select(*out_order)
+    elif q.items is not None:
+        names = []
+        for it in q.items:
+            names.append(_resolve_select_name(it.name, df, aliases))
+        df = df.select(*names)
+        # aliases on plain projections are not renamed (the IR has no rename
+        # node); keep SQL output names = source names
+
+    if q.order_by:
+        df = df.order_by(*[n for n, _ in q.order_by], ascending=[a for _, a in q.order_by])
+    if q.limit is not None:
+        df = df.limit(q.limit)
+    return df
+
+
+def _resolve_side(a: str, b: str, right_alias: str, aliases, left_cols, right_cols) -> Tuple[str, str]:
+    """Order an ON pair as (left column, right column) using qualifiers when
+    present, else membership."""
+
+    def side_of(name: str) -> Optional[str]:
+        if "." in name:
+            qual = name.split(".", 1)[0].lower()
+            if qual == right_alias.lower():
+                return "right"
+            if qual in aliases:
+                return "left"
+        return None
+
+    sa, sb = side_of(a), side_of(b)
+    an, bn = _strip_qualifier(a), _strip_qualifier(b)
+    if sa == "right" or sb == "left":
+        an, bn = bn, an
+    elif sa is None and sb is None:
+        if an.lower() not in left_cols and bn.lower() in left_cols:
+            an, bn = bn, an
+    return an, bn
+
+
+def _resolve_select_name(name: str, df, aliases) -> str:
+    plain = _strip_qualifier(name)
+    cols_ = df.plan.output_columns
+    # a qualified duplicate from the right side of a join surfaces as "#r";
+    # check the qualifier before the plain name, which also exists
+    if "." in name:
+        qual = name.split(".", 1)[0].lower()
+        if aliases.get(qual) == "right" and f"{plain}#r" in cols_:
+            return f"{plain}#r"
+    if plain in cols_:
+        return plain
+    lowered = {c.lower(): c for c in cols_}
+    if plain.lower() in lowered:
+        return lowered[plain.lower()]
+    raise SqlError(f"Unknown column {name!r} among {cols_}")
+
+
+def run_sql(text: str, session) -> "DataFrame":  # noqa: F821
+    views = session._temp_views
+    return plan_query(parse(text), views, session)

@@ -25,6 +25,7 @@ class Session:
         self.hyperspace_enabled = False
         self._index_manager = None
         self._mesh = None
+        self._temp_views: Dict[str, Any] = {}
 
     # --- reading data ------------------------------------------------------
     def read(self, paths, file_format: str, **options) -> "DataFrame":  # noqa: F821
@@ -67,6 +68,18 @@ class Session:
         from hyperspace_tpu.sources.iceberg import IcebergRelation
 
         return DataFrame(Scan(IcebergRelation(path, snapshot_id=snapshot_id)), self)
+
+    # --- SQL (the reference's users drive Hyperspace through Spark SQL) ----
+    def sql(self, query: str) -> "DataFrame":  # noqa: F821
+        from hyperspace_tpu.plan.sql import run_sql
+
+        return run_sql(query, self)
+
+    def register_view(self, name: str, df: "DataFrame") -> None:  # noqa: F821
+        self._temp_views[name] = df
+
+    def drop_view(self, name: str) -> None:
+        self._temp_views.pop(name, None)
 
     # --- hyperspace toggle (ref: HS/package.scala:36-43) -------------------
     def enable_hyperspace(self) -> "Session":

@@ -723,3 +723,32 @@ def test_outer_join_bool_payload_matches_pandas(session, tmp_path):
     ga = sorted(got["flag"], key=str)
     pa_ = sorted(plain["flag"], key=str)
     assert [str(x) for x in ga] == [str(x) for x in pa_]
+
+
+def test_outer_join_duration_payload_nulls(session, tmp_path):
+    """Duration (timedelta64) payload columns null-fill with NaT on outer
+    joins instead of crashing on a NaN assignment."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    lroot, rroot = tmp_path / "tl", tmp_path / "tr"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table({"k": np.array([1, 9], dtype=np.int64), "a": np.array([1, 2], dtype=np.int64)}),
+        lroot / "p.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "k": np.array([1], dtype=np.int64),
+                "dur": pa.array([np.timedelta64(5, "s")], type=pa.duration("s")),
+            }
+        ),
+        rroot / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("tL", ["k"], ["a"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("tR", ["k"], ["dur"]))
+    session.enable_hyperspace()
+    got = ldf.join(rdf, on="k", how="left").select("a", "dur").collect()
+    assert got["a"].shape[0] == 2
+    assert np.isnat(got["dur"]).sum() == 1

@@ -1,0 +1,191 @@
+"""SQL front-end tests: the dialect plans onto the same IR the optimizer
+rules rewrite, so indexes apply to SQL queries exactly as to dataframe ones
+(the reference's users drive Hyperspace through Spark SQL)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.sql import SqlError, parse
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+@pytest.fixture()
+def views(session, tmp_path):
+    rng = np.random.default_rng(5)
+    n = 600
+    sales = pa.table(
+        {
+            "region": np.array([f"r{i % 8}" for i in range(n)]),
+            "user": rng.integers(0, 40, n).astype(np.int64),
+            "amount": np.round(rng.uniform(0, 100, n), 2),
+            "day": np.datetime64("2024-01-01") + rng.integers(0, 90, n).astype("timedelta64[D]"),
+        }
+    )
+    users = pa.table(
+        {
+            "user": np.arange(40, dtype=np.int64),
+            "tier": np.array(["gold" if i % 5 == 0 else "std" for i in range(40)]),
+        }
+    )
+    sroot, uroot = tmp_path / "sales", tmp_path / "users"
+    sroot.mkdir(), uroot.mkdir()
+    pq.write_table(sales, sroot / "p.parquet")
+    pq.write_table(users, uroot / "p.parquet")
+    sdf = session.read_parquet(str(sroot))
+    udf = session.read_parquet(str(uroot))
+    sdf.create_or_replace_temp_view("sales")
+    udf.create_or_replace_temp_view("users")
+    return sdf, udf
+
+
+class TestSqlBasics:
+    def test_select_star(self, session, views):
+        got = session.sql("SELECT * FROM sales").collect()
+        assert set(got.keys()) == {"region", "user", "amount", "day"}
+        assert got["user"].shape[0] == 600
+
+    def test_filter_and_project(self, session, views):
+        sdf, _ = views
+        got = session.sql("SELECT amount FROM sales WHERE region = 'r3'").collect()
+        expected = sdf.filter(hst.col("region") == "r3").select("amount").collect()
+        np.testing.assert_array_equal(np.sort(got["amount"]), np.sort(expected["amount"]))
+
+    def test_predicates(self, session, views):
+        sdf, _ = views
+        cases = [
+            ("SELECT user FROM sales WHERE amount > 50 AND amount <= 70", (hst.col("amount") > 50) & (hst.col("amount") <= 70)),
+            ("SELECT user FROM sales WHERE user IN (1, 2, 3)", hst.col("user").isin(1, 2, 3)),
+            ("SELECT user FROM sales WHERE NOT user = 5", ~(hst.col("user") == 5)),
+            ("SELECT user FROM sales WHERE amount BETWEEN 10 AND 20", (hst.col("amount") >= 10) & (hst.col("amount") <= 20)),
+            ("SELECT user FROM sales WHERE amount * 2 > 150", hst.col("amount") * 2 > 150),
+            ("SELECT user FROM sales WHERE region != 'r0' OR user < 3", (hst.col("region") != "r0") | (hst.col("user") < 3)),
+        ]
+        for text, cond in cases:
+            got = session.sql(text).collect()
+            expected = sdf.filter(cond).select("user").collect()
+            np.testing.assert_array_equal(np.sort(got["user"]), np.sort(expected["user"]), err_msg=text)
+
+    def test_date_literal(self, session, views):
+        sdf, _ = views
+        got = session.sql("SELECT user FROM sales WHERE day >= DATE '2024-03-01'").collect()
+        expected = sdf.filter(hst.col("day") >= hst.lit(np.datetime64("2024-03-01"))).select("user").collect()
+        assert got["user"].shape == expected["user"].shape
+
+    def test_order_and_limit(self, session, views):
+        got = session.sql("SELECT user, amount FROM sales ORDER BY amount DESC LIMIT 5").collect()
+        assert got["amount"].shape[0] == 5
+        assert np.all(np.diff(got["amount"]) <= 0)
+
+    def test_group_by(self, session, views):
+        sdf, _ = views
+        got = session.sql(
+            "SELECT region, SUM(amount) AS total, COUNT(*) AS n FROM sales GROUP BY region"
+        ).collect()
+        assert set(got.keys()) == {"region", "total", "n"}
+        assert int(got["n"].sum()) == 600
+        expected = sdf.group_by("region").agg(total=("amount", "sum")).collect()
+        a = dict(zip(got["region"], np.round(got["total"], 4)))
+        b = dict(zip(expected["region"], np.round(expected["total"], 4)))
+        assert a == b
+
+    def test_global_aggregate(self, session, views):
+        got = session.sql("SELECT COUNT(*) AS n, MAX(amount) AS m FROM sales").collect()
+        assert int(got["n"][0]) == 600
+
+
+class TestSqlJoins:
+    def test_join_with_qualifiers(self, session, views):
+        sdf, udf = views
+        got = session.sql(
+            "SELECT amount, tier FROM sales s JOIN users u ON s.user = u.user WHERE tier = 'gold'"
+        ).collect()
+        expected = (
+            sdf.join(udf, on="user").filter(hst.col("tier") == "gold").select("amount", "tier").collect()
+        )
+        np.testing.assert_array_equal(np.sort(got["amount"]), np.sort(expected["amount"]))
+
+    def test_left_join(self, session, views):
+        got = session.sql(
+            "SELECT amount, tier FROM sales s LEFT JOIN users u ON s.user = u.user"
+        ).collect()
+        assert got["amount"].shape[0] == 600
+
+    def test_join_duplicate_column_qualifier(self, session, views):
+        got = session.sql("SELECT s.user, u.user FROM sales s JOIN users u ON s.user = u.user").collect()
+        assert set(got.keys()) == {"user", "user#r"}
+
+
+class TestSqlUsesIndexes:
+    def test_filter_index_applies_to_sql(self, session, hs, views):
+        sdf, _ = views
+        hs.create_index(sdf, hst.CoveringIndexConfig("sqlIdx", ["region"], ["amount"]))
+        session.enable_hyperspace()
+        q = session.sql("SELECT amount FROM sales WHERE region = 'r2'")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda x: True)), plan.pretty()
+        session.disable_hyperspace()
+        baseline = np.sort(q.collect()["amount"])
+        session.enable_hyperspace()
+        np.testing.assert_array_equal(np.sort(q.collect()["amount"]), baseline)
+
+    def test_join_index_applies_to_sql(self, session, hs, views):
+        sdf, udf = views
+        hs.create_index(sdf, hst.CoveringIndexConfig("sqlJL", ["user"], ["amount"]))
+        hs.create_index(udf, hst.CoveringIndexConfig("sqlJR", ["user"], ["tier"]))
+        session.enable_hyperspace()
+        q = session.sql("SELECT amount, tier FROM sales s JOIN users u ON s.user = u.user")
+        plan = q.optimized_plan()
+        scans = [p for p in L.collect(plan, lambda x: isinstance(x, L.IndexScan))]
+        assert len(scans) == 2, plan.pretty()
+
+    def test_explain_works_on_sql(self, session, hs, views):
+        sdf, _ = views
+        hs.create_index(sdf, hst.CoveringIndexConfig("sqlEx", ["region"], ["amount"]))
+        session.enable_hyperspace()
+        text = hs.explain(session.sql("SELECT amount FROM sales WHERE region = 'r1'"))
+        assert "sqlEx" in text
+
+
+class TestSqlErrors:
+    def test_unknown_view(self, session, views):
+        with pytest.raises(SqlError, match="Unknown table"):
+            session.sql("SELECT * FROM nope")
+
+    def test_unknown_column(self, session, views):
+        with pytest.raises((SqlError, ValueError)):
+            session.sql("SELECT missing FROM sales").collect()
+
+    def test_group_by_requires_aggregate_membership(self, session, views):
+        with pytest.raises(SqlError, match="GROUP BY"):
+            session.sql("SELECT user, SUM(amount) FROM sales GROUP BY region")
+
+    def test_trailing_garbage(self, session, views):
+        with pytest.raises(SqlError, match="trailing"):
+            session.sql("SELECT * FROM sales HAVING x")
+
+    def test_parse_shapes(self):
+        q = parse("SELECT a, SUM(b) AS s FROM t GROUP BY a ORDER BY a DESC LIMIT 3")
+        assert q.table == "t" and q.limit == 3
+        assert q.order_by == [("a", False)]
+        assert q.items[1].agg == ("sum", "b")
+
+    def test_string_escape(self, session, views, tmp_path):
+        import pyarrow.parquet as pq
+
+        root = tmp_path / "esc"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"s": np.array(["it's", "plain"]), "v": np.array([1, 2], dtype=np.int64)}),
+            root / "p.parquet",
+        )
+        session.read_parquet(str(root)).create_or_replace_temp_view("esc")
+        got = session.sql("SELECT v FROM esc WHERE s = 'it''s'").collect()
+        assert got["v"].tolist() == [1]
