@@ -265,6 +265,10 @@ class Executor:
             child = self._exec(plan.child, with_file_names)
             return {k: v[: plan.n] for k, v in child.items()}
 
+        if isinstance(plan, L.Rename):
+            child = self._exec(plan.child, with_file_names)
+            return {plan.mapping.get(k, k): v for k, v in child.items()}
+
         if isinstance(plan, (L.Union, L.BucketUnion)):
             return B.concat([self._exec(c, with_file_names) for c in plan.children()])
 

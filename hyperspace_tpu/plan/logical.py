@@ -305,6 +305,37 @@ class Aggregate(LogicalPlan):
         return f"Aggregate(keys={self.keys}, [{', '.join(parts)}])"
 
 
+class Rename(LogicalPlan):
+    """Column renaming (SQL ``AS`` aliases). Purely cosmetic at the top of a
+    plan: data and row order pass through, only names change (the reference
+    delegates aliasing to Spark's analyzer)."""
+
+    def __init__(self, mapping: dict, child: LogicalPlan):
+        out = child.output_columns
+        unknown = [k for k in mapping if k not in out]
+        if unknown:
+            raise ValueError(f"Cannot rename unknown columns {unknown} among {out}")
+        renamed = [mapping.get(c, c) for c in out]
+        if len(set(renamed)) != len(renamed):
+            raise ValueError(f"Rename produces duplicate output names: {renamed}")
+        self.mapping = dict(mapping)
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return [self.mapping.get(c, c) for c in self.child.output_columns]
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Rename":
+        (child,) = children
+        return Rename(self.mapping, child)
+
+    def describe(self) -> str:
+        return f"Rename({self.mapping})"
+
+
 class Sort(LogicalPlan):
     """Order-by over (column, ascending) keys; host-side stable lexsort."""
 

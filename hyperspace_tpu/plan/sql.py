@@ -194,9 +194,9 @@ def parse(text: str) -> Query:
         q.where = _parse_or(p)
     if p.accept_kw("group"):
         p.expect_kw("by")
-        q.group_by = [_strip_qualifier(p.expect_ident())]
+        q.group_by = [p.expect_ident()]
         while p.accept_op(","):
-            q.group_by.append(_strip_qualifier(p.expect_ident()))
+            q.group_by.append(p.expect_ident())
     if p.accept_kw("order"):
         p.expect_kw("by")
         q.order_by = [_parse_order_item(p)]
@@ -242,7 +242,7 @@ def _parse_item(p: _Parser) -> SelectItem:
             if fn != "count":
                 raise SqlError(f"{fn.upper()}(*) is not valid")
         else:
-            arg = _strip_qualifier(p.expect_ident())
+            arg = p.expect_ident()
         p.expect_op(")")
         alias = _maybe_alias(p)
         return SelectItem(None, alias, (fn, arg))
@@ -259,7 +259,7 @@ def _parse_on_eq(p: _Parser) -> Tuple[str, str]:
 
 
 def _parse_order_item(p: _Parser) -> Tuple[str, bool]:
-    name = _strip_qualifier(p.expect_ident())
+    name = p.expect_ident()
     if p.accept_kw("desc"):
         return name, False
     p.accept_kw("asc")
@@ -361,7 +361,7 @@ def _parse_factor(p: _Parser) -> Expr:
         raise SqlError("Unexpected end of expression")
     if t[0] == "ident":
         p.i += 1
-        return col(_strip_qualifier(t[1]))
+        return col(t[1])  # qualifiers resolve at plan time (alias map needed)
     return lit(_parse_literal_value(p))
 
 
@@ -387,7 +387,7 @@ def _parse_literal_value(p: _Parser) -> Any:
 # --- planning -------------------------------------------------------------
 
 
-def plan_query(q: Query, views: Dict[str, "DataFrame"], session) -> "DataFrame":  # noqa: F821
+def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa: F821
     if q.table not in views:
         raise SqlError(f"Unknown table/view {q.table!r}; register with create_or_replace_temp_view")
     df = views[q.table]
@@ -399,58 +399,112 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"], session) -> "DataFrame":
         right = views[j.view]
         condition: Optional[Expr] = None
         left_cols = {c.lower() for c in df.plan.output_columns}
-        right_cols = {c.lower() for c in right.plan.output_columns}
         for a, b in j.on:
-            an, bn = _resolve_side(a, b, j.alias, aliases, left_cols, right_cols)
+            an, bn = _resolve_side(a, b, j.alias, aliases, left_cols)
             term = col(an) == col(bn)
             condition = term if condition is None else (condition & term)
         df = df.join(right, on=condition, how=j.how)
         aliases[j.alias.lower()] = "right"
 
-    if q.where is not None:
-        df = df.filter(q.where)
+    resolve_ref = _make_ref_resolver(df, aliases)
 
+    if q.where is not None:
+        df = df.filter(_resolve_expr_refs(q.where, resolve_ref))
+
+    renames: Dict[str, str] = {}
     agg_items = [it for it in (q.items or []) if it.agg is not None]
     if agg_items or q.group_by:
         if q.items is None:
             raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
+        group_keys = [resolve_ref(g) for g in q.group_by]
         aggs = {}
         out_order: List[str] = []
         for it in q.items:
             if it.agg is not None:
                 fn, arg = it.agg
+                arg = resolve_ref(arg) if arg is not None else None
                 name = it.alias or (f"{fn}({arg})" if arg else "count")
                 aggs[name] = (arg if arg is not None else "*", fn)
                 out_order.append(name)
             else:
-                plain = _strip_qualifier(it.name)
-                if plain.lower() not in {g.lower() for g in q.group_by}:
+                plain = resolve_ref(it.name)
+                if plain.lower() not in {g.lower() for g in group_keys}:
                     raise SqlError(f"Column {plain!r} must appear in GROUP BY or an aggregate")
-                out_order.append(it.alias or plain)
+                out_order.append(plain)
+                if it.alias:
+                    renames[plain] = it.alias
         if not aggs:
             raise SqlError("GROUP BY requires at least one aggregate in SELECT")
-        df = df.group_by(*q.group_by).agg(**aggs) if q.group_by else df.agg(**aggs)
-        keyed = {k: k for k in df.plan.output_columns}
-        missing = [c for c in out_order if c not in keyed]
+        df = df.group_by(*group_keys).agg(**aggs) if group_keys else df.agg(**aggs)
+        missing = [c for c in out_order if c not in df.plan.output_columns]
         if missing:
             raise SqlError(f"Unknown output columns {missing}")
         df = df.select(*out_order)
     elif q.items is not None:
         names = []
         for it in q.items:
-            names.append(_resolve_select_name(it.name, df, aliases))
+            name = _resolve_select_name(it.name, df, aliases)
+            names.append(name)
+            if it.alias:
+                renames[name] = it.alias
         df = df.select(*names)
-        # aliases on plain projections are not renamed (the IR has no rename
-        # node); keep SQL output names = source names
+
+    if renames:
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.logical import Rename
+
+        df = DataFrame(Rename(renames, df.plan), df.session)
 
     if q.order_by:
-        df = df.order_by(*[n for n, _ in q.order_by], ascending=[a for _, a in q.order_by])
+        inverse = {v: k for k, v in renames.items()}
+        out_cols = df.plan.output_columns
+
+        def order_key(name: str) -> str:
+            n = resolve_ref(name)
+            if n in out_cols:
+                return n
+            if renames.get(n) in out_cols:  # ORDER BY source name after AS
+                return renames[n]
+            if inverse.get(n):
+                return n
+            return n
+
+        df = df.order_by(*[order_key(n) for n, _ in q.order_by], ascending=[a for _, a in q.order_by])
     if q.limit is not None:
         df = df.limit(q.limit)
     return df
 
 
-def _resolve_side(a: str, b: str, right_alias: str, aliases, left_cols, right_cols) -> Tuple[str, str]:
+def _make_ref_resolver(df, aliases):
+    """Resolve a possibly table-qualified name against the planned frame:
+    ``alias.col`` strips the qualifier, mapping right-side duplicates to
+    their ``#r`` column; unqualified (or nested-path) names pass through."""
+    cols_ = df.plan.output_columns
+
+    def resolve(name: str) -> str:
+        if "." in name:
+            qual, rest = name.split(".", 1)
+            if qual.lower() in aliases:
+                if aliases[qual.lower()] == "right" and f"{rest}#r" in cols_:
+                    return f"{rest}#r"
+                return rest
+        return name
+
+    return resolve
+
+
+def _resolve_expr_refs(e: Expr, resolve) -> Expr:
+    from hyperspace_tpu.plan.expr import rewrite_columns
+
+    mapping = {}
+    for ref in e.references():
+        resolved = resolve(ref)
+        if resolved != ref:
+            mapping[ref] = resolved
+    return rewrite_columns(e, mapping) if mapping else e
+
+
+def _resolve_side(a: str, b: str, right_alias: str, aliases, left_cols) -> Tuple[str, str]:
     """Order an ON pair as (left column, right column) using qualifiers when
     present, else membership."""
 
@@ -491,5 +545,4 @@ def _resolve_select_name(name: str, df, aliases) -> str:
 
 
 def run_sql(text: str, session) -> "DataFrame":  # noqa: F821
-    views = session._temp_views
-    return plan_query(parse(text), views, session)
+    return plan_query(parse(text), session._temp_views)

@@ -305,5 +305,10 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
     if isinstance(plan, L.Limit):
         (child,) = plan.children()
         return plan.with_children([prune_columns(child, needed)])
+    if isinstance(plan, L.Rename):
+        inverse = {v: k for k, v in plan.mapping.items()}
+        child_needed = None if needed is None else {inverse.get(c, c) for c in needed}
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, child_needed)])
     # unknown node: keep children un-pruned (safe)
     return plan

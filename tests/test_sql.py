@@ -189,3 +189,48 @@ class TestSqlErrors:
         session.read_parquet(str(root)).create_or_replace_temp_view("esc")
         got = session.sql("SELECT v FROM esc WHERE s = 'it''s'").collect()
         assert got["v"].tolist() == [1]
+
+
+class TestSqlAliasesAndQualifiers:
+    def test_plain_projection_alias_renames(self, session, views):
+        got = session.sql("SELECT region AS zone, amount FROM sales LIMIT 4").collect()
+        assert set(got.keys()) == {"zone", "amount"}
+
+    def test_group_key_alias(self, session, views):
+        got = session.sql(
+            "SELECT region AS zone, SUM(amount) AS total FROM sales GROUP BY region"
+        ).collect()
+        assert set(got.keys()) == {"zone", "total"}
+
+    def test_order_by_alias(self, session, views):
+        got = session.sql(
+            "SELECT amount AS amt FROM sales ORDER BY amt DESC LIMIT 3"
+        ).collect()
+        assert np.all(np.diff(got["amt"]) <= 0)
+
+    def test_qualified_where_binds_right_side(self, session, tmp_path):
+        """The standard anti-join shape: WHERE right.col IS NULL must test
+        the RIGHT side's (possibly '#r'-renamed) column, not the left twin."""
+        lroot, rroot = tmp_path / "aj_l", tmp_path / "aj_r"
+        lroot.mkdir(), rroot.mkdir()
+        pq.write_table(
+            pa.table({"k": np.array([1, 2, 3], dtype=np.int64), "v": np.array([10, 20, 30], dtype=np.int64)}),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table({"k": np.array([1, 2], dtype=np.int64), "v": np.array([100, 200], dtype=np.int64)}),
+            rroot / "p.parquet",
+        )
+        session.read_parquet(str(lroot)).create_or_replace_temp_view("aj_l")
+        session.read_parquet(str(rroot)).create_or_replace_temp_view("aj_r")
+        got = session.sql(
+            "SELECT l.k FROM aj_l l LEFT JOIN aj_r r ON l.k = r.k WHERE r.v IS NULL"
+        ).collect()
+        assert got["k"].tolist() == [3]
+
+    def test_qualified_group_and_order(self, session, views):
+        got = session.sql(
+            "SELECT s.region, COUNT(*) AS n FROM sales s GROUP BY s.region ORDER BY s.region"
+        ).collect()
+        assert got["region"].shape[0] == 8
+        assert list(got["region"]) == sorted(got["region"])
