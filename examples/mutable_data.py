@@ -85,8 +85,7 @@ def main() -> None:
 
 
 def stats_count(hs) -> int:
-    entry = hs._manager.get_index("ordersByStatus")
-    return len(entry.content.files)
+    return len(hs.index("ordersByStatus")["indexContentPaths"])
 
 
 if __name__ == "__main__":

@@ -25,7 +25,7 @@ def main() -> None:
     delta = os.path.join(root, "events")
     rng = np.random.default_rng(0)
 
-    # two delta commits: time-ordered event batches, so per-file MinMax
+    # four delta commits: time-ordered event batches, so per-file MinMax
     # ranges on `ts_bucket` are disjoint and skipping prunes hard
     for day in range(4):
         n = 50_000

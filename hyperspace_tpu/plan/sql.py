@@ -453,20 +453,18 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
         from hyperspace_tpu.plan.dataframe import DataFrame
         from hyperspace_tpu.plan.logical import Rename
 
-        df = DataFrame(Rename(renames, df.plan), df.session)
+        try:
+            df = DataFrame(Rename(renames, df.plan), df.session)
+        except ValueError as e:  # e.g. alias collides with another column
+            raise SqlError(f"Invalid AS aliases: {e}")
 
     if q.order_by:
-        inverse = {v: k for k, v in renames.items()}
         out_cols = df.plan.output_columns
 
         def order_key(name: str) -> str:
             n = resolve_ref(name)
-            if n in out_cols:
-                return n
-            if renames.get(n) in out_cols:  # ORDER BY source name after AS
-                return renames[n]
-            if inverse.get(n):
-                return n
+            if n not in out_cols and renames.get(n) in out_cols:
+                return renames[n]  # ORDER BY source name after AS
             return n
 
         df = df.order_by(*[order_key(n) for n, _ in q.order_by], ascending=[a for _, a in q.order_by])

@@ -234,3 +234,8 @@ class TestSqlAliasesAndQualifiers:
         ).collect()
         assert got["region"].shape[0] == 8
         assert list(got["region"]) == sorted(got["region"])
+
+
+def test_duplicate_alias_raises_sql_error(session, views):
+    with pytest.raises(SqlError, match="alias"):
+        session.sql("SELECT region AS amount, amount FROM sales")
