@@ -15,6 +15,7 @@ Supported grammar (case-insensitive keywords):
     [ [INNER|LEFT|RIGHT|FULL] [OUTER] JOIN <view> [alias] ON a = b [AND ...] ]*
     [WHERE <predicate>]
     [GROUP BY col [, col ...]]
+    [HAVING <predicate over aggregate outputs>]
     [ORDER BY col [ASC|DESC] [, ...]]
     [LIMIT n]
 
@@ -50,7 +51,7 @@ _TOKEN_RE = re.compile(
 )
 
 _KEYWORDS = {
-    "select", "from", "where", "group", "by", "order", "limit", "join", "on",
+    "select", "from", "where", "group", "by", "having", "order", "limit", "join", "on",
     "inner", "left", "right", "full", "outer", "and", "or", "not", "in", "is",
     "null", "between", "as", "asc", "desc", "date", "count", "sum", "min",
     "max", "avg",
@@ -162,6 +163,7 @@ class Query:
         self.joins: List[JoinClause] = []
         self.where: Optional[Expr] = None
         self.group_by: List[str] = []
+        self.having: Optional[Expr] = None
         self.order_by: List[Tuple[str, bool]] = []
         self.limit: Optional[int] = None
 
@@ -197,6 +199,8 @@ def parse(text: str) -> Query:
         q.group_by = [p.expect_ident()]
         while p.accept_op(","):
             q.group_by.append(p.expect_ident())
+    if p.accept_kw("having"):
+        q.having = _parse_or(p)
     if p.accept_kw("order"):
         p.expect_kw("by")
         q.order_by = [_parse_order_item(p)]
@@ -359,10 +363,28 @@ def _parse_factor(p: _Parser) -> Expr:
     t = p.peek()
     if t is None:
         raise SqlError("Unexpected end of expression")
+    if t[0] == "kw" and t[1] in _AGG_FNS and p.peek(1) == ("op", "("):
+        # aggregate call in a predicate (HAVING COUNT(*) > 1): reference the
+        # aggregate's canonical output name; plan_query maps it to the actual
+        # (possibly aliased) output column
+        fn = p.next()[1]
+        p.expect_op("(")
+        if p.accept_op("*"):
+            arg = None
+            if fn != "count":
+                raise SqlError(f"{fn.upper()}(*) is not valid")
+        else:
+            arg = p.expect_ident()
+        p.expect_op(")")
+        return col(_canonical_agg_name(fn, arg))
     if t[0] == "ident":
         p.i += 1
         return col(t[1])  # qualifiers resolve at plan time (alias map needed)
     return lit(_parse_literal_value(p))
+
+
+def _canonical_agg_name(fn: str, arg: Optional[str]) -> str:
+    return f"{fn}({_strip_qualifier(arg)})" if arg is not None else "count"
 
 
 def _parse_literal_value(p: _Parser) -> Any:
@@ -413,19 +435,24 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
 
     renames: Dict[str, str] = {}
     agg_items = [it for it in (q.items or []) if it.agg is not None]
+    if q.having is not None and not (agg_items or q.group_by):
+        raise SqlError("HAVING requires GROUP BY or aggregates in SELECT")
     if agg_items or q.group_by:
         if q.items is None:
             raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
         group_keys = [resolve_ref(g) for g in q.group_by]
         aggs = {}
         out_order: List[str] = []
+        canonical_out: Dict[str, str] = {}  # canonical agg name -> output name
         for it in q.items:
             if it.agg is not None:
                 fn, arg = it.agg
                 arg = resolve_ref(arg) if arg is not None else None
-                name = it.alias or (f"{fn}({arg})" if arg else "count")
+                canonical = _canonical_agg_name(fn, arg)
+                name = it.alias or canonical
                 aggs[name] = (arg if arg is not None else "*", fn)
                 out_order.append(name)
+                canonical_out.setdefault(canonical, name)
             else:
                 plain = resolve_ref(it.name)
                 if plain.lower() not in {g.lower() for g in group_keys}:
@@ -436,6 +463,14 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
         if not aggs:
             raise SqlError("GROUP BY requires at least one aggregate in SELECT")
         df = df.group_by(*group_keys).agg(**aggs) if group_keys else df.agg(**aggs)
+        if q.having is not None:
+            # HAVING COUNT(*) parses to the canonical agg name; map it onto
+            # the actual (possibly aliased) output column
+            def resolve_having(name: str) -> str:
+                r = resolve_ref(name)
+                return canonical_out.get(r, r)
+
+            df = df.filter(_resolve_expr_refs(q.having, resolve_having))
         missing = [c for c in out_order if c not in df.plan.output_columns]
         if missing:
             raise SqlError(f"Unknown output columns {missing}")

@@ -169,7 +169,11 @@ class TestSqlErrors:
 
     def test_trailing_garbage(self, session, views):
         with pytest.raises(SqlError, match="trailing"):
-            session.sql("SELECT * FROM sales HAVING x")
+            session.sql("SELECT * FROM sales WINDOW w")
+
+    def test_having_without_group_by_raises(self, session, views):
+        with pytest.raises(SqlError, match="HAVING"):
+            session.sql("SELECT * FROM sales HAVING user > 1")
 
     def test_parse_shapes(self):
         q = parse("SELECT a, SUM(b) AS s FROM t GROUP BY a ORDER BY a DESC LIMIT 3")
@@ -239,3 +243,24 @@ class TestSqlAliasesAndQualifiers:
 def test_duplicate_alias_raises_sql_error(session, views):
     with pytest.raises(SqlError, match="alias"):
         session.sql("SELECT region AS amount, amount FROM sales")
+
+
+def test_having_filters_groups(session, views):
+    got = session.sql(
+        "SELECT region, COUNT(*) AS n FROM sales GROUP BY region HAVING n > 70 ORDER BY region"
+    ).collect()
+    assert np.all(got["n"] > 70)
+    full = session.sql("SELECT region, COUNT(*) AS n FROM sales GROUP BY region").collect()
+    assert got["n"].shape[0] == int((full["n"] > 70).sum())
+
+
+def test_having_with_aggregate_call(session, views):
+    got = session.sql(
+        "SELECT region, COUNT(*) AS n FROM sales GROUP BY region HAVING COUNT(*) > 70"
+    ).collect()
+    assert np.all(got["n"] > 70) and got["n"].shape[0] > 0
+    # unaliased aggregate referenced by canonical name too
+    got2 = session.sql(
+        "SELECT region, SUM(amount) FROM sales GROUP BY region HAVING SUM(amount) > 3000"
+    ).collect()
+    assert "sum(amount)" in got2 and np.all(got2["sum(amount)"] > 3000)
