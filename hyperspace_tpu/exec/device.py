@@ -964,10 +964,13 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     return host_bucketed_join(session, plan, _compat=compat, _setup=setup)
 
 
-def _bucketed_join_setup(session, plan: L.Join, compat=None):
+def _bucketed_join_setup(session, plan: L.Join, compat=None, needed_override=None):
     """Shared validation + per-bucket decode for the bucketed SMJ paths.
 
-    Returns (lbuckets, rbuckets, lkey, rkey, nb, lcols_needed, rcols_needed).
+    Returns (lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed,
+    rcols_needed). ``needed_override`` = (left cols, right cols) replaces the
+    join-output-derived column need (the fused aggregate reads only its
+    inputs, not the join's full output).
     """
     if compat is None:
         compat = join_sides_compatible(plan)
@@ -977,10 +980,14 @@ def _bucketed_join_setup(session, plan: L.Join, compat=None):
     if plan.how not in ("inner", "left", "right", "outer"):
         raise DeviceUnsupported(f"unsupported join type {plan.how!r}")
 
-    # decode only the columns the join output (plus keys) needs
-    needed = set(plan.output_columns) | {n[:-2] for n in plan.output_columns if n.endswith("#r")}
-    lcols_needed = [c for c in lside.output_columns if c in needed or c in lkeys]
-    rcols_needed = [c for c in rside.output_columns if c in needed or c in rkeys]
+    # decode only the columns the consumer (plus keys) needs
+    if needed_override is not None:
+        need_l, need_r = set(needed_override[0]), set(needed_override[1])
+    else:
+        needed = set(plan.output_columns) | {n[:-2] for n in plan.output_columns if n.endswith("#r")}
+        need_l = need_r = needed
+    lcols_needed = [c for c in lside.output_columns if c in need_l or c in lkeys]
+    rcols_needed = [c for c in rside.output_columns if c in need_r or c in rkeys]
     lbuckets = _side_buckets(session, lside, lcols_needed, lkeys)
     rbuckets = _side_buckets(session, rside, rcols_needed, rkeys)
     nb = _side_bucket_spec(lside).num_buckets
@@ -1213,16 +1220,13 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
 
 
-def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Batch:
-    """The same shuffle-free bucketed SMJ with spans computed host-side over
-    the pre-sorted runs. Single int64-comparable keys feed the native merge
-    walk directly; composite and string keys are first encoded per bucket
-    into shared dense int64 ranks (order-preserving across both sides), then
-    use the identical span machinery. Used below the device-dispatch row
-    threshold and for every key shape the device program doesn't cover."""
-    lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = (
-        _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
-    )
+def _make_host_span_of(session, plan: L.Join, setup, compat):
+    """Build ``span_of(b) -> (lo, hi)`` over the pre-sorted per-bucket runs.
+    Single int64-comparable keys feed the native merge walk directly;
+    composite and string keys are first encoded per bucket into shared dense
+    int64 ranks (order-preserving across both sides), cached across queries
+    on the sides' immutable file + filter identity."""
+    lbuckets, rbuckets, lkeys, rkeys, _nb, _lc, _rc = setup
 
     single_int = len(lkeys) == 1
     lkeys_by_bucket: Dict[int, np.ndarray] = {}
@@ -1236,11 +1240,7 @@ def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Ba
         except DeviceUnsupported:
             single_int = False
     if not single_int:
-        # rank-encode composite/string keys per bucket (both sides together,
-        # so equal tuples share a rank). The encoding depends only on the
-        # sides' immutable files + key names, so it is cached across queries
-        # (string factorization dominated repeated composite joins otherwise).
-        lside, rside = (_compat or join_sides_compatible(plan))[:2]
+        lside, rside = (compat or join_sides_compatible(plan))[:2]
         cache_key = _rank_cache_key(lside, rside, lkeys, rkeys)
         cached = _RANK_CACHE.get(cache_key) if cache_key is not None else None
         if cached is not None:
@@ -1269,4 +1269,176 @@ def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Ba
         except native.NativeUnsupported:
             return np.searchsorted(rk, lk, side="left"), np.searchsorted(rk, lk, side="right")
 
+    return span_of
+
+
+def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Batch:
+    """The shuffle-free bucketed SMJ with spans computed host-side. Used
+    below the device-dispatch row threshold and for every key shape the
+    device program doesn't cover."""
+    setup = _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
+    lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = setup
+    span_of = _make_host_span_of(session, plan, setup, _compat)
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
+
+
+def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.Batch:
+    """Global aggregates over a compatible bucketed inner join WITHOUT
+    materializing the pair expansion: per bucket, the [lo, hi) match spans
+    give each left row's multiplicity, so sums become weighted sums and
+    right-side sums become prefix-sum differences — O(n+m) per bucket instead
+    of O(pairs). Integer sums stay exact (per-bucket int64 dot products with
+    overflow guards, accumulated in Python ints). Raises DeviceUnsupported
+    for shapes it can't fuse (grouped aggregates, outer joins, min/max of
+    right-side columns, non-numeric inputs, overflow-risk int sums); the
+    caller then materializes.
+
+    This is TPU-framework-specific: the reference delegates aggregation to
+    Spark above its rewritten scans."""
+    if agg.keys:
+        raise DeviceUnsupported("fused join-aggregate covers global aggregates")
+    if join.how != "inner":
+        raise DeviceUnsupported("fused join-aggregate covers inner joins")
+    compat = join_sides_compatible(join)
+    if compat is None:
+        raise DeviceUnsupported("join sides are not compatible bucketed scans")
+    lside, rside, lkeys, rkeys = compat
+
+    # which side does each aggregate input column come from?
+    lcols = set(lside.output_columns)
+    rcols = set(rside.output_columns)
+
+    def side_of(col_name: str):
+        if col_name.endswith("#r") and col_name[:-2] in rcols:
+            return "right", col_name[:-2]
+        if col_name in lcols:
+            return "left", col_name
+        if col_name in rcols:
+            return "right", col_name
+        raise DeviceUnsupported(f"aggregate input {col_name!r} not on either join side")
+
+    plans = []
+    need_l, need_r = set(), set()
+    for name, fn, col_name in agg.aggs:
+        if fn == "count" and col_name is None:
+            plans.append((name, "count*", None, None))
+            continue
+        side, src = side_of(col_name)
+        if fn in ("min", "max") and side == "right":
+            # would need segment min over covered spans; not worth it here
+            raise DeviceUnsupported("min/max of a right-side column -> materialize")
+        plans.append((name, fn, side, src))
+        (need_l if side == "left" else need_r).add(src)
+
+    # decode only keys + needed inputs
+    setup = _bucketed_join_setup(
+        session, join, compat, needed_override=(sorted(need_l), sorted(need_r))
+    )
+    lbuckets, rbuckets, _lk, _rk, nb, _lc, _rc = setup
+    span_of = _make_host_span_of(session, join, setup, compat)
+
+    INT_GUARD = 2 ** 62
+
+    def column_stats(arr: np.ndarray):
+        """(values in native dtype, non-null mask, is_int)."""
+        if arr.dtype.kind in ("i", "u", "b"):
+            return arr.astype(np.int64, copy=False), None, True
+        if arr.dtype.kind == "f":
+            return arr, ~np.isnan(arr), False
+        raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
+
+    total_pairs = 0
+    acc = {name: {"sum": 0, "cnt": 0, "min": None, "max": None} for name, *_ in plans}
+    is_int_out = {name: True for name, *_ in plans}
+    for b in range(nb):
+        lb, rb = lbuckets.get(b), rbuckets.get(b)
+        if lb is None or rb is None:
+            continue
+        ll, rr = B.num_rows(lb), B.num_rows(rb)
+        if ll == 0 or rr == 0:
+            continue
+        lo, hi = span_of(b)
+        lo_i = np.asarray(lo, dtype=np.int64)
+        hi_i = np.asarray(hi, dtype=np.int64)
+        counts = hi_i - lo_i
+        bucket_pairs = int(counts.sum())
+        total_pairs += bucket_pairs
+        if bucket_pairs == 0:
+            continue
+
+        # per-(side, column) encodings + prefix sums, shared by every
+        # aggregate reading that column in this bucket
+        col_cache: Dict[Tuple[str, str], tuple] = {}
+
+        def col_info(side: str, src: str):
+            got = col_cache.get((side, src))
+            if got is not None:
+                return got
+            arr = (lb if side == "left" else rb)[src]
+            vals, ok, is_int = column_stats(arr)
+            pref = prefn = None
+            if side == "right":
+                if is_int:
+                    if vals.size and int(np.abs(vals).max()) * vals.size >= INT_GUARD:
+                        raise DeviceUnsupported("int sum overflow risk -> materialize")
+                    pref = np.concatenate([[0], np.cumsum(vals)])
+                else:
+                    pref = np.concatenate([[0.0], np.cumsum(np.where(ok, vals, 0.0))])
+                nn = np.ones(vals.shape[0], dtype=np.int64) if ok is None else ok.astype(np.int64)
+                prefn = np.concatenate([[0], np.cumsum(nn)])
+            got = (vals, ok, is_int, pref, prefn)
+            col_cache[(side, src)] = got
+            return got
+
+        for name, fn, side, src in plans:
+            a = acc[name]
+            if fn == "count*":
+                continue
+            vals, ok, is_int, pref, prefn = col_info(side, src)
+            if not is_int:
+                is_int_out[name] = False
+            if side == "left":
+                w = counts if ok is None else counts * ok
+                if fn in ("sum", "avg"):
+                    if is_int:
+                        if vals.size and int(np.abs(vals).max()) * bucket_pairs >= INT_GUARD:
+                            raise DeviceUnsupported("int sum overflow risk -> materialize")
+                        a["sum"] += int(np.dot(vals, counts))
+                    else:
+                        a["sum"] += float(np.dot(np.where(ok, vals, 0.0), counts))
+                    a["cnt"] += int(w.sum())
+                elif fn == "count":
+                    a["cnt"] += int(w.sum())
+                else:  # min/max over rows that matched at least once
+                    sel = (counts > 0) if ok is None else (ok & (counts > 0))
+                    if sel.any():
+                        mn, mx = vals[sel].min(), vals[sel].max()
+                        a["min"] = mn if a["min"] is None else min(a["min"], mn)
+                        a["max"] = mx if a["max"] is None else max(a["max"], mx)
+            else:
+                if fn in ("sum", "avg"):
+                    span_sum = (pref[hi_i] - pref[lo_i]).sum()
+                    a["sum"] += int(span_sum) if is_int else float(span_sum)
+                    a["cnt"] += int((prefn[hi_i] - prefn[lo_i]).sum())
+                elif fn == "count":
+                    a["cnt"] += int((prefn[hi_i] - prefn[lo_i]).sum())
+
+    out: B.Batch = {}
+    for name, fn, side, src in plans:
+        a = acc[name]
+        if fn == "count*":
+            out[name] = np.asarray([total_pairs])
+        elif fn == "count":
+            out[name] = np.asarray([a["cnt"]])
+        elif fn == "sum":
+            # pandas: sum of an all-null/empty series is 0; int inputs stay int
+            out[name] = np.asarray([a["sum"]], dtype=np.int64 if is_int_out[name] else np.float64)
+        elif fn == "avg":
+            out[name] = np.asarray([a["sum"] / a["cnt"] if a["cnt"] else np.nan])
+        elif fn == "min":
+            v = a["min"]
+            out[name] = np.asarray([np.nan if v is None else v])
+        else:
+            v = a["max"]
+            out[name] = np.asarray([np.nan if v is None else v])
+    return out

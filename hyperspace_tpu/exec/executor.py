@@ -327,6 +327,18 @@ class Executor:
         # program over HBM-resident columns; only scalars transfer back
         child = None
         if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
+            # fused aggregate over a bucketed join: spans give each pair's
+            # multiplicity, so no join output is ever materialized
+            join_node = plan.child
+            while isinstance(join_node, L.Project):
+                join_node = join_node.child
+            if isinstance(join_node, L.Join):
+                from hyperspace_tpu.exec import device as D
+
+                try:
+                    return D.aggregate_over_bucketed_join(self.session, plan, join_node)
+                except D.DeviceUnsupported:
+                    pass
             got, scan_batch, filter_node = self._try_device_aggregate(plan)
             if got is not None:
                 return got

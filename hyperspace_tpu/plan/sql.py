@@ -193,6 +193,7 @@ def parse(text: str) -> Query:
             on.append(_parse_on_eq(p))
         q.joins.append(JoinClause(view, alias, how, on))
     if p.accept_kw("where"):
+        p.allow_agg = False
         q.where = _parse_or(p)
     if p.accept_kw("group"):
         p.expect_kw("by")
@@ -200,7 +201,9 @@ def parse(text: str) -> Query:
         while p.accept_op(","):
             q.group_by.append(p.expect_ident())
     if p.accept_kw("having"):
+        p.allow_agg = True
         q.having = _parse_or(p)
+        p.allow_agg = False
     if p.accept_kw("order"):
         p.expect_kw("by")
         q.order_by = [_parse_order_item(p)]
@@ -364,6 +367,8 @@ def _parse_factor(p: _Parser) -> Expr:
     if t is None:
         raise SqlError("Unexpected end of expression")
     if t[0] == "kw" and t[1] in _AGG_FNS and p.peek(1) == ("op", "("):
+        if not getattr(p, "allow_agg", False):
+            raise SqlError(f"Aggregate {t[1].upper()}() is not allowed in WHERE; use HAVING")
         # aggregate call in a predicate (HAVING COUNT(*) > 1): reference the
         # aggregate's canonical output name; plan_query maps it to the actual
         # (possibly aliased) output column
@@ -470,7 +475,15 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
                 r = resolve_ref(name)
                 return canonical_out.get(r, r)
 
-            df = df.filter(_resolve_expr_refs(q.having, resolve_having))
+            having = _resolve_expr_refs(q.having, resolve_having)
+            unknown = sorted(set(having.references()) - set(df.plan.output_columns))
+            if unknown:
+                raise SqlError(
+                    f"HAVING references {unknown}, which are not among the "
+                    f"aggregate outputs {df.plan.output_columns}; add the "
+                    "aggregate to SELECT or alias it"
+                )
+            df = df.filter(having)
         missing = [c for c in out_order if c not in df.plan.output_columns]
         if missing:
             raise SqlError(f"Unknown output columns {missing}")

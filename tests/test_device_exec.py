@@ -752,3 +752,94 @@ def test_outer_join_duration_payload_nulls(session, tmp_path):
     got = ldf.join(rdf, on="k", how="left").select("a", "dur").collect()
     assert got["a"].shape[0] == 2
     assert np.isnat(got["dur"]).sum() == 1
+
+
+class TestFusedJoinAggregate:
+    """Global aggregates over a bucketed join compute from match spans
+    without materializing the pair expansion; results must equal the
+    materialize-then-aggregate path exactly."""
+
+    @pytest.fixture()
+    def agg_env(self, session, hs, tmp_path):
+        session.conf.set(hst.keys.NUM_BUCKETS, 8)
+        rng = np.random.default_rng(51)
+        lroot, rroot = tmp_path / "al", tmp_path / "ar"
+        lroot.mkdir(), rroot.mkdir()
+        n = 2000
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 100, n).astype(np.int64),
+                    "qty": rng.integers(1, 50, n).astype(np.int64),
+                    "price": rng.uniform(1, 100, n),
+                }
+            ),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 100, 300).astype(np.int64),
+                    "fx": rng.uniform(0.5, 1.5, 300),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("agL", ["k"], ["qty", "price"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("agR", ["k"], ["fx"]))
+        session.enable_hyperspace()
+        return ldf, rdf
+
+    def _check(self, session, q):
+        fused = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        plain = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        assert sorted(fused.keys()) == sorted(plain.keys())
+        for k in fused:
+            np.testing.assert_allclose(fused[k], plain[k], rtol=1e-9, err_msg=k)
+        return fused
+
+    def test_count_and_sums_both_sides(self, session, agg_env):
+        ldf, rdf = agg_env
+        j = ldf.join(rdf, on="k")
+        q = j.agg(n=("*", "count"), s_left=("price", "sum"), s_right=("fx", "sum"),
+                  m_left=("qty", "avg"), m_right=("fx", "avg"))
+        got = self._check(session, q)
+        assert int(got["n"][0]) > 0
+
+    def test_min_max_left(self, session, agg_env):
+        ldf, rdf = agg_env
+        q = ldf.join(rdf, on="k").agg(lo=("price", "min"), hi=("price", "max"))
+        self._check(session, q)
+
+    def test_min_right_falls_back(self, session, agg_env):
+        ldf, rdf = agg_env
+        q = ldf.join(rdf, on="k").agg(lo=("fx", "min"))
+        self._check(session, q)  # materialized fallback still correct
+
+    def test_fused_path_is_taken(self, session, agg_env):
+        from hyperspace_tpu.plan import logical as L
+
+        ldf, rdf = agg_env
+        q = ldf.join(rdf, on="k").agg(n=("*", "count"))
+        plan = q.optimized_plan()
+        joins = L.collect(plan, lambda p: isinstance(p, L.Join))
+        aggs = [p for p in L.collect(plan, lambda p: isinstance(p, L.Aggregate))]
+        got = D.aggregate_over_bucketed_join(session, aggs[0], joins[0])
+        expanded = D.dispatch_bucketed_join(session, joins[0])
+        assert int(got["n"][0]) == B.num_rows(expanded)
+
+    def test_empty_join_aggregates(self, session, hs, tmp_path):
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        lroot, rroot = tmp_path / "el", tmp_path / "er"
+        lroot.mkdir(), rroot.mkdir()
+        pq.write_table(pa.table({"k": np.array([1], dtype=np.int64), "v": np.array([1.0])}), lroot / "p.parquet")
+        pq.write_table(pa.table({"k": np.array([2], dtype=np.int64), "w": np.array([2.0])}), rroot / "p.parquet")
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("eL", ["k"], ["v"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("eR", ["k"], ["w"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on="k").agg(n=("*", "count"), s=("v", "sum"), m=("w", "avg"))
+        self._check(session, q)

@@ -264,3 +264,13 @@ def test_having_with_aggregate_call(session, views):
         "SELECT region, SUM(amount) FROM sales GROUP BY region HAVING SUM(amount) > 3000"
     ).collect()
     assert "sum(amount)" in got2 and np.all(got2["sum(amount)"] > 3000)
+
+
+def test_where_rejects_aggregates(session, views):
+    with pytest.raises(SqlError, match="not allowed in WHERE"):
+        session.sql("SELECT user FROM sales WHERE SUM(amount) > 10")
+
+
+def test_having_unknown_aggregate_is_plan_error(session, views):
+    with pytest.raises(SqlError, match="HAVING references"):
+        session.sql("SELECT region, COUNT(*) AS n FROM sales GROUP BY region HAVING SUM(amount) > 100")
