@@ -1341,15 +1341,31 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
 
     def column_stats(arr: np.ndarray):
         """(values in native dtype, non-null mask, is_int)."""
+        if arr.dtype.kind == "u" and arr.dtype.itemsize == 8:
+            # uint64 >= 2^63 would wrap negative under int64 — materialize
+            raise DeviceUnsupported("uint64 aggregate input -> materialize")
         if arr.dtype.kind in ("i", "u", "b"):
             return arr.astype(np.int64, copy=False), None, True
         if arr.dtype.kind == "f":
             return arr, ~np.isnan(arr), False
         raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
 
+    def declared_is_int(side: str, src: str) -> bool:
+        # dtype from ANY decoded bucket, so the output dtype is right even
+        # when no bucket has matches (empty-join sum must stay float for
+        # float inputs, matching the materialized path)
+        for batch in (lbuckets if side == "left" else rbuckets).values():
+            if src in batch:
+                _v, _ok, is_int = column_stats(batch[src])
+                return is_int
+        raise DeviceUnsupported(f"aggregate input {src!r} has no decoded bucket")
+
     total_pairs = 0
     acc = {name: {"sum": 0, "cnt": 0, "min": None, "max": None} for name, *_ in plans}
-    is_int_out = {name: True for name, *_ in plans}
+    is_int_out = {
+        name: (declared_is_int(side, src) if side is not None else True)
+        for name, fn, side, src in plans
+    }
     for b in range(nb):
         lb, rb = lbuckets.get(b), rbuckets.get(b)
         if lb is None or rb is None:
@@ -1395,8 +1411,6 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             if fn == "count*":
                 continue
             vals, ok, is_int, pref, prefn = col_info(side, src)
-            if not is_int:
-                is_int_out[name] = False
             if side == "left":
                 w = counts if ok is None else counts * ok
                 if fn in ("sum", "avg"):
@@ -1432,6 +1446,10 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             out[name] = np.asarray([a["cnt"]])
         elif fn == "sum":
             # pandas: sum of an all-null/empty series is 0; int inputs stay int
+            if is_int_out[name] and abs(a["sum"]) >= 2 ** 63:
+                # exact Python-int total exceeds int64 across buckets: the
+                # materialized path defines the (wrapping/float) behavior
+                raise DeviceUnsupported("int sum exceeds int64 -> materialize")
             out[name] = np.asarray([a["sum"]], dtype=np.int64 if is_int_out[name] else np.float64)
         elif fn == "avg":
             out[name] = np.asarray([a["sum"] / a["cnt"] if a["cnt"] else np.nan])

@@ -90,6 +90,15 @@ class DataFrame:
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(L.Limit(n, self.plan), self.session)
 
+    def distinct(self) -> "DataFrame":
+        """Distinct rows over all output columns (grouped aggregation with
+        the helper count projected away)."""
+        cols = list(self.plan.output_columns)
+        agg = L.Aggregate(cols, [("__distinct_count", "count", None)], self.plan)
+        return DataFrame(L.Project(cols, agg), self.session)
+
+    dropDuplicates = drop_duplicates = distinct
+
     def as_scalar(self) -> Expr:
         """This one-column frame as a scalar-subquery expression, usable as a
         comparison operand: ``df.filter(col("a") == other.select("b").as_scalar())``.

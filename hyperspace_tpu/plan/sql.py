@@ -10,7 +10,7 @@ whyNot surface applies to SQL queries unchanged.
 
 Supported grammar (case-insensitive keywords):
 
-    SELECT <*| item [, item ...]>
+    SELECT [DISTINCT] <*| item [, item ...]>
     FROM <view> [AS] [alias]
     [ [INNER|LEFT|RIGHT|FULL] [OUTER] JOIN <view> [alias] ON a = b [AND ...] ]*
     [WHERE <predicate>]
@@ -51,7 +51,7 @@ _TOKEN_RE = re.compile(
 )
 
 _KEYWORDS = {
-    "select", "from", "where", "group", "by", "having", "order", "limit", "join", "on",
+    "select", "distinct", "from", "where", "group", "by", "having", "order", "limit", "join", "on",
     "inner", "left", "right", "full", "outer", "and", "or", "not", "in", "is",
     "null", "between", "as", "asc", "desc", "date", "count", "sum", "min",
     "max", "avg",
@@ -158,6 +158,7 @@ class JoinClause:
 class Query:
     def __init__(self):
         self.items: Optional[List[SelectItem]] = None  # None = SELECT *
+        self.distinct = False
         self.table = ""
         self.alias = ""
         self.joins: List[JoinClause] = []
@@ -172,6 +173,7 @@ def parse(text: str) -> Query:
     p = _Parser(_tokenize(text))
     q = Query()
     p.expect_kw("select")
+    q.distinct = p.accept_kw("distinct") is not None
     if p.accept_op("*"):
         q.items = None
     else:
@@ -496,6 +498,11 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
             if it.alias:
                 renames[name] = it.alias
         df = df.select(*names)
+
+    if q.distinct:
+        if agg_items or q.group_by:
+            raise SqlError("SELECT DISTINCT cannot be combined with GROUP BY/aggregates")
+        df = df.distinct()
 
     if renames:
         from hyperspace_tpu.plan.dataframe import DataFrame

@@ -843,3 +843,50 @@ class TestFusedJoinAggregate:
         session.enable_hyperspace()
         q = ldf.join(rdf, on="k").agg(n=("*", "count"), s=("v", "sum"), m=("w", "avg"))
         self._check(session, q)
+
+
+def test_executor_routes_aggregate_through_fused_path(session, tmp_path, monkeypatch):
+    """The executor wiring (not just the device function) must dispatch
+    Aggregate-over-Join to the fused path."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 4)
+    lroot, rroot = tmp_path / "wl", tmp_path / "wr"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(pa.table({"k": np.arange(50, dtype=np.int64), "v": np.arange(50, dtype=np.float64)}), lroot / "p.parquet")
+    pq.write_table(pa.table({"k": np.arange(50, dtype=np.int64), "w": np.arange(50, dtype=np.float64)}), rroot / "p.parquet")
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("wL", ["k"], ["v"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("wR", ["k"], ["w"]))
+    session.enable_hyperspace()
+    calls = {"n": 0}
+    real = D.aggregate_over_bucketed_join
+
+    def counting(sess_, agg_, join_):
+        calls["n"] += 1
+        return real(sess_, agg_, join_)
+
+    monkeypatch.setattr(D, "aggregate_over_bucketed_join", counting)
+    got = ldf.join(rdf, on="k").agg(s=("v", "sum")).collect()
+    assert calls["n"] == 1, "fused path was not taken by the executor"
+    assert got["s"][0] == float(np.arange(50).sum())
+
+
+def test_empty_join_float_sum_dtype(session, tmp_path):
+    """SUM of a float column over an empty join stays float64, matching the
+    materialized path."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    lroot, rroot = tmp_path / "fl2", tmp_path / "fr2"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(pa.table({"k": np.array([1], dtype=np.int64), "v": np.array([1.5])}), lroot / "p.parquet")
+    pq.write_table(pa.table({"k": np.array([2], dtype=np.int64), "w": np.array([2.5])}), rroot / "p.parquet")
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("fL2", ["k"], ["v"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("fR2", ["k"], ["w"]))
+    session.enable_hyperspace()
+    got = ldf.join(rdf, on="k").agg(s=("v", "sum")).collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    plain = ldf.join(rdf, on="k").agg(s=("v", "sum")).collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    assert got["s"].dtype == plain["s"].dtype == np.float64
+    assert got["s"][0] == plain["s"][0] == 0.0

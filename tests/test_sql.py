@@ -274,3 +274,17 @@ def test_where_rejects_aggregates(session, views):
 def test_having_unknown_aggregate_is_plan_error(session, views):
     with pytest.raises(SqlError, match="HAVING references"):
         session.sql("SELECT region, COUNT(*) AS n FROM sales GROUP BY region HAVING SUM(amount) > 100")
+
+
+def test_select_distinct(session, views):
+    got = session.sql("SELECT DISTINCT region FROM sales").collect()
+    assert sorted(got["region"]) == sorted({f"r{i}" for i in range(8)})
+    # dataframe surface too
+    sdf, _ = views
+    d = sdf.select("region").distinct().collect()
+    assert len(d["region"]) == 8
+
+
+def test_distinct_with_group_by_raises(session, views):
+    with pytest.raises(SqlError, match="DISTINCT"):
+        session.sql("SELECT DISTINCT region, COUNT(*) FROM sales GROUP BY region")
