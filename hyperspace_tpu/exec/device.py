@@ -1282,40 +1282,73 @@ def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Ba
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
 
 
+def _agg_side_of(lcols, rcols, col_name: str):
+    """Which join side an aggregate input column comes from (and its source
+    name there); '#r'-suffixed duplicates resolve to the right side."""
+    if col_name.endswith("#r") and col_name[:-2] in rcols:
+        return "right", col_name[:-2]
+    if col_name in lcols:
+        return "left", col_name
+    if col_name in rcols:
+        return "right", col_name
+    raise DeviceUnsupported(f"aggregate input {col_name!r} not on either join side")
+
+
+def _agg_column_stats(arr: np.ndarray):
+    """(values as int64/float64, non-null mask or None, is_int) for a fused
+    aggregate input; rejects dtypes the exact paths can't represent."""
+    if arr.dtype.kind == "u" and arr.dtype.itemsize == 8:
+        # uint64 >= 2^63 would wrap negative under int64 — materialize
+        raise DeviceUnsupported("uint64 aggregate input -> materialize")
+    if arr.dtype.kind in ("i", "u", "b"):
+        return arr.astype(np.int64, copy=False), None, True
+    if arr.dtype.kind == "f":
+        return arr, ~np.isnan(arr), False
+    raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
+
+
 def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.Batch:
     """Global aggregates over a compatible bucketed inner join WITHOUT
     materializing the pair expansion: per bucket, the [lo, hi) match spans
     give each left row's multiplicity, so sums become weighted sums and
     right-side sums become prefix-sum differences — O(n+m) per bucket instead
     of O(pairs). Integer sums stay exact (per-bucket int64 dot products with
-    overflow guards, accumulated in Python ints). Raises DeviceUnsupported
-    for shapes it can't fuse (grouped aggregates, outer joins, min/max of
-    right-side columns, non-numeric inputs, overflow-risk int sums); the
-    caller then materializes.
+    overflow guards, accumulated in Python ints). GROUP BY over exactly the
+    join keys fuses too (segment reductions over the sorted runs). Raises
+    DeviceUnsupported for shapes it can't fuse (other group keys, outer
+    joins, min/max of right-side columns, non-numeric inputs, overflow-risk
+    int sums); the caller then materializes.
 
     This is TPU-framework-specific: the reference delegates aggregation to
     Spark above its rewritten scans."""
-    if agg.keys:
-        raise DeviceUnsupported("fused join-aggregate covers global aggregates")
     if join.how != "inner":
         raise DeviceUnsupported("fused join-aggregate covers inner joins")
     compat = join_sides_compatible(join)
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed scans")
     lside, rside, lkeys, rkeys = compat
+    if agg.keys:
+        # grouped by exactly the join keys: the left runs ARE the groups
+        # (sorted per bucket), so per-group values come from segment
+        # reductions — still no pair materialization. Every join key must be
+        # covered exactly once (grouping by l.a and r.a of a composite join
+        # would silently group by the wrong granularity).
+        canonical = []
+        for k in agg.keys:
+            base = k[:-2] if k.endswith("#r") else k
+            if base in lkeys:
+                canonical.append(base)
+            elif base in rkeys:
+                canonical.append(lkeys[rkeys.index(base)])
+            else:
+                raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
+        if sorted(canonical) != sorted(lkeys):
+            raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
+        return _grouped_aggregate_over_join(session, agg, join, compat)
 
     # which side does each aggregate input column come from?
     lcols = set(lside.output_columns)
     rcols = set(rside.output_columns)
-
-    def side_of(col_name: str):
-        if col_name.endswith("#r") and col_name[:-2] in rcols:
-            return "right", col_name[:-2]
-        if col_name in lcols:
-            return "left", col_name
-        if col_name in rcols:
-            return "right", col_name
-        raise DeviceUnsupported(f"aggregate input {col_name!r} not on either join side")
 
     plans = []
     need_l, need_r = set(), set()
@@ -1323,7 +1356,7 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
         if fn == "count" and col_name is None:
             plans.append((name, "count*", None, None))
             continue
-        side, src = side_of(col_name)
+        side, src = _agg_side_of(lcols, rcols, col_name)
         if fn in ("min", "max") and side == "right":
             # would need segment min over covered spans; not worth it here
             raise DeviceUnsupported("min/max of a right-side column -> materialize")
@@ -1339,24 +1372,13 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
 
     INT_GUARD = 2 ** 62
 
-    def column_stats(arr: np.ndarray):
-        """(values in native dtype, non-null mask, is_int)."""
-        if arr.dtype.kind == "u" and arr.dtype.itemsize == 8:
-            # uint64 >= 2^63 would wrap negative under int64 — materialize
-            raise DeviceUnsupported("uint64 aggregate input -> materialize")
-        if arr.dtype.kind in ("i", "u", "b"):
-            return arr.astype(np.int64, copy=False), None, True
-        if arr.dtype.kind == "f":
-            return arr, ~np.isnan(arr), False
-        raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
-
     def declared_is_int(side: str, src: str) -> bool:
         # dtype from ANY decoded bucket, so the output dtype is right even
         # when no bucket has matches (empty-join sum must stay float for
         # float inputs, matching the materialized path)
         for batch in (lbuckets if side == "left" else rbuckets).values():
             if src in batch:
-                _v, _ok, is_int = column_stats(batch[src])
+                _v, _ok, is_int = _agg_column_stats(batch[src])
                 return is_int
         raise DeviceUnsupported(f"aggregate input {src!r} has no decoded bucket")
 
@@ -1391,7 +1413,7 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             if got is not None:
                 return got
             arr = (lb if side == "left" else rb)[src]
-            vals, ok, is_int = column_stats(arr)
+            vals, ok, is_int = _agg_column_stats(arr)
             pref = prefn = None
             if side == "right":
                 if is_int:
@@ -1459,4 +1481,171 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
         else:
             v = a["max"]
             out[name] = np.asarray([np.nan if v is None else v])
+    return out
+
+
+def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat) -> B.Batch:
+    """Per-join-key aggregates from segment reductions over each bucket's
+    sorted left run: run boundaries are key changes, per-run pair totals are
+    reduceat sums of span counts, and sums reduce count-weighted values
+    (left) or span prefix-sum differences (right). Inner-join semantics:
+    keys with no matches produce no output row."""
+    lside, rside, lkeys, rkeys = compat
+    lcols = set(lside.output_columns)
+    rcols = set(rside.output_columns)
+
+    plans = []
+    need_l, need_r = set(lkeys), set()
+    for name, fn, col_name in agg.aggs:
+        if fn == "count" and col_name is None:
+            plans.append((name, "count*", None, None))
+            continue
+        side, src = _agg_side_of(lcols, rcols, col_name)
+        if fn in ("min", "max"):
+            raise DeviceUnsupported("grouped min/max -> materialize")
+        plans.append((name, fn, side, src))
+        (need_l if side == "left" else need_r).add(src)
+
+    setup = _bucketed_join_setup(
+        session, join, compat, needed_override=(sorted(need_l), sorted(need_r))
+    )
+    lbuckets, rbuckets, _lk, _rk, nb, _lc, _rc = setup
+    span_of = _make_host_span_of(session, join, setup, compat)
+
+    INT_GUARD = 2 ** 62
+
+    # output key columns: requested name -> the left key column holding its
+    # values (right key values equal left's on matched rows)
+    key_source = {}
+    for k in agg.keys:
+        base = k[:-2] if k.endswith("#r") else k
+        key_source[k] = lkeys[lkeys.index(base)] if base in lkeys else lkeys[rkeys.index(base)]
+
+    out_keys: Dict[str, List[np.ndarray]] = {k: [] for k in agg.keys}
+    out_vals: Dict[str, List[np.ndarray]] = {name: [] for name, *_ in plans}
+    int_sum = {name: fn in ("sum",) for name, fn, *_ in plans}  # refined below
+
+    for b in range(nb):
+        lb, rb = lbuckets.get(b), rbuckets.get(b)
+        if lb is None or rb is None:
+            continue
+        ll, rr = B.num_rows(lb), B.num_rows(rb)
+        if ll == 0 or rr == 0:
+            continue
+        lo, hi = span_of(b)
+        lo_i = np.asarray(lo, dtype=np.int64)
+        hi_i = np.asarray(hi, dtype=np.int64)
+        counts = hi_i - lo_i
+
+        # run boundaries over the (sorted) left key columns
+        change = np.zeros(ll, dtype=bool)
+        change[0] = True
+        for kc in lkeys:
+            kv = _order_key_array(lb[kc])
+            change[1:] |= kv[1:] != kv[:-1]
+        starts = np.flatnonzero(change)
+        run_pairs = np.add.reduceat(counts, starts)
+        keep = run_pairs > 0  # inner join: unmatched keys drop out
+
+        if not keep.any():
+            continue
+
+        for k in agg.keys:
+            out_keys[k].append(lb[key_source[k]][starts][keep])
+
+        col_cache: Dict[Tuple[str, str], tuple] = {}
+
+        def col_info(side, src):
+            got = col_cache.get((side, src))
+            if got is not None:
+                return got
+            arr = (lb if side == "left" else rb)[src]
+            vals, ok, is_int = _agg_column_stats(arr)
+            if is_int and vals.size and int(np.abs(vals).max()) * max(int(counts.sum()), 1) >= INT_GUARD:
+                raise DeviceUnsupported("int sum overflow risk -> materialize")
+            got = (vals, ok, is_int)
+            col_cache[(side, src)] = got
+            return got
+
+        for name, fn, side, src in plans:
+            if fn == "count*":
+                out_vals[name].append(run_pairs[keep])
+                continue
+            vals, ok, is_int = col_info(side, src)
+            if not is_int:
+                int_sum[name] = False
+            if side == "left":
+                w = counts if ok is None else counts * ok
+                if fn == "count":
+                    out_vals[name].append(np.add.reduceat(w, starts)[keep])
+                else:  # sum / avg
+                    contrib = vals * counts if ok is None else np.where(ok, vals, 0) * counts
+                    sums = np.add.reduceat(contrib, starts)[keep]
+                    if fn == "sum":
+                        out_vals[name].append(sums)
+                    else:
+                        cnts = np.add.reduceat(w, starts)[keep]
+                        out_vals[name].append(
+                            np.divide(sums, cnts, out=np.full(sums.shape, np.nan), where=cnts > 0)
+                        )
+            else:
+                if ok is None:
+                    pref = np.concatenate([[0], np.cumsum(vals)])
+                    nn = np.ones(vals.shape[0], dtype=np.int64)
+                else:
+                    pref = np.concatenate([[0.0], np.cumsum(np.where(ok, vals, 0.0))])
+                    nn = ok.astype(np.int64)
+                prefn = np.concatenate([[0], np.cumsum(nn)])
+                row_sums = pref[hi_i] - pref[lo_i]
+                row_cnts = prefn[hi_i] - prefn[lo_i]
+                sums = np.add.reduceat(row_sums, starts)[keep]
+                cnts = np.add.reduceat(row_cnts, starts)[keep]
+                if fn == "sum":
+                    out_vals[name].append(sums)
+                elif fn == "count":
+                    out_vals[name].append(cnts)
+                else:
+                    out_vals[name].append(
+                        np.divide(
+                            sums.astype(np.float64),
+                            cnts,
+                            out=np.full(sums.shape, np.nan),
+                            where=cnts > 0,
+                        )
+                    )
+
+    def declared_dtype(side, src) -> np.dtype:
+        for batch in (lbuckets if side == "left" else rbuckets).values():
+            if src in batch:
+                return batch[src].dtype
+        raise DeviceUnsupported(f"aggregate input {src!r} has no decoded bucket")
+
+    out: B.Batch = {}
+    for k in agg.keys:
+        parts = out_keys[k]
+        out[k] = (
+            np.concatenate(parts)
+            if parts
+            else np.empty(0, dtype=declared_dtype("left", key_source[k]))
+        )
+    for name, fn, side, src in plans:
+        parts = out_vals[name]
+        if not parts:
+            if fn in ("count", "count*"):
+                dt = np.dtype(np.int64)
+            elif fn == "sum":
+                _v, _ok, is_int = _agg_column_stats(
+                    np.empty(0, dtype=declared_dtype(side, src))
+                )
+                dt = np.dtype(np.int64) if is_int else np.dtype(np.float64)
+            else:
+                dt = np.dtype(np.float64)
+            out[name] = np.empty(0, dtype=dt)
+            continue
+        merged = np.concatenate(parts)
+        if fn in ("count", "count*"):
+            merged = merged.astype(np.int64)
+        elif fn == "sum" and int_sum[name] and merged.dtype.kind != "f":
+            merged = merged.astype(np.int64)
+        out[name] = merged
     return out

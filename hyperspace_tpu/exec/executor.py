@@ -326,9 +326,10 @@ class Executor:
         # filtered) index/file scan: predicate + reductions run in one jitted
         # program over HBM-resident columns; only scalars transfer back
         child = None
-        if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
+        if not with_file_names and self.session.conf.device_execution_enabled:
             # fused aggregate over a bucketed join: spans give each pair's
-            # multiplicity, so no join output is ever materialized
+            # multiplicity, so no join output is ever materialized (global
+            # aggregates, or grouped by the join keys)
             join_node = plan.child
             while isinstance(join_node, L.Project):
                 join_node = join_node.child
@@ -339,6 +340,7 @@ class Executor:
                     return D.aggregate_over_bucketed_join(self.session, plan, join_node)
                 except D.DeviceUnsupported:
                     pass
+        if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
             got, scan_batch, filter_node = self._try_device_aggregate(plan)
             if got is not None:
                 return got

@@ -890,3 +890,138 @@ def test_empty_join_float_sum_dtype(session, tmp_path):
     session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
     assert got["s"].dtype == plain["s"].dtype == np.float64
     assert got["s"][0] == plain["s"][0] == 0.0
+
+
+class TestGroupedFusedJoinAggregate:
+    """GROUP BY the join key over a bucketed join fuses via segment
+    reductions; results must equal the materialize-then-groupby path
+    (compared as key->value maps — output order is not part of the
+    contract)."""
+
+    @pytest.fixture()
+    def genv(self, session, hs, tmp_path):
+        session.conf.set(hst.keys.NUM_BUCKETS, 8)
+        rng = np.random.default_rng(61)
+        lroot, rroot = tmp_path / "gl", tmp_path / "gr"
+        lroot.mkdir(), rroot.mkdir()
+        n = 3000
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 60, n).astype(np.int64),
+                    "qty": rng.integers(1, 9, n).astype(np.int64),
+                    "price": rng.uniform(1, 50, n),
+                }
+            ),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 80, 400).astype(np.int64),  # some keys unmatched
+                    "fx": rng.uniform(0.5, 1.5, 400),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("gL", ["k"], ["qty", "price"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("gR", ["k"], ["fx"]))
+        session.enable_hyperspace()
+        return ldf, rdf
+
+    def _maps(self, batch, keys=("k",)):
+        names = [c for c in batch if c not in keys]
+        out = {}
+        for i in range(len(batch[names[0]])):
+            kk = tuple(batch[k][i] for k in keys)
+            out[kk] = tuple(np.round(float(batch[n][i]), 6) for n in names)
+        return out
+
+    def test_grouped_parity(self, session, genv):
+        ldf, rdf = genv
+        q = ldf.join(rdf, on="k").group_by("k").agg(
+            n=("*", "count"), s=("price", "sum"), sq=("qty", "sum"),
+            a=("fx", "avg"), c=("fx", "count"))
+        fused = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        plain = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        assert self._maps(fused) == self._maps(plain)
+        assert fused["sq"].dtype == np.int64  # exact int sums
+
+    def test_grouped_path_is_taken(self, session, genv, monkeypatch):
+        ldf, rdf = genv
+        calls = {"n": 0}
+        real = D._grouped_aggregate_over_join
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(D, "_grouped_aggregate_over_join", counting)
+        ldf.join(rdf, on="k").group_by("k").agg(n=("*", "count")).collect()
+        assert calls["n"] == 1
+
+    def test_group_by_non_key_falls_back(self, session, genv):
+        ldf, rdf = genv
+        q = ldf.join(rdf, on="k").group_by("qty").agg(n=("*", "count"))
+        fused = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        plain = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        assert self._maps(fused, keys=("qty",)) == self._maps(plain, keys=("qty",))
+
+
+def test_grouped_fused_rejects_repeated_key(session, tmp_path):
+    """Grouping by l.a and r.a of a composite (a,b) join must NOT take the
+    fused path (wrong granularity); results equal the materialized path."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    rng = np.random.default_rng(71)
+    lroot, rroot = tmp_path / "rl2", tmp_path / "rr2"
+    lroot.mkdir(), rroot.mkdir()
+    n = 400
+    pq.write_table(
+        pa.table({"a": rng.integers(0, 5, n).astype(np.int64),
+                  "b": rng.integers(0, 5, n).astype(np.int64),
+                  "v": rng.standard_normal(n)}), lroot / "p.parquet")
+    pq.write_table(
+        pa.table({"a": rng.integers(0, 5, 60).astype(np.int64),
+                  "b": rng.integers(0, 5, 60).astype(np.int64),
+                  "w": rng.standard_normal(60)}), rroot / "p.parquet")
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("rkL", ["a", "b"], ["v"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("rkR", ["a", "b"], ["w"]))
+    session.enable_hyperspace()
+    j = ldf.join(rdf, on=["a", "b"])
+    q = j.group_by("a", "a#r").agg(n=("*", "count"))
+    fused = q.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    plain = q.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    assert fused["n"].shape == plain["n"].shape
+    a = {(x, y): int(c) for x, y, c in zip(fused["a"], fused["a#r"], fused["n"])}
+    b = {(x, y): int(c) for x, y, c in zip(plain["a"], plain["a#r"], plain["n"])}
+    assert a == b
+
+
+def test_grouped_fused_empty_join_dtypes(session, tmp_path):
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    lroot, rroot = tmp_path / "zl", tmp_path / "zr"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(pa.table({"k": np.array([1, 3], dtype=np.int64), "v": np.array([5, 6], dtype=np.int64)}), lroot / "p.parquet")
+    pq.write_table(pa.table({"k": np.array([2, 4], dtype=np.int64), "w": np.array([7, 8], dtype=np.int64)}), rroot / "p.parquet")
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("zL", ["k"], ["v"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("zR", ["k"], ["w"]))
+    session.enable_hyperspace()
+    q = ldf.join(rdf, on="k").group_by("k").agg(s=("v", "sum"))
+    fused = q.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    plain = q.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    assert fused["k"].shape[0] == plain["k"].shape[0] == 0
+    assert fused["k"].dtype == plain["k"].dtype == np.int64
+    assert fused["s"].dtype == plain["s"].dtype == np.int64
