@@ -1307,6 +1307,22 @@ def _agg_column_stats(arr: np.ndarray):
     raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
 
 
+def _group_key_canonical(lcols, rcols, lkeys, rkeys, name: str) -> str:
+    """Resolve a group-by name to the LEFT join-key column holding its values
+    (matched rows carry equal keys on both sides). Resolves the column the
+    name actually denotes first (mirroring _agg_side_of, so a non-key column
+    sharing a join key's name cannot be mistaken for the key), then requires
+    it to BE a join key; raises DeviceUnsupported otherwise."""
+    side, src = _agg_side_of(lcols, rcols, name)
+    if side == "left":
+        if src not in lkeys:
+            raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
+        return src
+    if src not in rkeys:
+        raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
+    return lkeys[rkeys.index(src)]
+
+
 def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.Batch:
     """Global aggregates over a compatible bucketed inner join WITHOUT
     materializing the pair expansion: per bucket, the [lo, hi) match spans
@@ -1333,15 +1349,9 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
         # reductions — still no pair materialization. Every join key must be
         # covered exactly once (grouping by l.a and r.a of a composite join
         # would silently group by the wrong granularity).
-        canonical = []
-        for k in agg.keys:
-            base = k[:-2] if k.endswith("#r") else k
-            if base in lkeys:
-                canonical.append(base)
-            elif base in rkeys:
-                canonical.append(lkeys[rkeys.index(base)])
-            else:
-                raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
+        lc = set(lside.output_columns)
+        rc = set(rside.output_columns)
+        canonical = [_group_key_canonical(lc, rc, lkeys, rkeys, k) for k in agg.keys]
         if sorted(canonical) != sorted(lkeys):
             raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
         return _grouped_aggregate_over_join(session, agg, join, compat)
@@ -1516,10 +1526,7 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
 
     # output key columns: requested name -> the left key column holding its
     # values (right key values equal left's on matched rows)
-    key_source = {}
-    for k in agg.keys:
-        base = k[:-2] if k.endswith("#r") else k
-        key_source[k] = lkeys[lkeys.index(base)] if base in lkeys else lkeys[rkeys.index(base)]
+    key_source = {k: _group_key_canonical(lcols, rcols, lkeys, rkeys, k) for k in agg.keys}
 
     out_keys: Dict[str, List[np.ndarray]] = {k: [] for k in agg.keys}
     out_vals: Dict[str, List[np.ndarray]] = {name: [] for name, *_ in plans}
@@ -1563,7 +1570,16 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
             vals, ok, is_int = _agg_column_stats(arr)
             if is_int and vals.size and int(np.abs(vals).max()) * max(int(counts.sum()), 1) >= INT_GUARD:
                 raise DeviceUnsupported("int sum overflow risk -> materialize")
-            got = (vals, ok, is_int)
+            pref = prefn = None
+            if side == "right":
+                if ok is None:
+                    pref = np.concatenate([[0], np.cumsum(vals)])
+                    nn = np.ones(vals.shape[0], dtype=np.int64)
+                else:
+                    pref = np.concatenate([[0.0], np.cumsum(np.where(ok, vals, 0.0))])
+                    nn = ok.astype(np.int64)
+                prefn = np.concatenate([[0], np.cumsum(nn)])
+            got = (vals, ok, is_int, pref, prefn)
             col_cache[(side, src)] = got
             return got
 
@@ -1571,7 +1587,7 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
             if fn == "count*":
                 out_vals[name].append(run_pairs[keep])
                 continue
-            vals, ok, is_int = col_info(side, src)
+            vals, ok, is_int, pref, prefn = col_info(side, src)
             if not is_int:
                 int_sum[name] = False
             if side == "left":
@@ -1589,13 +1605,6 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
                             np.divide(sums, cnts, out=np.full(sums.shape, np.nan), where=cnts > 0)
                         )
             else:
-                if ok is None:
-                    pref = np.concatenate([[0], np.cumsum(vals)])
-                    nn = np.ones(vals.shape[0], dtype=np.int64)
-                else:
-                    pref = np.concatenate([[0.0], np.cumsum(np.where(ok, vals, 0.0))])
-                    nn = ok.astype(np.int64)
-                prefn = np.concatenate([[0], np.cumsum(nn)])
                 row_sums = pref[hi_i] - pref[lo_i]
                 row_cnts = prefn[hi_i] - prefn[lo_i]
                 sums = np.add.reduceat(row_sums, starts)[keep]

@@ -63,6 +63,7 @@ def _read_files(
     with_file_names: bool,
     partition_values: Optional[dict] = None,
     partition_dtypes: Optional[dict] = None,
+    format_options: Optional[dict] = None,
 ) -> B.Batch:
     """Read ``files`` into one batch. ``partition_values`` ({file -> {col ->
     typed value}}) attaches hive-partition columns — constant per file, absent
@@ -99,12 +100,12 @@ def _read_files(
             # every requested column is a partition column: the file is never
             # decoded, but its row count still shapes the output
             b: B.Batch = {}
-            n = F.count_rows(f, file_format)
+            n = F.count_rows(f, file_format, format_options)
         elif file_format == "parquet":
             b = read_parquet_batch([f], file_columns)
             n = B.num_rows(b)
         else:
-            b = B.table_to_batch(F.read_table(f, file_format, file_columns))
+            b = B.table_to_batch(F.read_table(f, file_format, file_columns, format_options))
             n = B.num_rows(b)
         if attach:
             from hyperspace_tpu.sources import partitions as P
@@ -123,7 +124,7 @@ def _read_files(
         return read_parquet_batch(list(files), columns)
     from hyperspace_tpu.sources import formats as F
 
-    t = F.open_dataset(list(files), file_format).to_table(columns=columns)
+    t = F.open_dataset(list(files), file_format, format_options).to_table(columns=columns)
     return B.table_to_batch(t)
 
 
@@ -189,6 +190,7 @@ class Executor:
                 with_file_names,
                 partition_values=plan.partition_values,
                 partition_dtypes=plan.partition_dtypes,
+                format_options=plan.format_options,
             )
 
         if isinstance(plan, L.IndexScan):
@@ -299,7 +301,15 @@ class Executor:
             pv = {f: rel.partition_values_for(f) for f in files}
             pd_ = getattr(rel, "partition_dtypes", None)
             pd = dict(pd_) if pd_ else None
-        return _read_files(files, rel.physical_format, None, with_file_names, pv, pd)
+        return _read_files(
+            files,
+            rel.physical_format,
+            None,
+            with_file_names,
+            pv,
+            pd,
+            format_options=getattr(rel, "options", None) or None,
+        )
 
     def _filter_mask(self, plan: L.Filter, child: B.Batch) -> np.ndarray:
         """Predicate evaluation: device path over index/file scans when the

@@ -293,7 +293,10 @@ class DataSkippingIndex(Index):
             else:
                 from hyperspace_tpu.sources import formats as F
 
-                t = F.read_table(fi.name, relation.physical_format, file_cols)
+                t = F.read_table(
+                    fi.name, relation.physical_format, file_cols,
+                    getattr(relation, "options", None),
+                )
                 b = {c: t.column(c).to_numpy(zero_copy_only=False) for c in file_cols}
                 n = len(next(iter(b.values()))) if b else 0
             if part_cols:

@@ -188,10 +188,13 @@ class FileScan(LogicalPlan):
         via_index: Optional[str] = None,
         partition_values: Optional[dict] = None,
         partition_dtypes: Optional[dict] = None,
+        format_options: Optional[dict] = None,
     ):
         self.files = list(files)
         self.file_format = file_format
         self.columns = list(columns)
+        # reader options of the source relation (e.g. csv delimiter/header)
+        self.format_options = dict(format_options) if format_options else None
         # name of the index whose rewrite produced this scan (e.g. a
         # data-skipping prune), for explain/whyNot reporting
         self.via_index = via_index

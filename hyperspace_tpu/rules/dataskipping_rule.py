@@ -334,6 +334,7 @@ def apply_data_skipping_rule(
         via_index=entry.name,
         partition_values=pv,
         partition_dtypes=pd,
+        format_options=getattr(rel, "options", None),
     )
     new_plan: L.LogicalPlan = L.Filter(condition, new_scan)
     if project_cols is not None:

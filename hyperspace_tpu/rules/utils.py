@@ -205,7 +205,8 @@ def _hybrid_scan_plan(
         pd_ = getattr(rel, "partition_dtypes", None)
         pd = dict(pd_) if pd_ else None
     appended_scan = L.FileScan(
-        appended, rel.physical_format, list(required), partition_values=pv, partition_dtypes=pd
+        appended, rel.physical_format, list(required), partition_values=pv,
+        partition_dtypes=pd, format_options=getattr(rel, "options", None),
     )
     rebucketed = L.Repartition(bucket_spec, appended_scan)
     branches = [index_side, rebucketed]

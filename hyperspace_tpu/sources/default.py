@@ -23,6 +23,7 @@ from hyperspace_tpu.sources.interfaces import (
     FileBasedSourceProvider,
 )
 from hyperspace_tpu.sources.signatures import file_based_signature
+from hyperspace_tpu.sources import formats
 from hyperspace_tpu.sources.formats import (
     MATERIALIZED_FORMATS,
     SUPPORTED_FORMATS,
@@ -141,15 +142,16 @@ class DefaultFileBasedRelation(FileBasedRelation):
         target = files if files is not None else self._files
         if self._file_format in MATERIALIZED_FORMATS:
             return self._materialized_dataset(target)
+        fmt = formats.arrow_format(self._file_format, self._options)
         if self._part_cols:
             part = pads.partitioning(pa.schema(self._partition_arrow_fields()), flavor="hive")
             return pads.dataset(
                 target,
-                format=self._file_format,
+                format=fmt,
                 partitioning=part,
                 partition_base_dir=self._root_paths[0],
             )
-        return pads.dataset(target, format=self._file_format)
+        return pads.dataset(target, format=fmt)
 
     def _materialized_dataset(self, target: List[str]) -> pads.Dataset:
         """Avro/text: decode to in-memory tables, attaching hive-partition

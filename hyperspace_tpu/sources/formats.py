@@ -117,13 +117,36 @@ def write_text(path: str, lines: List[str]) -> None:
             f.write("\n")
 
 
-def read_table(path: str, file_format: str, columns: Optional[List[str]] = None) -> pa.Table:
+def arrow_format(file_format: str, options: Optional[Dict[str, Any]] = None):
+    """The pyarrow dataset ``format`` argument honoring reader options.
+
+    CSV supports ``delimiter``/``sep`` and ``header`` (default true; false
+    autogenerates ``f0..fN`` column names). Unknown options are ignored, as
+    are options on formats that take none here."""
+    if file_format == "csv" and options:
+        from pyarrow import csv as pacsv
+
+        parse = pacsv.ParseOptions(delimiter=str(options.get("delimiter", options.get("sep", ","))))
+        header = options.get("header", True)
+        if isinstance(header, str):
+            header = header.strip().lower() in ("true", "1", "yes")
+        read = pacsv.ReadOptions(autogenerate_column_names=not header)
+        return pads.CsvFileFormat(parse_options=parse, read_options=read)
+    return file_format
+
+
+def read_table(
+    path: str,
+    file_format: str,
+    columns: Optional[List[str]] = None,
+    options: Optional[Dict[str, Any]] = None,
+) -> pa.Table:
     """One file -> arrow table (column-pruned at decode when the format allows)."""
     if file_format == "avro":
         return read_avro_table(path, columns)
     if file_format == "text":
         return read_text_table(path, columns)
-    return pads.dataset([path], format=file_format).to_table(columns=columns)
+    return pads.dataset([path], format=arrow_format(file_format, options)).to_table(columns=columns)
 
 
 def _align_to_schema(t: pa.Table, schema: pa.Schema) -> pa.Table:
@@ -147,22 +170,24 @@ def tables_to_dataset(tables: List[pa.Table]) -> pads.Dataset:
     return pads.dataset([_align_to_schema(t, schema) for t in tables], schema=schema)
 
 
-def open_dataset(files: List[str], file_format: str) -> pads.Dataset:
+def open_dataset(
+    files: List[str], file_format: str, options: Optional[Dict[str, Any]] = None
+) -> pads.Dataset:
     """``files`` -> a pyarrow Dataset regardless of format.
 
     Native formats stream from file bytes; materialized formats (avro/text)
     are decoded up front into an in-memory dataset with a unified schema.
     """
     if file_format in ARROW_NATIVE_FORMATS:
-        return pads.dataset(files, format=file_format)
+        return pads.dataset(files, format=arrow_format(file_format, options))
     if file_format not in MATERIALIZED_FORMATS:
         raise ValueError(f"Unsupported file format: {file_format!r}")
     return tables_to_dataset([read_table(f, file_format) for f in files])
 
 
-def count_rows(path: str, file_format: str) -> int:
+def count_rows(path: str, file_format: str, options: Optional[Dict[str, Any]] = None) -> int:
     if file_format in ARROW_NATIVE_FORMATS:
-        return pads.dataset([path], format=file_format).count_rows()
+        return pads.dataset([path], format=arrow_format(file_format, options)).count_rows()
     if file_format == "avro":
         # block headers carry record counts; no payload is decompressed
         from hyperspace_tpu.utils.avro import count_records

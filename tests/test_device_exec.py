@@ -1025,3 +1025,31 @@ def test_grouped_fused_empty_join_dtypes(session, tmp_path):
     assert fused["k"].shape[0] == plain["k"].shape[0] == 0
     assert fused["k"].dtype == plain["k"].dtype == np.int64
     assert fused["s"].dtype == plain["s"].dtype == np.int64
+
+
+def test_grouped_fused_name_collision_with_key(session, tmp_path):
+    """A non-key column sharing a join key's name must not be mistaken for
+    the key: group_by over it falls back and returns ITS values."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 2)
+    lroot, rroot = tmp_path / "nc_l", tmp_path / "nc_r"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table({"a": np.array([1, 2], dtype=np.int64), "v": np.array([0.5, 1.5])}),
+        lroot / "p.parquet",
+    )
+    # right joins on 'b'; its non-key column 'a' holds DIFFERENT values
+    pq.write_table(
+        pa.table({"b": np.array([1, 2], dtype=np.int64), "a": np.array([100, 200], dtype=np.int64)}),
+        rroot / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("ncL", ["a"], ["v"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("ncR", ["b"], ["a"]))
+    session.enable_hyperspace()
+    q = ldf.join(rdf, on=hst.col("a") == hst.col("b")).group_by("a#r").agg(n=("*", "count"))
+    fused = q.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    plain = q.collect()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    assert sorted(fused["a#r"].tolist()) == sorted(plain["a#r"].tolist()) == [100, 200]

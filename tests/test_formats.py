@@ -237,3 +237,42 @@ class TestFormatHelpers:
         assert read_schema(p) == s
         assert F.read_format_schema([p], "avro") == pa.schema([pa.field("a", pa.int64())])
         assert F.read_format_schema(["ignored"], "text").names == [F.TEXT_COLUMN]
+
+
+class TestCsvOptions:
+    def test_delimiter_and_header(self, session, tmp_path):
+        root = tmp_path / "csvopts"
+        root.mkdir()
+        (root / "p.csv").write_text("k;v\n1;10\n2;20\n")
+        got = session.read_csv(str(root), delimiter=";").collect()
+        assert got["k"].tolist() == [1, 2] and got["v"].tolist() == [10, 20]
+
+    def test_headerless(self, session, tmp_path):
+        root = tmp_path / "csvnh"
+        root.mkdir()
+        (root / "p.csv").write_text("1,10\n2,20\n")
+        got = session.read_csv(str(root), header=False).collect()
+        assert sorted(got.keys()) == ["f0", "f1"]
+        assert got["f0"].tolist() == [1, 2]
+
+    def test_options_survive_indexing_and_skipping(self, session, tmp_path):
+        import hyperspace_tpu as hst
+
+        root = tmp_path / "csvidx"
+        root.mkdir()
+        for i in range(3):
+            lines = "\n".join(f"{i * 100 + j};{j}" for j in range(100))
+            (root / f"p{i}.csv").write_text("k;v\n" + lines + "\n")
+        hs = hst.Hyperspace(session)
+        df = session.read_csv(str(root), delimiter=";")
+        hs.create_index(df, hst.DataSkippingIndexConfig("csvSkip", hst.MinMaxSketch("k")))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("k") == 105).select("v")
+        from hyperspace_tpu.plan import logical as L
+
+        plan = q.optimized_plan()
+        fscans = L.collect(plan, lambda p: isinstance(p, L.FileScan))
+        assert fscans and len(fscans[0].files) == 1  # pruned to one file
+        assert fscans[0].format_options == {"delimiter": ";"}
+        got = q.collect()
+        assert got["v"].tolist() == [5]
