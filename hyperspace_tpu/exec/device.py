@@ -710,18 +710,15 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_keys: Optional[Lis
 
 
 def _order_key_array(arr: np.ndarray) -> np.ndarray:
-    """An int64/float view of ``arr`` with the same ordering, null-safe:
-    strings factorize to codes (null -> -1, before everything — the same
-    order _composite_ranks uses), datetimes view their epoch. Raw object
-    comparisons would TypeError on None."""
-    if arr.dtype.kind in ("U", "S", "O"):
-        from hyperspace_tpu.ops.encode import factorize_strings
+    """An order-preserving int64 view of ``arr``, null-safe: strings
+    factorize to codes (null -> -1, before everything), datetimes view their
+    epoch, floats use the IEEE total-order encoding — the exact encoding the
+    index build sorts by (ops/encode.sort_key_int64), so sortedness checks
+    and rank comparisons are sound for NaN too (a raw float comparison is
+    NaN-blind and a raw object comparison TypeErrors on None)."""
+    from hyperspace_tpu.ops.encode import sort_key_int64
 
-        codes, _, _ = factorize_strings(arr)
-        return codes.astype(np.int64)
-    if arr.dtype.kind == "M":
-        return arr.view("int64")
-    return arr
+    return sort_key_int64(arr)
 
 
 def _sort_bucket(batch: B.Batch, sort_keys: List[str]) -> B.Batch:
@@ -1307,6 +1304,32 @@ def _agg_column_stats(arr: np.ndarray):
     raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
 
 
+def _check_agg_input_dtypes(lside, rside, need_l, need_r) -> None:
+    """Footer-only eligibility check for fused-aggregate inputs: numeric or
+    boolean parquet types only (and not uint64). Sides without an index leaf
+    carrying the column are checked later, at decode."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    for side, cols in ((lside, need_l), (rside, need_r)):
+        scans = L.collect(side, lambda x: isinstance(x, L.IndexScan))
+        scan = scans[0] if scans else None
+        if scan is None or not scan.files:
+            continue
+        try:
+            schema = pq.read_schema(scan.files[0])
+        except OSError:
+            continue
+        for c in cols:
+            if c not in scan.columns or scan.file_column_of(c) not in schema.names:
+                continue
+            t = schema.field(scan.file_column_of(c)).type
+            if pa.types.is_uint64(t) or not (
+                pa.types.is_integer(t) or pa.types.is_floating(t) or pa.types.is_boolean(t)
+            ):
+                raise DeviceUnsupported(f"aggregate input {c!r} type {t} -> materialize")
+
+
 def _group_key_canonical(lcols, rcols, lkeys, rkeys, name: str) -> str:
     """Resolve a group-by name to the LEFT join-key column holding its values
     (matched rows carry equal keys on both sides). Resolves the column the
@@ -1372,6 +1395,11 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             raise DeviceUnsupported("min/max of a right-side column -> materialize")
         plans.append((name, fn, side, src))
         (need_l if side == "left" else need_r).add(src)
+
+    # cheap footer-level dtype check BEFORE any decode: a string/binary
+    # aggregate input must not cost a full read of both sides only to fall
+    # back (the overflow guards still bail late — they need values)
+    _check_agg_input_dtypes(lside, rside, need_l, need_r)
 
     # decode only keys + needed inputs
     setup = _bucketed_join_setup(

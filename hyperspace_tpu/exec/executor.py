@@ -492,8 +492,8 @@ class Executor:
 
         # rename duplicated right-side columns up front so every output column
         # (including unmatched-row nulls on outer joins) comes straight out of
-        # the merge result
-        rename = {c: f"{c}#r" for c in right_cols if c in left_cols}
+        # the merge result; naming must match the plan's (join_output_names)
+        _, rename = L.join_output_names(left_cols, right_cols)
         ldf = pd.DataFrame(left)
         rdf = pd.DataFrame(right).rename(columns=rename)
         rkeys_renamed = [rename.get(k, k) for k in rkeys]

@@ -125,6 +125,25 @@ class Project(LogicalPlan):
         return f"Project({self.columns})"
 
 
+def join_output_names(left_cols: List[str], right_cols: List[str]) -> Tuple[List[str], Dict[str, str]]:
+    """Join output naming: right-side duplicates get a '#r' suffix, repeated
+    until unique (a second join whose right side collides with an existing
+    'x#r' yields 'x#r#r'). Returns (output names, right-col rename map) —
+    the single source of truth for planning AND execution."""
+    out = list(left_cols)
+    taken = set(left_cols)
+    rename: Dict[str, str] = {}
+    for c in right_cols:
+        name = c
+        while name in taken:
+            name = f"{name}#r"
+        if name != c:
+            rename[c] = name
+        taken.add(name)
+        out.append(name)
+    return out, rename
+
+
 class Join(LogicalPlan):
     """Equi-join. ``condition`` must be a conjunction of col = col terms
     (the only shape the reference's JoinIndexRule accepts,
@@ -141,12 +160,7 @@ class Join(LogicalPlan):
 
     @property
     def output_columns(self) -> List[str]:
-        # disambiguate duplicate names with l_/r_ prefix applied at execution
-        left_cols = self.left.output_columns
-        right_cols = self.right.output_columns
-        out = list(left_cols)
-        for c in right_cols:
-            out.append(c if c not in left_cols else f"{c}#r")
+        out, _ = join_output_names(self.left.output_columns, self.right.output_columns)
         return out
 
     def with_children(self, children: Sequence[LogicalPlan]) -> "Join":

@@ -433,6 +433,11 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
             term = col(an) == col(bn)
             condition = term if condition is None else (condition & term)
         df = df.join(right, on=condition, how=j.how)
+        # after a further join, the previous right side is folded into the
+        # left composite (its duplicated columns already carry their '#r'
+        # names); only the newest join's right side resolves via '#r'
+        for a in aliases:
+            aliases[a] = "left"
         aliases[j.alias.lower()] = "right"
 
     resolve_ref = _make_ref_resolver(df, aliases)

@@ -288,3 +288,42 @@ def test_select_distinct(session, views):
 def test_distinct_with_group_by_raises(session, views):
     with pytest.raises(SqlError, match="DISTINCT"):
         session.sql("SELECT DISTINCT region, COUNT(*) FROM sales GROUP BY region")
+
+
+class TestMultiJoin:
+    @pytest.fixture()
+    def three_views(self, session, tmp_path):
+        t1 = pa.table({"a": np.array([1, 2, 3], dtype=np.int64), "y": np.array([7, 8, 9], dtype=np.int64)})
+        t2 = pa.table({"b": np.array([1, 2, 3], dtype=np.int64), "x": np.array([100, 200, 300], dtype=np.int64)})
+        t3 = pa.table({"c": np.array([1, 2, 3], dtype=np.int64), "x": np.array([1000, 2000, 3000], dtype=np.int64)})
+        for name, t in (("t1", t1), ("t2", t2), ("t3", t3)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+
+    def test_qualified_ref_to_earlier_join(self, session, three_views):
+        got = session.sql(
+            "SELECT t2.x FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c"
+        ).collect()
+        assert sorted(got["x"].tolist()) == [100, 200, 300]
+
+    def test_qualified_where_on_earlier_join(self, session, three_views):
+        got = session.sql(
+            "SELECT y FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c WHERE t2.x = 100"
+        ).collect()
+        assert got["y"].tolist() == [7]
+
+    def test_double_suffix_collision(self, session, three_views):
+        # t1 also gets an 'x' via join 1 ('x'), join 2 adds another ('x#r')
+        got = session.sql(
+            "SELECT t3.x FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c"
+        ).collect()
+        assert sorted(got["x"].tolist()) == [1000, 2000, 3000]
+
+    def test_all_columns_of_triple_join(self, session, three_views):
+        got = session.sql("SELECT * FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c").collect()
+        # both duplicate 'x' columns surface under distinct names
+        assert "x" in got and "x#r" in got
+        assert sorted(got["x"].tolist()) == [100, 200, 300]
+        assert sorted(got["x#r"].tolist()) == [1000, 2000, 3000]
