@@ -51,6 +51,11 @@ def take(batch: Batch, indices: np.ndarray) -> Batch:
 
 
 def mask_rows(batch: Batch, mask: np.ndarray) -> Batch:
+    mask = np.asarray(mask)
+    if mask.ndim == 0:
+        # a scalar predicate (e.g. comparison against a NULL scalar subquery)
+        # applies uniformly; 0-d boolean indexing would instead add an axis
+        mask = np.broadcast_to(mask, (num_rows(batch),))
     return {k: v[mask] for k, v in batch.items()}
 
 

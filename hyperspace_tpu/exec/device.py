@@ -1304,6 +1304,13 @@ def _agg_column_stats(arr: np.ndarray):
     raise DeviceUnsupported(f"non-numeric aggregate input dtype {arr.dtype}")
 
 
+def _int_magnitude(vals: np.ndarray) -> int:
+    """Largest |value| as a Python int. np.abs(int64.min) wraps negative, so
+    take abs() after widening to Python ints, keeping the overflow guards
+    sound for columns containing int64.min."""
+    return max(abs(int(vals.max())), abs(int(vals.min())))
+
+
 def _check_agg_input_dtypes(lside, rside, need_l, need_r) -> None:
     """Footer-only eligibility check for fused-aggregate inputs: numeric or
     boolean parquet types only (and not uint64). Sides without an index leaf
@@ -1455,7 +1462,7 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             pref = prefn = None
             if side == "right":
                 if is_int:
-                    if vals.size and int(np.abs(vals).max()) * vals.size >= INT_GUARD:
+                    if vals.size and _int_magnitude(vals) * vals.size >= INT_GUARD:
                         raise DeviceUnsupported("int sum overflow risk -> materialize")
                     pref = np.concatenate([[0], np.cumsum(vals)])
                 else:
@@ -1475,7 +1482,7 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
                 w = counts if ok is None else counts * ok
                 if fn in ("sum", "avg"):
                     if is_int:
-                        if vals.size and int(np.abs(vals).max()) * bucket_pairs >= INT_GUARD:
+                        if vals.size and _int_magnitude(vals) * bucket_pairs >= INT_GUARD:
                             raise DeviceUnsupported("int sum overflow risk -> materialize")
                         a["sum"] += int(np.dot(vals, counts))
                     else:
@@ -1596,7 +1603,7 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
                 return got
             arr = (lb if side == "left" else rb)[src]
             vals, ok, is_int = _agg_column_stats(arr)
-            if is_int and vals.size and int(np.abs(vals).max()) * max(int(counts.sum()), 1) >= INT_GUARD:
+            if is_int and vals.size and _int_magnitude(vals) * max(int(counts.sum()), 1) >= INT_GUARD:
                 raise DeviceUnsupported("int sum overflow risk -> materialize")
             pref = prefn = None
             if side == "right":

@@ -263,7 +263,9 @@ class BinaryOp(Expr):
             elif op in ("=", "!=", "<", "<=", ">", ">="):
                 return null
             else:
-                return np.full(shape, np.nan)
+                # arithmetic on SQL NULL stays NULL: keep the sentinel so a
+                # downstream comparison yields three-valued NULL, not False
+                return EMPTY_SCALAR
         if op == "AND":
             return _kleene_and(l, r)
         if op == "OR":
@@ -329,7 +331,9 @@ class IsNull(Expr):
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         v = self.child.eval(batch)
         if v is EMPTY_SCALAR:
-            return np.ones((), dtype=bool)  # IS NULL on a zero-row scalar subquery
+            # IS NULL on a zero-row scalar subquery: true for every batch row
+            n = next((c.shape[0] for c in batch.values() if getattr(c, "ndim", 0)), None)
+            return np.ones((), dtype=bool) if n is None else np.ones(n, dtype=bool)
         if isinstance(v, NullableBool):
             return np.array(v.unknown)  # IS NULL of a three-valued boolean
         if v.dtype.kind == "f":

@@ -420,7 +420,14 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
     if q.table not in views:
         raise SqlError(f"Unknown table/view {q.table!r}; register with create_or_replace_temp_view")
     df = views[q.table]
-    aliases = {q.alias.lower(): "left"}
+    # alias -> {lowercased source column -> its actual name in the joined
+    # frame}. Join dedup renames right-side duplicates ('x' -> 'x#r', 'x#r#r',
+    # ...; plan/logical.py join_output_names is the single source of truth),
+    # and this map tracks those renames per alias so qualified references
+    # stay correct through any number of joins.
+    alias_cols: Dict[str, Dict[str, str]] = {
+        q.alias.lower(): {c.lower(): c for c in df.plan.output_columns}
+    }
 
     for j in q.joins:
         if j.view not in views:
@@ -429,18 +436,18 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
         condition: Optional[Expr] = None
         left_cols = {c.lower() for c in df.plan.output_columns}
         for a, b in j.on:
-            an, bn = _resolve_side(a, b, j.alias, aliases, left_cols)
+            an, bn = _resolve_side(a, b, j.alias, alias_cols, left_cols, right)
             term = col(an) == col(bn)
             condition = term if condition is None else (condition & term)
-        df = df.join(right, on=condition, how=j.how)
-        # after a further join, the previous right side is folded into the
-        # left composite (its duplicated columns already carry their '#r'
-        # names); only the newest join's right side resolves via '#r'
-        for a in aliases:
-            aliases[a] = "left"
-        aliases[j.alias.lower()] = "right"
+        from hyperspace_tpu.plan.logical import join_output_names
 
-    resolve_ref = _make_ref_resolver(df, aliases)
+        _, rename = join_output_names(df.plan.output_columns, right.plan.output_columns)
+        df = df.join(right, on=condition, how=j.how)
+        alias_cols[j.alias.lower()] = {
+            c.lower(): rename.get(c, c) for c in right.plan.output_columns
+        }
+
+    resolve_ref = _make_ref_resolver(df, alias_cols)
 
     if q.where is not None:
         df = df.filter(_resolve_expr_refs(q.where, resolve_ref))
@@ -472,6 +479,7 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
                 out_order.append(plain)
                 if it.alias:
                     renames[plain] = it.alias
+        _surface_plain_names(q.items, out_order, renames)
         if not aggs:
             raise SqlError("GROUP BY requires at least one aggregate in SELECT")
         df = df.group_by(*group_keys).agg(**aggs) if group_keys else df.agg(**aggs)
@@ -498,10 +506,11 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
     elif q.items is not None:
         names = []
         for it in q.items:
-            name = _resolve_select_name(it.name, df, aliases)
+            name = _resolve_select_name(it.name, df, alias_cols)
             names.append(name)
             if it.alias:
                 renames[name] = it.alias
+        _surface_plain_names(q.items, names, renames)
         df = df.select(*names)
 
     if q.distinct:
@@ -533,19 +542,23 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
     return df
 
 
-def _make_ref_resolver(df, aliases):
+def _make_ref_resolver(df, alias_cols):
     """Resolve a possibly table-qualified name against the planned frame:
-    ``alias.col`` strips the qualifier, mapping right-side duplicates to
-    their ``#r`` column; unqualified (or nested-path) names pass through."""
-    cols_ = df.plan.output_columns
+    ``alias.col`` maps through the alias's column map (which tracks join
+    dedup renames); unqualified (or nested-path) names pass through."""
 
     def resolve(name: str) -> str:
         if "." in name:
             qual, rest = name.split(".", 1)
-            if qual.lower() in aliases:
-                if aliases[qual.lower()] == "right" and f"{rest}#r" in cols_:
-                    return f"{rest}#r"
-                return rest
+            mapping = alias_cols.get(qual.lower())
+            if mapping is not None:
+                got = mapping.get(rest.lower())
+                if got is None:
+                    raise SqlError(
+                        f"Column {rest!r} not found in table/alias {qual!r} "
+                        f"(has {sorted(mapping.values())})"
+                    )
+                return got
         return name
 
     return resolve
@@ -562,38 +575,66 @@ def _resolve_expr_refs(e: Expr, resolve) -> Expr:
     return rewrite_columns(e, mapping) if mapping else e
 
 
-def _resolve_side(a: str, b: str, right_alias: str, aliases, left_cols) -> Tuple[str, str]:
+def _resolve_side(a: str, b: str, right_alias: str, alias_cols, left_cols, right) -> Tuple[str, str]:
     """Order an ON pair as (left column, right column) using qualifiers when
-    present, else membership."""
+    present, else membership; left references map through the alias column
+    map so keys renamed by an earlier join's dedup resolve correctly."""
 
     def side_of(name: str) -> Optional[str]:
         if "." in name:
             qual = name.split(".", 1)[0].lower()
             if qual == right_alias.lower():
                 return "right"
-            if qual in aliases:
+            if qual in alias_cols:
                 return "left"
         return None
 
+    def left_name(name: str) -> str:
+        if "." in name:
+            qual, rest = name.split(".", 1)
+            mapping = alias_cols.get(qual.lower())
+            if mapping is not None and rest.lower() in mapping:
+                return mapping[rest.lower()]
+        return _strip_qualifier(name)
+
     sa, sb = side_of(a), side_of(b)
-    an, bn = _strip_qualifier(a), _strip_qualifier(b)
     if sa == "right" or sb == "left":
-        an, bn = bn, an
+        a, b = b, a
     elif sa is None and sb is None:
-        if an.lower() not in left_cols and bn.lower() in left_cols:
-            an, bn = bn, an
-    return an, bn
+        an_, bn_ = _strip_qualifier(a), _strip_qualifier(b)
+        if an_.lower() not in left_cols and bn_.lower() in left_cols:
+            a, b = b, a
+    return left_name(a), _strip_qualifier(b)
 
 
-def _resolve_select_name(name: str, df, aliases) -> str:
+def _surface_plain_names(items: List[SelectItem], names: List[str], renames: Dict[str, str]) -> None:
+    """A qualified right-side duplicate resolves to its internal '#r' column;
+    when the plain name is free in the final projection (after AS renames
+    apply), surface it under the plain name the way Spark does
+    (SELECT t3.x -> column "x"). Mutates ``renames`` in place."""
+    for it, name in zip(items, names):
+        if it.alias or it.agg is not None or "#r" not in name:
+            continue
+        plain = name.split("#r", 1)[0]
+        taken = {renames.get(n, n) for n in names if n != name}
+        if plain not in taken:
+            renames[name] = plain
+
+
+def _resolve_select_name(name: str, df, alias_cols) -> str:
     plain = _strip_qualifier(name)
     cols_ = df.plan.output_columns
-    # a qualified duplicate from the right side of a join surfaces as "#r";
-    # check the qualifier before the plain name, which also exists
     if "." in name:
-        qual = name.split(".", 1)[0].lower()
-        if aliases.get(qual) == "right" and f"{plain}#r" in cols_:
-            return f"{plain}#r"
+        qual, rest = name.split(".", 1)
+        mapping = alias_cols.get(qual.lower())
+        if mapping is not None:
+            got = mapping.get(rest.lower())
+            if got is None:
+                raise SqlError(
+                    f"Column {rest!r} not found in table/alias {qual!r} "
+                    f"(has {sorted(mapping.values())})"
+                )
+            return got
     if plain in cols_:
         return plain
     lowered = {c.lower(): c for c in cols_}

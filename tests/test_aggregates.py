@@ -134,6 +134,48 @@ class TestAggregates:
         assert np.allclose(on["spend"], off["spend"])
         assert np.allclose(on["budget"], off["budget"])
 
+    def test_int64_min_join_sum_no_silent_overflow(self, session, hs, tmp_path):
+        """A join-aggregate input containing int64.min must not slip past the
+        fused path's overflow guard (np.abs(int64.min) wraps negative): the
+        plan falls back to the exact path and the sums stay correct."""
+        from hyperspace_tpu.exec.device import _int_magnitude
+
+        lo = np.iinfo(np.int64).min
+        assert _int_magnitude(np.array([lo, 5], dtype=np.int64)) == 2 ** 63
+        # the old formula was negative, bypassing the guard entirely
+        assert int(np.abs(np.array([lo], dtype=np.int64)).max()) < 0
+
+        lroot, rroot = tmp_path / "l", tmp_path / "r"
+        lroot.mkdir(), rroot.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "dept": np.array([0, 0, 1, 1], dtype=np.int64),
+                    "amount": np.array([lo, 3, 7, 11], dtype=np.int64),
+                }
+            ),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table(
+                {
+                    "dept": np.array([0, 1], dtype=np.int64),
+                    "budget": np.array([10, 20], dtype=np.int64),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 2)
+        ldf = session.read_parquet(str(lroot))
+        rdf = session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("ovL", ["dept"], ["amount"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("ovR", ["dept"], ["budget"]))
+        session.enable_hyperspace()
+        got = as_pandas(
+            ldf.join(rdf, on=["dept"]).group_by("dept").agg(s=("amount", "sum")).collect()
+        ).sort_values("dept")
+        assert got["s"].tolist() == [lo + 3, 18]
+
     def test_order_by_and_limit(self, session, data):
         df = session.read_parquet(data)
         out = as_pandas(

@@ -22,7 +22,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = r'''
 import os, sys
 sys.path.insert(0, sys.argv[3])
+# sitecustomize may import jax at interpreter startup (before this script), so
+# setting JAX_PLATFORMS here is too late; update the config object instead —
+# four workers racing for the single real TPU chip would hang (see conftest.py)
 os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
 import hyperspace_tpu as hst
 root, d = sys.argv[1], sys.argv[2]
 sess = hst.Session(conf={hst.keys.SYSTEM_PATH: os.path.join(root, "i"), hst.keys.NUM_BUCKETS: 4})

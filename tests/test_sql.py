@@ -321,6 +321,62 @@ class TestMultiJoin:
         ).collect()
         assert sorted(got["x"].tolist()) == [1000, 2000, 3000]
 
+    def test_suffix_surfaces_when_alias_frees_plain_name(self, session, three_views):
+        # 'x' is renamed away by AS, so t3.x can surface under the plain name
+        got = session.sql(
+            "SELECT t2.x AS y2, t3.x FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c"
+        ).collect()
+        assert sorted(got["y2"].tolist()) == [100, 200, 300]
+        assert sorted(got["x"].tolist()) == [1000, 2000, 3000]
+
+    def test_suffix_kept_when_plain_name_also_projected(self, session, three_views):
+        got = session.sql(
+            "SELECT t2.x, t3.x FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c"
+        ).collect()
+        assert sorted(got["x"].tolist()) == [100, 200, 300]
+        assert sorted(got["x#r"].tolist()) == [1000, 2000, 3000]
+
+    def test_suffix_surfaces_in_group_by(self, session, three_views):
+        got = session.sql(
+            "SELECT t3.x, COUNT(*) AS n FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c GROUP BY t3.x"
+        ).collect()
+        assert sorted(got["x"].tolist()) == [1000, 2000, 3000]
+        assert got["n"].tolist() == [1, 1, 1]
+
+    @pytest.fixture()
+    def three_views_all_x(self, session, tmp_path):
+        # ALL three tables carry 'x': t2's becomes 'x#r', t3's 'x#r#r'
+        t1 = pa.table({"a": np.array([1, 2, 3], dtype=np.int64), "x": np.array([10, 20, 30], dtype=np.int64)})
+        t2 = pa.table({"b": np.array([1, 2, 3], dtype=np.int64), "x": np.array([100, 200, 300], dtype=np.int64)})
+        t3 = pa.table({"c": np.array([1, 2, 3], dtype=np.int64), "x": np.array([1000, 2000, 3000], dtype=np.int64)})
+        for name, t in (("u1", t1), ("u2", t2), ("u3", t3)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+
+    def test_triple_duplicate_qualified_refs(self, session, three_views_all_x):
+        sql = "FROM u1 JOIN u2 ON a = b JOIN u3 ON a = c"
+        for qual, expect in (("u1", [10, 20, 30]), ("u2", [100, 200, 300]), ("u3", [1000, 2000, 3000])):
+            got = session.sql(f"SELECT {qual}.x AS v {sql}").collect()
+            assert sorted(got["v"].tolist()) == expect, (qual, dict(got))
+
+    def test_triple_duplicate_group_by(self, session, three_views_all_x):
+        got = session.sql(
+            "SELECT u3.x AS k, COUNT(*) AS n FROM u1 JOIN u2 ON a = b JOIN u3 ON a = c GROUP BY u3.x"
+        ).collect()
+        assert sorted(got["k"].tolist()) == [1000, 2000, 3000]
+
+    def test_triple_duplicate_where(self, session, three_views_all_x):
+        got = session.sql(
+            "SELECT a FROM u1 JOIN u2 ON a = b JOIN u3 ON a = c WHERE u3.x = 2000"
+        ).collect()
+        assert got["a"].tolist() == [2]
+
+    def test_unknown_qualified_column_raises(self, session, three_views):
+        with pytest.raises(SqlError, match="not found in table/alias"):
+            session.sql("SELECT t2.nope FROM t1 JOIN t2 ON a = b")
+
     def test_all_columns_of_triple_join(self, session, three_views):
         got = session.sql("SELECT * FROM t1 JOIN t2 ON a = b JOIN t3 ON a = c").collect()
         # both duplicate 'x' columns surface under distinct names

@@ -113,6 +113,23 @@ class TestScalarSubquery:
         is_null = main.filter((hst.col("k") == null_scalar).is_null()).collect()
         assert is_null["k"].shape[0] == main.collect()["k"].shape[0]
 
+    def test_null_scalar_arithmetic_stays_null(self, session, two_tables):
+        """Arithmetic on a NULL scalar is NULL, so a comparison of the result
+        is three-valued: NOT((k + NULL) > 5) selects nothing (not everything),
+        and IS NULL on the arithmetic result is true for every row."""
+        mroot, droot = two_tables
+        main, dim = session.read_parquet(mroot), session.read_parquet(droot)
+        null_scalar = dim.filter(hst.col("id") == 9999).select("id").as_scalar()
+
+        kept = main.filter(~((hst.col("k") + null_scalar) > 5)).collect()
+        assert kept["k"].shape[0] == 0
+
+        pos = main.filter((hst.col("k") + null_scalar) > 5).collect()
+        assert pos["k"].shape == (0,)  # 1-D empty, not a 0-d-mask artifact
+
+        is_null = main.filter(((hst.col("k") * null_scalar) - 1).is_null()).collect()
+        assert is_null["k"].shape[0] == main.collect()["k"].shape[0]
+
     def test_null_scalar_as_boolean_operand(self, session, two_tables):
         """A NULL boolean scalar Kleene-combines in AND/OR: NULL OR TRUE
         keeps the true side's rows; NULL AND anything keeps none."""
