@@ -121,7 +121,7 @@ class OptimizeAction(Action):
             files = [fi.name for b in group for fi in self._to_optimize[b]]
             table = pads.dataset(files, format="parquet").to_table()
             # one write_bucketed pass per group re-buckets + re-sorts
-            write_bucketed(table, index.indexed_columns, index.num_buckets, out_dir)
+            write_bucketed(table, index.indexed_columns, index.num_buckets, out_dir, session=self.session)
 
     def log_entry(self) -> IndexLogEntry:
         new_content = Content.from_directory(self.data_manager.version_path(self._version), self._tracker)

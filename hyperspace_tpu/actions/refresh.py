@@ -207,13 +207,13 @@ class RefreshIncrementalAction(_RefreshActionBase):
                 if appended_table is not None
                 else kept
             )
-            write_bucketed(combined, index.indexed_columns, index.num_buckets, ctx.index_data_path, batch_rows=ctx.session.conf.build_batch_rows)
+            write_bucketed(combined, index.indexed_columns, index.num_buckets, ctx.index_data_path, batch_rows=ctx.session.conf.build_batch_rows, session=ctx.session)
             self._overwrite = True
         else:
             # appended-only: write just the delta, merge content trees
             # (ref: RefreshIncrementalAction merge :115-128, UpdateMode.Merge)
             assert appended_table is not None
-            write_bucketed(appended_table, index.indexed_columns, index.num_buckets, ctx.index_data_path, batch_rows=ctx.session.conf.build_batch_rows)
+            write_bucketed(appended_table, index.indexed_columns, index.num_buckets, ctx.index_data_path, batch_rows=ctx.session.conf.build_batch_rows, session=ctx.session)
             self._overwrite = False
         self._new_index = index
 

@@ -39,6 +39,7 @@ class keys:
     TPU_ROWS_PER_SHARD_CAPACITY_FACTOR = "hyperspace.tpu.rebucket.capacityFactor"
     TPU_MESH_AXIS = "hyperspace.tpu.mesh.axis"
     TPU_BUILD_BATCH_ROWS = "hyperspace.tpu.build.batchRows"
+    TPU_BUILD_DISTRIBUTED_MIN_ROWS = "hyperspace.tpu.build.distributedMinRows"
     TPU_QUERY_DEVICE_EXECUTION = "hyperspace.tpu.query.deviceExecution"
     TPU_QUERY_DEVICE_MIN_ROWS = "hyperspace.tpu.query.deviceMinRows"
 
@@ -76,6 +77,12 @@ DEFAULTS: Dict[str, Any] = {
     # tunneled chip); each chunk adds one sorted run per bucket, which the
     # join path re-sorts lazily and optimizeIndex compacts
     keys.TPU_BUILD_BATCH_ROWS: 2_000_000,
+    # When the session mesh spans >1 device, index builds with at least this
+    # many rows run the distributed exchange (hash -> all_to_all -> per-device
+    # sort) instead of the single-device program. 0 = always distributed on a
+    # multi-device mesh; single-device meshes always use the fused one-chip
+    # program regardless.
+    keys.TPU_BUILD_DISTRIBUTED_MIN_ROWS: 0,
     keys.TPU_QUERY_DEVICE_EXECUTION: True,
     # Below this many rows a host<->device round trip costs more than the
     # compute it offloads; the executor keeps small batches on host. Tune to 0
@@ -212,6 +219,10 @@ class HyperspaceConf:
     @property
     def build_batch_rows(self) -> int:
         return int(self.get(keys.TPU_BUILD_BATCH_ROWS))
+
+    @property
+    def distributed_build_min_rows(self) -> int:
+        return int(self.get(keys.TPU_BUILD_DISTRIBUTED_MIN_ROWS))
 
     @property
     def device_execution_enabled(self) -> bool:

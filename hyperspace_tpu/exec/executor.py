@@ -226,6 +226,24 @@ class Executor:
                 cols = cols + [INPUT_FILE_NAME]
             return B.select(child, cols)
 
+        if isinstance(plan, L.Compute):
+            from hyperspace_tpu.plan.expr import EMPTY_SCALAR, NullableBool
+
+            child = self._exec(plan.child, with_file_names)
+            out = dict(child)
+            n = B.num_rows(child)
+            for name, expr in plan.exprs:
+                v = expr.eval(child)
+                if v is EMPTY_SCALAR:  # NULL scalar subquery -> NULL column
+                    v = np.full(n, np.nan)
+                elif isinstance(v, NullableBool):  # boolean NULL -> False
+                    v = v.value & ~v.unknown
+                v = np.asarray(v)
+                if v.ndim == 0:
+                    v = np.broadcast_to(v, (n,)).copy()
+                out[name] = v
+            return out
+
         if isinstance(plan, L.Join):
             return self._exec_join(plan, with_file_names)
 

@@ -164,6 +164,7 @@ class CoveringIndex(Index):
                 payload_fn=payload_fn,
                 column_order=columns,
                 batch_rows=ctx.session.conf.build_batch_rows,
+                session=ctx.session,
             )
             schema = pa.schema([_arrow_field_for(r, ds.schema) for r in resolved])
             self.schema_json = schema_codec.schema_to_json(schema)
@@ -176,6 +177,7 @@ class CoveringIndex(Index):
             self.num_buckets,
             ctx.index_data_path,
             batch_rows=ctx.session.conf.build_batch_rows,
+            session=ctx.session,
         )
         self.schema_json = schema_codec.schema_to_json(table.schema)
 
@@ -262,6 +264,7 @@ def write_bucketed(
     payload_fn=None,
     column_order: Optional[List[str]] = None,
     batch_rows: Optional[int] = None,
+    session=None,
 ) -> List[str]:
     """Device-accelerated bucketed + sorted Parquet write.
 
@@ -285,6 +288,17 @@ def write_bucketed(
     multi-run state incremental refresh also produces; optimize compacts
     it). Returns written file paths — bucket order within each chunk,
     chunk-major with repeated bucket ids when chunking kicks in.
+
+    When ``session`` is given and its mesh spans more than one device (and the
+    table clears conf ``hyperspace.tpu.build.distributedMinRows``), each chunk
+    runs the DISTRIBUTED program instead: rows shard across the mesh, hash on
+    device, one ``all_to_all`` routes every row to its owning device
+    (bucket % n_devices), and each device sorts its buckets locally — the
+    TPU-native replacement for the reference's cluster-wide
+    ``repartition(numBuckets, cols)`` shuffle (ref: CoveringIndex.scala:54-69).
+    Exchange-capacity overflow (skew) retries with doubled slot capacity until
+    the exchange fits. Bucket file contents are identical to the single-device
+    build's (same rows, same within-bucket order).
     """
     import time as _time
 
@@ -300,6 +314,14 @@ def write_bucketed(
     n = table.num_rows
     if n == 0:
         return []
+
+    mesh = None
+    capacity_factor = 2.0
+    if session is not None:
+        m = session.mesh
+        if m.devices.size > 1 and n >= session.conf.distributed_build_min_rows:
+            mesh = m
+            capacity_factor = session.conf.rebucket_capacity_factor
 
     def _launch(chunk: pa.Table) -> dict:
         """Host encode + device program dispatch + async d2h start. Returns
@@ -331,11 +353,13 @@ def write_bucketed(
             marks["pad_upload_launch"] = round(_time.perf_counter() - t, 3)
         return {"chunk": chunk, "np2": np2, "counts": counts, "pieces": pieces, "marks": marks}
 
-    def _finish(state: dict, chunk_payload_fn) -> List[str]:
-        """Drain the permutation and write the per-bucket sorted parquet
-        files; host-heavy, overlapped with the NEXT chunk's device work."""
-        chunk, np2 = state["chunk"], state["np2"]
-        marks = state["marks"]
+    def _prepare_chunk(state: dict, chunk_payload_fn) -> pa.Table:
+        """Shared host prep before bucket writes: attach the lazily-decoded
+        payload columns, fix the output column order, and collapse to
+        single-chunk columns so per-bucket takes don't re-resolve chunk
+        offsets (a numpy-gather variant measured equal within noise; arrow
+        take keeps string/date columns on one code path)."""
+        chunk, marks = state["chunk"], state["marks"]
         t = _time.perf_counter()
         if chunk_payload_fn is not None:
             payload = chunk_payload_fn()
@@ -347,12 +371,17 @@ def write_bucketed(
         t = _time.perf_counter()
         if column_order:
             chunk = chunk.select(column_order)
-        # single-chunk columns so per-bucket takes don't re-resolve chunk
-        # offsets (a numpy-gather variant measured equal within noise; arrow
-        # take keeps string/date columns on one code path)
         chunk = chunk.combine_chunks()
         if timing:
             marks["combine_chunks"] = round(_time.perf_counter() - t, 3)
+        return chunk
+
+    def _finish(state: dict, chunk_payload_fn) -> List[str]:
+        """Drain the permutation and write the per-bucket sorted parquet
+        files; host-heavy, overlapped with the NEXT chunk's device work."""
+        np2 = state["np2"]
+        marks = state["marks"]
+        chunk = _prepare_chunk(state, chunk_payload_fn)
         t = _time.perf_counter()
         counts_np = np.asarray(state["counts"])
         boundaries = np.concatenate([[0], np.cumsum(counts_np)])
@@ -398,6 +427,123 @@ def write_bucketed(
             print(f"HS_BUILD_TIMING rows={chunk.num_rows} {marks}", file=_sys.stderr, flush=True)
         return out
 
+    def _launch_mesh(chunk: pa.Table) -> dict:
+        """Distributed variant of ``_launch``: shard the encoded key planes
+        over the mesh, dispatch the exchange program, and start async fetches.
+        The returned state carries the device inputs so ``_finish_mesh`` can
+        retry with doubled capacity on exchange overflow."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from hyperspace_tpu.ops.bucketize import _next_pow2, distributed_bucket_sort_build
+
+        marks = {}
+        t = _time.perf_counter()
+        batch = table_to_batch(chunk.select(bucket_sort_columns))
+        keys, kinds, host_hashes = encode.encode_sort_columns(
+            [batch[c] for c in bucket_sort_columns]
+        )
+        if timing:
+            marks["encode_keys"] = round(_time.perf_counter() - t, 3)
+        t = _time.perf_counter()
+        cn = chunk.num_rows
+        n_dev = int(mesh.devices.size)
+        per_dev = padded_size(-(-cn // n_dev))
+        pad_n = per_dev * n_dev
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        dev_keys = [jax.device_put(np.pad(k, (0, pad_n - cn)), sharding) for k in keys]
+        dev_hashes = [jax.device_put(np.pad(h, (0, pad_n - cn)), sharding) for h in host_hashes]
+        row_idx = jax.device_put(np.arange(pad_n, dtype=np.int32), sharding)
+        capacity = min(
+            _next_pow2(int(per_dev / n_dev * capacity_factor)), _next_pow2(per_dev)
+        )
+        bkts, ridx, vld, ovf = distributed_bucket_sort_build(
+            mesh, dev_keys, dev_hashes, kinds, row_idx, cn, num_buckets, capacity
+        )
+        for a in (ovf, bkts, ridx, vld):
+            a.copy_to_host_async()
+        if timing:
+            marks["pad_upload_launch"] = round(_time.perf_counter() - t, 3)
+        return {
+            "chunk": chunk,
+            "bkts": bkts,
+            "ridx": ridx,
+            "vld": vld,
+            "ovf": ovf,
+            "n_dev": n_dev,
+            "capacity": capacity,
+            "per_dev": per_dev,
+            "retry": (dev_keys, dev_hashes, kinds, row_idx, cn),
+            "marks": marks,
+        }
+
+    def _finish_mesh(state: dict, chunk_payload_fn) -> List[str]:
+        """Drain the distributed program's outputs and write per-bucket sorted
+        parquet files. Buckets live wholly on their owner device, so each
+        device shard yields its own contiguous bucket runs."""
+        from hyperspace_tpu.ops.bucketize import _next_pow2, distributed_bucket_sort_build
+
+        marks = state["marks"]
+        chunk = _prepare_chunk(state, chunk_payload_fn)
+        t = _time.perf_counter()
+
+        capacity, per_dev = state["capacity"], state["per_dev"]
+        bkts, ridx, vld, ovf = state["bkts"], state["ridx"], state["vld"], state["ovf"]
+        while int(np.asarray(ovf).sum()) > 0:
+            # skew overflowed a destination's slots: double capacity and rerun
+            # (a source holds per_dev rows total, so capacity == per_dev
+            # always fits and the loop terminates)
+            if capacity >= per_dev:
+                raise RuntimeError(
+                    "distributed build exchange overflow at full capacity "
+                    f"(capacity={capacity}, per_dev={per_dev})"
+                )
+            capacity = min(_next_pow2(capacity * 2), _next_pow2(per_dev))
+            dev_keys, dev_hashes, kinds, row_idx, cn = state["retry"]
+            bkts, ridx, vld, ovf = distributed_bucket_sort_build(
+                mesh, dev_keys, dev_hashes, kinds, row_idx, cn, num_buckets, capacity
+            )
+        bkts_np = np.asarray(bkts)
+        ridx_np = np.asarray(ridx)
+        vld_np = np.asarray(vld)
+        if timing:
+            marks["exchange_drain"] = round(_time.perf_counter() - t, 3)
+        t = _time.perf_counter()
+
+        def _take_write(b: int, indices: np.ndarray) -> str:
+            path = os.path.join(out_dir, _bucket_file_name(b))
+            rows = chunk.take(pa.array(indices))
+            pq.write_table(rows, path, use_dictionary=False, compression="NONE")
+            return path
+
+        from concurrent.futures import ThreadPoolExecutor
+
+        n_dev = state["n_dev"]
+        shard_len = bkts_np.shape[0] // n_dev
+        futures = []
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            for d in range(n_dev):
+                sl = slice(d * shard_len, (d + 1) * shard_len)
+                v_d = vld_np[sl]
+                nv = int(v_d.sum())  # valid rows sort to the shard's prefix
+                if nv == 0:
+                    continue
+                b_v = bkts_np[sl][:nv]
+                r_v = ridx_np[sl][:nv]
+                bounds = np.searchsorted(b_v, np.arange(num_buckets + 1))
+                for b in range(d, num_buckets, n_dev):
+                    lo, hi = int(bounds[b]), int(bounds[b + 1])
+                    if hi > lo:
+                        futures.append(ex.submit(_take_write, b, r_v[lo:hi]))
+            out = [f.result() for f in futures]
+        if timing:
+            marks["bucket_take_write"] = round(_time.perf_counter() - t, 3)
+            import sys as _sys
+
+            print(f"HS_BUILD_TIMING mesh rows={chunk.num_rows} {marks}", file=_sys.stderr, flush=True)
+        return out
+
+    launch, finish = (_launch_mesh, _finish_mesh) if mesh is not None else (_launch, _finish)
+
     if batch_rows is not None and batch_rows > 0 and n > batch_rows:
         # chunked build, software-pipelined one chunk deep: chunk k+1's
         # device program (and its d2h transfers) runs while chunk k's host
@@ -426,15 +572,15 @@ def write_bucketed(
         paths: List[str] = []
         in_flight: Optional[tuple] = None
         for off in range(0, n, batch_rows):
-            state = _launch(table.slice(off, batch_rows))
+            state = launch(table.slice(off, batch_rows))
             if in_flight is not None:
-                paths.extend(_finish(*in_flight))
+                paths.extend(finish(*in_flight))
             in_flight = (state, payload_for(off))
         if in_flight is not None:
-            paths.extend(_finish(*in_flight))
+            paths.extend(finish(*in_flight))
         return paths
 
-    return _finish(_launch(table), payload_fn)
+    return finish(launch(table), payload_fn)
 
 
 class CoveringIndexConfig(IndexConfig):

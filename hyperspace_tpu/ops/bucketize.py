@@ -161,6 +161,119 @@ def rebucket_and_sort(
     return sorted_arrays, sorted_buckets, sorted_valid, overflow
 
 
+def _next_pow2(x: int) -> int:
+    return max(8, 1 << (max(int(x) - 1, 1)).bit_length())
+
+
+from functools import lru_cache  # noqa: E402
+
+
+@lru_cache(maxsize=64)
+def _build_exchange_program(mesh: Mesh, kinds: Tuple[str, ...], num_buckets: int, capacity: int):
+    """Jitted distributed index-build step for one (mesh, key kinds,
+    num_buckets, capacity) class:
+
+      per-device hash (device-reconstructed for numeric kinds, host plane for
+      strings; bit-exact vs the single-device program ops/sort._build_sorted)
+      -> bucket ids -> ONE all_to_all routing each row to its owner device
+      (bucket % n_devices) -> per-device sort by (valid desc, bucket, keys...,
+      global row index).
+
+    Carrying the global row index instead of payload columns keeps the
+    exchange narrow: the host gathers arbitrary-typed payload rows by index
+    afterwards, exactly like the single-device build's permutation fetch.
+    Replaces the reference's cluster-wide ``repartition(numBuckets, cols)``
+    (ref: HS/index/covering/CoveringIndex.scala:54-69).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    from hyperspace_tpu.ops.hashing import bucket_ids_jnp
+    from hyperspace_tpu.ops.sort import _device_hash32, lex_argsort
+
+    axis = mesh.axis_names[0]
+    n_dev = mesh.shape[axis]
+    n_keys = len(kinds)
+    n_str = sum(1 for k in kinds if k == "s")
+
+    def run(keys, host_hashes, row_idx, n_valid):
+        valid = row_idx < n_valid
+
+        @partial(
+            shard_map,
+            mesh=mesh,
+            in_specs=(P(axis),) * (n_keys + n_str + 2),
+            out_specs=(P(axis), P(axis), P(axis), P(axis)),
+        )
+        def exchange(*args):
+            ks = args[:n_keys]
+            hh = args[n_keys : n_keys + n_str]
+            ridx, vld = args[-2], args[-1]
+            hash_cols = []
+            hidx = 0
+            for kind, key in zip(kinds, ks):
+                if kind == "s":
+                    hash_cols.append(hh[hidx])
+                    hidx += 1
+                else:
+                    hash_cols.append(_device_hash32(kind, key))
+            buckets = bucket_ids_jnp(hash_cols, num_buckets).astype(jnp.int32)
+            dest = (buckets % n_dev).astype(jnp.int32)
+            staged, mask, counts = _stage_for_exchange(
+                [*ks, ridx, buckets], dest, n_dev, capacity, valid=vld
+            )
+            sent = jnp.minimum(counts, capacity)
+            overflow = jnp.sum(counts - sent)
+            outs = [
+                jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+                for s in staged
+            ]
+            out_mask = jax.lax.all_to_all(
+                mask, axis, split_axis=0, concat_axis=0, tiled=True
+            ).reshape(-1)
+            *out_keys, out_ridx, out_buckets = outs
+            order = lex_argsort(
+                [(~out_mask).astype(jnp.int32), out_buckets, *out_keys, out_ridx]
+            )
+            return (
+                out_buckets[order],
+                out_ridx[order],
+                out_mask[order],
+                overflow[None],
+            )
+
+        return exchange(*keys, *host_hashes, row_idx, valid)
+
+    return jax.jit(run)
+
+
+def distributed_bucket_sort_build(
+    mesh: Mesh,
+    keys: List["jax.Array"],
+    host_hashes: List["jax.Array"],
+    kinds: Tuple[str, ...],
+    row_idx: "jax.Array",
+    n_valid: int,
+    num_buckets: int,
+    capacity: int,
+):
+    """Run the distributed build step; see ``_build_exchange_program``.
+
+    Inputs must be row-sharded over ``mesh`` and padded to a common length
+    divisible by the device count; ``row_idx`` is the global row iota with
+    padding rows >= ``n_valid`` (traced, so padding never recompiles).
+
+    Returns device arrays ``(sorted_buckets, sorted_row_idx, valid, overflow)``
+    each of per-device length ``n_devices * capacity``. Callers MUST check
+    ``overflow.sum() == 0`` and retry with doubled capacity otherwise (the
+    skew strategy — SURVEY.md §7 "hard parts").
+    """
+    import numpy as np
+
+    fn = _build_exchange_program(mesh, tuple(kinds), int(num_buckets), int(capacity))
+    return fn(tuple(keys), tuple(host_hashes), row_idx, np.int64(n_valid))
+
+
 def rebucket_hierarchical(
     mesh: Mesh,
     arrays: Dict[str, "jax.Array"],

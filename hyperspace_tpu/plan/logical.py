@@ -125,6 +125,40 @@ class Project(LogicalPlan):
         return f"Project({self.columns})"
 
 
+class Compute(LogicalPlan):
+    """Computed columns: appends ``name = expr`` outputs to the child's
+    columns (SQL expressions in the SELECT list, aggregate-input expressions,
+    post-aggregate arithmetic). The reference delegates expression projection
+    to Spark's Project; index rewrite rules recurse through this node
+    untouched, exactly as they do through Project."""
+
+    def __init__(self, exprs: List[Tuple[str, "Expr"]], child: LogicalPlan):
+        taken = set(child.output_columns)
+        names = [n for n, _ in exprs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"Duplicate computed column names: {names}")
+        clash = [n for n in names if n in taken]
+        if clash:
+            raise ValueError(f"Computed columns {clash} collide with child outputs")
+        self.exprs = [(n, e) for n, e in exprs]
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.child.output_columns + [n for n, _ in self.exprs]
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Compute":
+        (child,) = children
+        return Compute(self.exprs, child)
+
+    def describe(self) -> str:
+        parts = [f"{n}={e!r}" for n, e in self.exprs]
+        return f"Compute({', '.join(parts)})"
+
+
 def join_output_names(left_cols: List[str], right_cols: List[str]) -> Tuple[List[str], Dict[str, str]]:
     """Join output naming: right-side duplicates get a '#r' suffix, repeated
     until unique (a second join whose right side collides with an existing

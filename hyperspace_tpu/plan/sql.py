@@ -1,15 +1,16 @@
-"""Minimal SQL front-end over the relational IR.
+"""SQL front-end over the relational IR.
 
 The reference's users drive Hyperspace through Spark SQL; this module gives
 the same entry point without Spark: ``session.sql("SELECT ...")`` parses a
-deliberately small dialect (exactly the plan shapes the optimizer rules
-accept — linear scans, CNF equi-joins, filters/projects/aggregates; ref:
-JoinPlanNodeFilter's own restrictions, HS/index/covering/JoinIndexRule.scala:135-155)
-and plans it onto DataFrame operations, so every index rewrite, explain, and
-whyNot surface applies to SQL queries unchanged.
+dialect covering the plan shapes the optimizer rules accept — linear scans,
+CNF equi-joins, filters/projects/aggregates (ref: JoinPlanNodeFilter's own
+restrictions, HS/index/covering/JoinIndexRule.scala:135-155) — and plans it
+onto DataFrame operations, so every index rewrite, explain, and whyNot
+surface applies to SQL queries unchanged.
 
 Supported grammar (case-insensitive keywords):
 
+    [WITH name AS ( query ) [, name AS ( query )]*]
     SELECT [DISTINCT] <*| item [, item ...]>
     FROM <view> [AS] [alias]
     [ [INNER|LEFT|RIGHT|FULL] [OUTER] JOIN <view> [alias] ON a = b [AND ...] ]*
@@ -19,20 +20,38 @@ Supported grammar (case-insensitive keywords):
     [ORDER BY col [ASC|DESC] [, ...]]
     [LIMIT n]
 
-    item      := col | qualified.col | SUM|MIN|MAX|AVG|COUNT '(' col | '*' ')'  [AS name]
-    predicate := comparisons (=, !=, <>, <, <=, >, >=), IN (...), IS [NOT] NULL,
-                 BETWEEN x AND y, NOT/AND/OR, arithmetic (+ - * / %),
+    item      := expr [AS name]      -- full expressions, incl. aggregates
+    expr      := comparisons (=, !=, <>, <, <=, >, >=), IN (...),
+                 IN ( SELECT ... ), ( SELECT ... ) scalar subqueries,
+                 IS [NOT] NULL, BETWEEN x AND y, NOT/AND/OR,
+                 arithmetic (+ - * / %), SUM|MIN|MAX|AVG|COUNT(expr | *),
                  literals: 123, 1.5, 'text', DATE '2024-01-31'
+
+Subqueries are uncorrelated (as are the ones the reference's rules ever see;
+golden scenario src/test/resources/expected/spark-3.1/subquery.txt) and plan
+onto the same ScalarSubquery/InSubquery IR the dataframe API builds, so index
+rewrites apply inside them (rules/apply.py recursion). ORDER BY may reference
+non-projected columns (planned below the projection, Spark-style).
 """
 
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
-from hyperspace_tpu.plan.expr import Col, Expr, Lit, col, lit
+from hyperspace_tpu.plan.expr import (
+    BinaryOp,
+    Col,
+    Expr,
+    In,
+    IsNull,
+    Lit,
+    Not,
+    col,
+    lit,
+)
 
 
 class SqlError(ValueError):
@@ -54,7 +73,7 @@ _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having", "order", "limit", "join", "on",
     "inner", "left", "right", "full", "outer", "and", "or", "not", "in", "is",
     "null", "between", "as", "asc", "desc", "date", "count", "sum", "min",
-    "max", "avg",
+    "max", "avg", "with",
 }
 
 _AGG_FNS = ("count", "sum", "min", "max", "avg")
@@ -136,15 +155,91 @@ class _Parser:
     def at_end(self) -> bool:
         return self.i >= len(self.toks)
 
+    def text_since(self, start: int) -> str:
+        parts = []
+        for kind, val in self.toks[start : self.i]:
+            parts.append(f"'{val}'" if kind == "string" else val)
+        return " ".join(parts)
+
 
 # --- AST ------------------------------------------------------------------
 
 
+class _AggCall(Expr):
+    """Parse-time aggregate call marker (``SUM(expr)`` / ``COUNT(*)``);
+    plan_query replaces it with a reference to an Aggregate output. Never
+    evaluated."""
+
+    def __init__(self, fn: str, arg: Optional[Expr], text: str):
+        self.fn = fn
+        self.arg = arg
+        self.text = text  # source text of the argument, for default naming
+
+    def children(self) -> Sequence[Expr]:
+        return (self.arg,) if self.arg is not None else ()
+
+    def eval(self, batch):
+        raise SqlError(f"Aggregate {self.fn.upper()}() outside of an aggregation context")
+
+    def __repr__(self) -> str:
+        return f"{self.fn}({self.text})"
+
+
+class _SubquerySelect(Expr):
+    """Parse-time scalar-subquery marker (``( SELECT ... )``); plan_query
+    plans the inner query and replaces this with a ScalarSubquery."""
+
+    def __init__(self, query: "Query"):
+        self.query = query
+
+    def eval(self, batch):
+        raise SqlError("Unplanned scalar subquery")
+
+    def __repr__(self) -> str:
+        return "(<subquery>)"
+
+
+class _InQuery(Expr):
+    """Parse-time ``expr IN ( SELECT ... )`` marker; plan_query plans the
+    inner query and replaces this with an InSubquery."""
+
+    def __init__(self, child: Expr, query: "Query"):
+        self.child = child
+        self.query = query
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def eval(self, batch):
+        raise SqlError("Unplanned IN subquery")
+
+    def __repr__(self) -> str:
+        return f"({self.child!r} IN <subquery>)"
+
+
 class SelectItem:
-    def __init__(self, name: Optional[str], alias: Optional[str], agg: Optional[Tuple[str, Optional[str]]]):
-        self.name = name            # column (possibly qualified) for plain items
+    def __init__(self, expr: Expr, alias: Optional[str], text: str):
+        self.expr = expr
         self.alias = alias
-        self.agg = agg              # (fn, column-or-None-for-*) for aggregates
+        self.text = text  # source text, the default output name for expressions
+
+    # -- parse-level introspection kept for compatibility ------------------
+    @property
+    def name(self) -> Optional[str]:
+        """Column name when the item is a bare (possibly qualified) column."""
+        return self.expr.name if isinstance(self.expr, Col) else None
+
+    @property
+    def agg(self) -> Optional[Tuple[str, Optional[str]]]:
+        """(fn, column-or-None) when the item is a bare aggregate of a bare
+        column (or COUNT(*))."""
+        if isinstance(self.expr, _AggCall):
+            a = self.expr.arg
+            if a is None:
+                return (self.expr.fn, None)
+            if isinstance(a, Col):
+                return (self.expr.fn, a.name)
+        return None
 
 
 class JoinClause:
@@ -157,6 +252,7 @@ class JoinClause:
 
 class Query:
     def __init__(self):
+        self.ctes: List[Tuple[str, "Query"]] = []
         self.items: Optional[List[SelectItem]] = None  # None = SELECT *
         self.distinct = False
         self.table = ""
@@ -171,6 +267,24 @@ class Query:
 
 def parse(text: str) -> Query:
     p = _Parser(_tokenize(text))
+    ctes: List[Tuple[str, Query]] = []
+    if p.accept_kw("with"):
+        while True:
+            name = p.expect_ident()
+            p.expect_kw("as")
+            p.expect_op("(")
+            ctes.append((name, _parse_query(p)))
+            p.expect_op(")")
+            if not p.accept_op(","):
+                break
+    q = _parse_query(p)
+    q.ctes = ctes
+    if not p.at_end():
+        raise SqlError(f"Unexpected trailing SQL: {p._where()}")
+    return q
+
+
+def _parse_query(p: _Parser) -> Query:
     q = Query()
     p.expect_kw("select")
     q.distinct = p.accept_kw("distinct") is not None
@@ -195,7 +309,6 @@ def parse(text: str) -> Query:
             on.append(_parse_on_eq(p))
         q.joins.append(JoinClause(view, alias, how, on))
     if p.accept_kw("where"):
-        p.allow_agg = False
         q.where = _parse_or(p)
     if p.accept_kw("group"):
         p.expect_kw("by")
@@ -203,9 +316,7 @@ def parse(text: str) -> Query:
         while p.accept_op(","):
             q.group_by.append(p.expect_ident())
     if p.accept_kw("having"):
-        p.allow_agg = True
         q.having = _parse_or(p)
-        p.allow_agg = False
     if p.accept_kw("order"):
         p.expect_kw("by")
         q.order_by = [_parse_order_item(p)]
@@ -216,8 +327,6 @@ def parse(text: str) -> Query:
         if t[0] != "number":
             raise SqlError("LIMIT expects a number")
         q.limit = int(t[1])
-    if not p.at_end():
-        raise SqlError(f"Unexpected trailing SQL: {p._where()}")
     return q
 
 
@@ -242,22 +351,11 @@ def _parse_join_type(p: _Parser) -> Optional[str]:
 
 
 def _parse_item(p: _Parser) -> SelectItem:
-    t = p.peek()
-    if t is not None and t[0] == "kw" and t[1] in _AGG_FNS:
-        fn = p.next()[1]
-        p.expect_op("(")
-        if p.accept_op("*"):
-            arg = None
-            if fn != "count":
-                raise SqlError(f"{fn.upper()}(*) is not valid")
-        else:
-            arg = p.expect_ident()
-        p.expect_op(")")
-        alias = _maybe_alias(p)
-        return SelectItem(None, alias, (fn, arg))
-    name = p.expect_ident()
+    start = p.i
+    e = _parse_or(p)
+    text = p.text_since(start)
     alias = _maybe_alias(p)
-    return SelectItem(name, alias, None)
+    return SelectItem(e, alias, text)
 
 
 def _parse_on_eq(p: _Parser) -> Tuple[str, str]:
@@ -319,11 +417,15 @@ def _parse_cmp(p: _Parser) -> Expr:
         negate = True
     if p.accept_kw("in"):
         p.expect_op("(")
-        values = [_parse_literal_value(p)]
-        while p.accept_op(","):
-            values.append(_parse_literal_value(p))
-        p.expect_op(")")
-        e = left.isin(values)
+        if p.peek() == ("kw", "select"):
+            e: Expr = _InQuery(left, _parse_query(p))
+            p.expect_op(")")
+        else:
+            values = [_parse_literal_value(p)]
+            while p.accept_op(","):
+                values.append(_parse_literal_value(p))
+            p.expect_op(")")
+            e = left.isin(values)
         return ~e if negate else e
     if negate:
         raise SqlError("NOT must be followed by IN here")
@@ -360,6 +462,10 @@ def _parse_term(p: _Parser) -> Expr:
 
 def _parse_factor(p: _Parser) -> Expr:
     if p.accept_op("("):
+        if p.peek() == ("kw", "select"):
+            sub = _SubquerySelect(_parse_query(p))
+            p.expect_op(")")
+            return sub
         e = _parse_or(p)
         p.expect_op(")")
         return e
@@ -369,29 +475,22 @@ def _parse_factor(p: _Parser) -> Expr:
     if t is None:
         raise SqlError("Unexpected end of expression")
     if t[0] == "kw" and t[1] in _AGG_FNS and p.peek(1) == ("op", "("):
-        if not getattr(p, "allow_agg", False):
-            raise SqlError(f"Aggregate {t[1].upper()}() is not allowed in WHERE; use HAVING")
-        # aggregate call in a predicate (HAVING COUNT(*) > 1): reference the
-        # aggregate's canonical output name; plan_query maps it to the actual
-        # (possibly aliased) output column
         fn = p.next()[1]
         p.expect_op("(")
         if p.accept_op("*"):
-            arg = None
             if fn != "count":
                 raise SqlError(f"{fn.upper()}(*) is not valid")
-        else:
-            arg = p.expect_ident()
+            p.expect_op(")")
+            return _AggCall(fn, None, "*")
+        start = p.i
+        arg = _parse_sum(p)
+        text = p.text_since(start)
         p.expect_op(")")
-        return col(_canonical_agg_name(fn, arg))
+        return _AggCall(fn, arg, text)
     if t[0] == "ident":
         p.i += 1
         return col(t[1])  # qualifiers resolve at plan time (alias map needed)
     return lit(_parse_literal_value(p))
-
-
-def _canonical_agg_name(fn: str, arg: Optional[str]) -> str:
-    return f"{fn}({_strip_qualifier(arg)})" if arg is not None else "count"
 
 
 def _parse_literal_value(p: _Parser) -> Any:
@@ -413,13 +512,121 @@ def _parse_literal_value(p: _Parser) -> Any:
     raise SqlError(f"Expected a literal, got {t[1]!r}")
 
 
+# --- expression utilities --------------------------------------------------
+
+
+def _walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from _walk(c)
+
+
+def _contains_agg(e: Expr) -> bool:
+    return any(isinstance(x, _AggCall) for x in _walk(e))
+
+
+def _rewrite(e: Expr, mapping: Dict[str, str]) -> Expr:
+    """Column-reference rewrite that also traverses the parse-time markers
+    (the shared expr.rewrite_columns does not know them)."""
+    if isinstance(e, Col):
+        return Col(mapping.get(e.name, e.name))
+    if isinstance(e, _AggCall):
+        return _AggCall(e.fn, _rewrite(e.arg, mapping) if e.arg is not None else None, e.text)
+    if isinstance(e, _InQuery):
+        return _InQuery(_rewrite(e.child, mapping), e.query)
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, _rewrite(e.left, mapping), _rewrite(e.right, mapping))
+    if isinstance(e, Not):
+        return Not(_rewrite(e.child, mapping))
+    if isinstance(e, IsNull):
+        return IsNull(_rewrite(e.child, mapping))
+    if isinstance(e, In):
+        return In(_rewrite(e.child, mapping), list(e.values))
+    from hyperspace_tpu.plan.expr import InSubquery
+
+    if isinstance(e, InSubquery):
+        return InSubquery(_rewrite(e.child, mapping), e.plan, e.session)
+    return e
+
+
+def _resolve_expr_refs(e: Expr, resolve) -> Expr:
+    mapping = {}
+    for ref in e.references():
+        resolved = resolve(ref)
+        if resolved != ref:
+            mapping[ref] = resolved
+    return _rewrite(e, mapping) if mapping else e
+
+
+def _bind_subqueries(e: Expr, views, session) -> Expr:
+    """Replace parse-time subquery markers with planned Scalar/In subqueries
+    over the same view namespace (CTEs included)."""
+    from hyperspace_tpu.plan.expr import InSubquery, ScalarSubquery
+
+    if isinstance(e, _SubquerySelect):
+        return ScalarSubquery(plan_query(e.query, views).plan, session)
+    if isinstance(e, _InQuery):
+        inner = plan_query(e.query, views)
+        return InSubquery(_bind_subqueries(e.child, views, session), inner.plan, session)
+    if isinstance(e, _AggCall):
+        return _AggCall(
+            e.fn, _bind_subqueries(e.arg, views, session) if e.arg is not None else None, e.text
+        )
+    if isinstance(e, BinaryOp):
+        return BinaryOp(
+            e.op, _bind_subqueries(e.left, views, session), _bind_subqueries(e.right, views, session)
+        )
+    if isinstance(e, Not):
+        return Not(_bind_subqueries(e.child, views, session))
+    if isinstance(e, IsNull):
+        return IsNull(_bind_subqueries(e.child, views, session))
+    if isinstance(e, In):
+        return In(_bind_subqueries(e.child, views, session), list(e.values))
+    return e
+
+
+def _case_map(e: Expr, available: List[str]) -> Tuple[Expr, List[str]]:
+    """Resolve ``e``'s column references case-insensitively against the
+    available columns; returns (rewritten expr, still-unknown refs)."""
+    colset = set(available)
+    lowered = {c.lower(): c for c in available}
+    mapping: Dict[str, str] = {}
+    unknown: List[str] = []
+    for ref in e.references():
+        if ref in colset:
+            continue
+        m = lowered.get(ref.lower())
+        if m is not None:
+            mapping[ref] = m
+        else:
+            unknown.append(ref)
+    return (_rewrite(e, mapping) if mapping else e), sorted(unknown)
+
+
+def _canonical_agg_name(fn: str, arg: Optional[Expr], text: str) -> str:
+    if arg is None:
+        return "count"
+    if isinstance(arg, Col):
+        return f"{fn}({_strip_qualifier(arg.name)})"
+    return f"{fn}({text})"
+
+
 # --- planning -------------------------------------------------------------
 
 
 def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa: F821
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Compute, Rename, join_output_names
+
+    if q.ctes:
+        views = dict(views)
+        for name, cq in q.ctes:
+            views[name] = plan_query(cq, views)
+
     if q.table not in views:
         raise SqlError(f"Unknown table/view {q.table!r}; register with create_or_replace_temp_view")
     df = views[q.table]
+    session = df.session
     # alias -> {lowercased source column -> its actual name in the joined
     # frame}. Join dedup renames right-side duplicates ('x' -> 'x#r', 'x#r#r',
     # ...; plan/logical.py join_output_names is the single source of truth),
@@ -436,11 +643,9 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
         condition: Optional[Expr] = None
         left_cols = {c.lower() for c in df.plan.output_columns}
         for a, b in j.on:
-            an, bn = _resolve_side(a, b, j.alias, alias_cols, left_cols, right)
+            an, bn = _resolve_side(a, b, j.alias, alias_cols, left_cols)
             term = col(an) == col(bn)
             condition = term if condition is None else (condition & term)
-        from hyperspace_tpu.plan.logical import join_output_names
-
         _, rename = join_output_names(df.plan.output_columns, right.plan.output_columns)
         df = df.join(right, on=condition, how=j.how)
         alias_cols[j.alias.lower()] = {
@@ -449,97 +654,227 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
 
     resolve_ref = _make_ref_resolver(df, alias_cols)
 
+    def prep(e: Expr) -> Expr:
+        return _bind_subqueries(_resolve_expr_refs(e, resolve_ref), views, session)
+
     if q.where is not None:
-        df = df.filter(_resolve_expr_refs(q.where, resolve_ref))
+        where = prep(q.where)
+        for x in _walk(where):
+            if isinstance(x, _AggCall):
+                raise SqlError(
+                    f"Aggregate {x.fn.upper()}() is not allowed in WHERE; use HAVING"
+                )
+        df = df.filter(where)
+
+    prepared = (
+        [(it, prep(it.expr)) for it in q.items] if q.items is not None else None
+    )
+    having_e = prep(q.having) if q.having is not None else None
+
+    is_agg = bool(q.group_by) or (
+        prepared is not None and any(_contains_agg(e) for _, e in prepared)
+    )
+    if having_e is not None and not is_agg:
+        raise SqlError("HAVING requires GROUP BY or aggregates in SELECT")
 
     renames: Dict[str, str] = {}
-    agg_items = [it for it in (q.items or []) if it.agg is not None]
-    if q.having is not None and not (agg_items or q.group_by):
-        raise SqlError("HAVING requires GROUP BY or aggregates in SELECT")
-    if agg_items or q.group_by:
-        if q.items is None:
-            raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
-        group_keys = [resolve_ref(g) for g in q.group_by]
-        aggs = {}
-        out_order: List[str] = []
-        canonical_out: Dict[str, str] = {}  # canonical agg name -> output name
-        for it in q.items:
-            if it.agg is not None:
-                fn, arg = it.agg
-                arg = resolve_ref(arg) if arg is not None else None
-                canonical = _canonical_agg_name(fn, arg)
-                name = it.alias or canonical
-                aggs[name] = (arg if arg is not None else "*", fn)
-                out_order.append(name)
-                canonical_out.setdefault(canonical, name)
-            else:
-                plain = resolve_ref(it.name)
-                if plain.lower() not in {g.lower() for g in group_keys}:
-                    raise SqlError(f"Column {plain!r} must appear in GROUP BY or an aggregate")
-                out_order.append(plain)
-                if it.alias:
-                    renames[plain] = it.alias
-        _surface_plain_names(q.items, out_order, renames)
-        if not aggs:
-            raise SqlError("GROUP BY requires at least one aggregate in SELECT")
-        df = df.group_by(*group_keys).agg(**aggs) if group_keys else df.agg(**aggs)
-        if q.having is not None:
-            # HAVING COUNT(*) parses to the canonical agg name; map it onto
-            # the actual (possibly aliased) output column
-            def resolve_having(name: str) -> str:
-                r = resolve_ref(name)
-                return canonical_out.get(r, r)
+    names: List[str] = []  # projection, pre-rename
 
-            having = _resolve_expr_refs(q.having, resolve_having)
-            unknown = sorted(set(having.references()) - set(df.plan.output_columns))
-            if unknown:
-                raise SqlError(
-                    f"HAVING references {unknown}, which are not among the "
-                    f"aggregate outputs {df.plan.output_columns}; add the "
-                    "aggregate to SELECT or alias it"
-                )
-            df = df.filter(having)
-        missing = [c for c in out_order if c not in df.plan.output_columns]
-        if missing:
-            raise SqlError(f"Unknown output columns {missing}")
-        df = df.select(*out_order)
-    elif q.items is not None:
-        names = []
-        for it in q.items:
-            name = _resolve_select_name(it.name, df, alias_cols)
-            names.append(name)
-            if it.alias:
-                renames[name] = it.alias
+    if is_agg:
+        if prepared is None:
+            raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
+        df, names = _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session)
+    elif prepared is not None:
+        computes: List[Tuple[str, Expr]] = []
+        for i, (it, e) in enumerate(prepared):
+            if isinstance(e, Col):
+                name = _resolve_select_name(it.expr.name, df, alias_cols)
+                names.append(name)
+                if it.alias:
+                    renames[name] = it.alias
+            else:
+                e, unknown = _case_map(e, df.plan.output_columns)
+                if unknown:
+                    raise SqlError(f"Unknown columns {unknown} in expression {it.text!r}")
+                internal = f"__expr{i}"
+                computes.append((internal, e))
+                names.append(internal)
+                renames[internal] = it.alias or it.text
         _surface_plain_names(q.items, names, renames)
-        df = df.select(*names)
+        if computes:
+            df = DataFrame(Compute(computes, df.plan), session)
 
     if q.distinct:
-        if agg_items or q.group_by:
+        if is_agg:
             raise SqlError("SELECT DISTINCT cannot be combined with GROUP BY/aggregates")
+        if prepared is not None:
+            df = df.select(*names)
+            names = []
         df = df.distinct()
 
-    if renames:
-        from hyperspace_tpu.plan.dataframe import DataFrame
-        from hyperspace_tpu.plan.logical import Rename
+    # ORDER BY keys may reference output aliases, projected columns, or
+    # non-projected columns (the latter sort before the projection drops
+    # them, Spark-style)
+    sort_specs: List[Tuple[str, bool]] = []
+    extra_sort_cols: List[str] = []
+    if q.order_by:
+        pre_cols = set(df.plan.output_columns)
+        final_by_src = {n: renames.get(n, n) for n in names}
+        aliases_set = set(renames.values())
+        for name, asc in q.order_by:
+            n = resolve_ref(name)
+            if names and n in final_by_src:
+                sort_specs.append((final_by_src[n], asc))
+            elif n in aliases_set:
+                sort_specs.append((n, asc))
+            elif not names and n in pre_cols:  # SELECT * (or post-DISTINCT)
+                # the Rename applies before the sort, so map aliased names
+                sort_specs.append((renames.get(n, n), asc))
+            elif names and n in pre_cols:
+                extra_sort_cols.append(n)
+                sort_specs.append((n, asc))
+            else:
+                raise SqlError(
+                    f"ORDER BY column {name!r} is neither an output column "
+                    f"nor available before the projection ({sorted(pre_cols)})"
+                )
 
+    if names:
+        df = df.select(*names + [c for c in extra_sort_cols if c not in names])
+    if renames:
         try:
             df = DataFrame(Rename(renames, df.plan), df.session)
         except ValueError as e:  # e.g. alias collides with another column
             raise SqlError(f"Invalid AS aliases: {e}")
-
-    if q.order_by:
-        out_cols = df.plan.output_columns
-
-        def order_key(name: str) -> str:
-            n = resolve_ref(name)
-            if n not in out_cols and renames.get(n) in out_cols:
-                return renames[n]  # ORDER BY source name after AS
-            return n
-
-        df = df.order_by(*[order_key(n) for n, _ in q.order_by], ascending=[a for _, a in q.order_by])
+    if sort_specs:
+        df = df.order_by(*[n for n, _ in sort_specs], ascending=[a for _, a in sort_specs])
+    if extra_sort_cols:
+        final = [renames.get(n, n) for n in names]
+        df = df.select(*final)
     if q.limit is not None:
         df = df.limit(q.limit)
     return df
+
+
+def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
+    """Plan the aggregate branch: pre-aggregate computes for expression
+    arguments, the Aggregate node, HAVING, and post-aggregate computes for
+    expressions over aggregate outputs. Returns (df, projection names)."""
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Aggregate, Compute
+
+    group_keys = [resolve_ref(g) for g in q.group_by]
+    group_lower = {g.lower() for g in group_keys}
+
+    pre_computes: List[Tuple[str, Expr]] = []
+    aggs: List[Tuple[str, str, Optional[str]]] = []  # (out, fn, input col)
+    agg_out_by_key: Dict[Tuple[str, str], str] = {}
+    canonical_out: Dict[str, str] = {}
+    taken_out: Set[str] = set(group_keys)
+
+    def register(ac: _AggCall, preferred: Optional[str] = None) -> str:
+        canonical = _canonical_agg_name(ac.fn, ac.arg, ac.text)
+        key = (ac.fn, ac.text if ac.arg is not None else "*")
+        if preferred is None and key in agg_out_by_key:
+            return agg_out_by_key[key]
+        if ac.arg is None:
+            in_col = None
+        elif isinstance(ac.arg, Col):
+            in_col = ac.arg.name
+        else:
+            in_col = f"__aggin{len(pre_computes)}"
+            arg, unknown = _case_map(ac.arg, df.plan.output_columns)
+            if unknown:
+                raise SqlError(f"Unknown columns {unknown} in aggregate {ac.text!r}")
+            pre_computes.append((in_col, arg))
+        out = preferred or canonical
+        if out in taken_out:
+            if preferred is None:
+                return agg_out_by_key.get(key, canonical)
+            raise SqlError(f"Duplicate output name {out!r}")
+        taken_out.add(out)
+        aggs.append((out, ac.fn, in_col))
+        agg_out_by_key.setdefault(key, out)
+        canonical_out.setdefault(canonical, out)
+        return out
+
+    def replace_aggs(e: Expr, preferred: Optional[str] = None) -> Expr:
+        if isinstance(e, _AggCall):
+            return Col(register(e, preferred))
+        if isinstance(e, BinaryOp):
+            return BinaryOp(e.op, replace_aggs(e.left), replace_aggs(e.right))
+        if isinstance(e, Not):
+            return Not(replace_aggs(e.child))
+        if isinstance(e, IsNull):
+            return IsNull(replace_aggs(e.child))
+        if isinstance(e, In):
+            return In(replace_aggs(e.child), list(e.values))
+        return e
+
+    # first pass: items that ARE bare aggregate calls claim their alias as
+    # the aggregate's output name (matches the reference's Spark naming)
+    item_exprs: List[Optional[Expr]] = []
+    for it, e in prepared:
+        if isinstance(e, _AggCall):
+            out = register(e, preferred=it.alias)
+            item_exprs.append(Col(out))
+        else:
+            item_exprs.append(None)
+    for idx, (it, e) in enumerate(prepared):
+        if item_exprs[idx] is None:
+            item_exprs[idx] = replace_aggs(e)
+
+    if not aggs:
+        raise SqlError("GROUP BY requires at least one aggregate in SELECT")
+
+    if pre_computes:
+        df = DataFrame(Compute(pre_computes, df.plan), session)
+    df = DataFrame(Aggregate(group_keys, aggs, df.plan), session)
+
+    if having_e is not None:
+
+        def resolve_having(name: str) -> str:
+            return canonical_out.get(name, name)
+
+        having = _resolve_expr_refs(replace_aggs(having_e), resolve_having)
+        unknown = sorted(set(having.references()) - set(df.plan.output_columns))
+        if unknown:
+            raise SqlError(
+                f"HAVING references {unknown}, which are not among the "
+                f"aggregate outputs {df.plan.output_columns}; add the "
+                "aggregate to SELECT or alias it"
+            )
+        df = df.filter(having)
+
+    names: List[str] = []
+    post_computes: List[Tuple[str, Expr]] = []
+    for i, ((it, _), e) in enumerate(zip(prepared, item_exprs)):
+        if isinstance(e, Col):
+            n = e.name
+            if n not in df.plan.output_columns:
+                if n.lower() in group_lower:
+                    n = next(g for g in group_keys if g.lower() == n.lower())
+                else:
+                    raise SqlError(
+                        f"Column {n!r} must appear in GROUP BY or an aggregate"
+                    )
+            names.append(n)
+            if it.alias and it.alias != n:
+                renames[n] = it.alias
+        else:
+            e, unknown = _case_map(e, df.plan.output_columns)
+            if unknown:
+                raise SqlError(
+                    f"Columns {unknown} in {it.text!r} must appear in GROUP BY or an aggregate"
+                )
+            internal = f"__aggexpr{i}"
+            post_computes.append((internal, e))
+            names.append(internal)
+            renames[internal] = it.alias or it.text
+    if post_computes:
+        df = DataFrame(Compute(post_computes, df.plan), session)
+    _surface_plain_names([it for it, _ in prepared], names, renames)
+    return df, names
 
 
 def _make_ref_resolver(df, alias_cols):
@@ -552,30 +887,31 @@ def _make_ref_resolver(df, alias_cols):
             qual, rest = name.split(".", 1)
             mapping = alias_cols.get(qual.lower())
             if mapping is not None:
-                got = mapping.get(rest.lower())
-                if got is None:
-                    raise SqlError(
-                        f"Column {rest!r} not found in table/alias {qual!r} "
-                        f"(has {sorted(mapping.values())})"
-                    )
-                return got
+                return _map_qualified(mapping, qual, rest)
         return name
 
     return resolve
 
 
-def _resolve_expr_refs(e: Expr, resolve) -> Expr:
-    from hyperspace_tpu.plan.expr import rewrite_columns
+def _map_qualified(mapping: Dict[str, str], qual: str, rest: str) -> str:
+    """Map an alias-qualified column through the alias's column map; a dotted
+    remainder falls back to mapping the path root so nested-struct references
+    (``t.addr.city``) keep working."""
+    got = mapping.get(rest.lower())
+    if got is not None:
+        return got
+    if "." in rest:
+        root, path = rest.split(".", 1)
+        mapped = mapping.get(root.lower())
+        if mapped is not None:
+            return f"{mapped}.{path}"
+    raise SqlError(
+        f"Column {rest!r} not found in table/alias {qual!r} "
+        f"(has {sorted(mapping.values())})"
+    )
 
-    mapping = {}
-    for ref in e.references():
-        resolved = resolve(ref)
-        if resolved != ref:
-            mapping[ref] = resolved
-    return rewrite_columns(e, mapping) if mapping else e
 
-
-def _resolve_side(a: str, b: str, right_alias: str, alias_cols, left_cols, right) -> Tuple[str, str]:
+def _resolve_side(a: str, b: str, right_alias: str, alias_cols, left_cols) -> Tuple[str, str]:
     """Order an ON pair as (left column, right column) using qualifiers when
     present, else membership; left references map through the alias column
     map so keys renamed by an earlier join's dedup resolve correctly."""
@@ -628,13 +964,7 @@ def _resolve_select_name(name: str, df, alias_cols) -> str:
         qual, rest = name.split(".", 1)
         mapping = alias_cols.get(qual.lower())
         if mapping is not None:
-            got = mapping.get(rest.lower())
-            if got is None:
-                raise SqlError(
-                    f"Column {rest!r} not found in table/alias {qual!r} "
-                    f"(has {sorted(mapping.values())})"
-                )
-            return got
+            return _map_qualified(mapping, qual, rest)
     if plain in cols_:
         return plain
     lowered = {c.lower(): c for c in cols_}

@@ -46,6 +46,19 @@ def destructure_linear(plan: L.LogicalPlan) -> Optional[Tuple[Optional[List[str]
             if project_cols is None:
                 project_cols = list(node.columns)
             node = node.child
+        elif isinstance(node, L.Compute):
+            # computed columns need their input columns from the scan: swap
+            # each computed name in the projection for the expression's
+            # references (SQL expression SELECT items plan as Compute)
+            exprs = dict(node.exprs)
+            if condition is not None and set(condition.references()) & set(exprs):
+                return None  # a filter over computed columns can't move below them
+            if project_cols is not None:
+                resolved: List[str] = []
+                for c in project_cols:
+                    resolved.extend(sorted(exprs[c].references()) if c in exprs else [c])
+                project_cols = list(dict.fromkeys(resolved))
+            node = node.child
         elif isinstance(node, L.Filter):
             condition = node.condition if condition is None else condition & node.condition
             node = node.child
@@ -148,10 +161,26 @@ def transform_plan_to_use_index(
     else:
         new_scan = _hybrid_scan_plan(ctx, entry, scan, required_all, bucket_spec)
 
+    # canonical rebuild Project→Compute*→Filter→IndexScan: filters sit
+    # DIRECTLY above the scan (the executor's device fast paths match that
+    # shape) and Compute nodes (SQL expression SELECT items) re-apply above,
+    # in their original order
+    node, outer_cols, computes = sub_plan, None, []
+    while not isinstance(node, L.Scan):
+        if isinstance(node, L.Project) and outer_cols is None:
+            outer_cols = list(node.columns)
+        if isinstance(node, L.Compute):
+            computes.append(node)
+        (node,) = node.children()
+
     out: L.LogicalPlan = new_scan
     if condition is not None:
         out = L.Filter(condition, out)
-    if project_cols is not None or set(out.output_columns) != set(required):
+    for comp in reversed(computes):  # innermost compute first
+        out = L.Compute(comp.exprs, out)
+    if outer_cols is not None:
+        out = L.Project(outer_cols, out)
+    elif set(out.output_columns) != set(required):
         out = L.Project(list(required), out)
     return out
 
@@ -241,6 +270,20 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         return L.Project(plan.columns, prune_columns(plan.child, child_needed))
     if isinstance(plan, L.Filter):
         child_needed = None if needed is None else set(needed) | set(plan.condition.references())
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, child_needed)])
+    if isinstance(plan, L.Compute):
+        # a computed column needs its expression's inputs instead of itself
+        if needed is None:
+            child_needed = None
+        else:
+            exprs = dict(plan.exprs)
+            child_needed = set()
+            for c in needed:
+                if c in exprs:
+                    child_needed |= exprs[c].references()
+                else:
+                    child_needed.add(c)
         (child,) = plan.children()
         return plan.with_children([prune_columns(child, child_needed)])
     if isinstance(plan, L.Join):

