@@ -1393,6 +1393,8 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
     plans = []
     need_l, need_r = set(), set()
     for name, fn, col_name in agg.aggs:
+        if fn not in _AGG_FNS:
+            raise DeviceUnsupported(f"unsupported aggregate fn {fn!r} -> materialize")
         if fn == "count" and col_name is None:
             plans.append((name, "count*", None, None))
             continue
@@ -1541,6 +1543,9 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
 
     plans = []
     need_l, need_r = set(lkeys), set()
+    for _, fn, _c in agg.aggs:
+        if fn not in _AGG_FNS:
+            raise DeviceUnsupported(f"unsupported aggregate fn {fn!r} -> materialize")
     for name, fn, col_name in agg.aggs:
         if fn == "count" and col_name is None:
             plans.append((name, "count*", None, None))

@@ -398,14 +398,23 @@ class Executor:
 
         _PD_FN = {"avg": "mean", "sum": "sum", "min": "min", "max": "max"}
 
+        def _global_agg(fn: str, col_name: Optional[str]):
+            if fn == "count":
+                return n if col_name is None else int(pd.Series(series(col_name)).count())
+            s = pd.Series(series(col_name))
+            if fn == "count_distinct":
+                return int(s.nunique(dropna=True))
+            if fn in ("sum_distinct", "avg_distinct"):
+                d = s.dropna().drop_duplicates()
+                return d.sum() if fn == "sum_distinct" else d.mean()
+            if fn == "stddev_samp":
+                return s.std(ddof=1)
+            return getattr(s, _PD_FN[fn])()
+
         if not plan.keys:
             out: B.Batch = {}
             for name, fn, col_name in plan.aggs:
-                if fn == "count":
-                    out[name] = np.asarray([n if col_name is None else int(pd.Series(series(col_name)).count())])
-                else:
-                    s = pd.Series(series(col_name))
-                    out[name] = np.asarray([getattr(s, _PD_FN[fn])()])
+                out[name] = np.asarray([_global_agg(fn, col_name)])
             return out
 
         frame_cols = {k: series(k) for k in plan.keys}  # series(): dotted keys too
@@ -421,6 +430,14 @@ class Executor:
                 pieces[name] = grouped.size()
             elif fn == "count":
                 pieces[name] = grouped[col_name].count()
+            elif fn == "count_distinct":
+                pieces[name] = grouped[col_name].nunique(dropna=True)
+            elif fn == "sum_distinct":
+                pieces[name] = grouped[col_name].agg(lambda s: s.dropna().drop_duplicates().sum())
+            elif fn == "avg_distinct":
+                pieces[name] = grouped[col_name].agg(lambda s: s.dropna().drop_duplicates().mean())
+            elif fn == "stddev_samp":
+                pieces[name] = grouped[col_name].std(ddof=1)
             else:
                 pieces[name] = getattr(grouped[col_name], _PD_FN[fn])()
         result = pd.DataFrame(pieces).reset_index()

@@ -1,0 +1,75 @@
+"""Multi-process runtime startup (SURVEY.md §5.8 distributed backend).
+
+The reference inherits Spark's cluster runtime; here a multi-host device
+mesh comes up through ``jax.distributed``: every process calls
+``initialize_from_env()`` before touching devices, then ``make_mesh_2d``
+(parallel/mesh.py) aligns its ``dcn`` axis with process boundaries — so the
+hierarchical re-bucketing exchange (ops/bucketize.rebucket_hierarchical)
+keeps phase-1 ``all_to_all`` traffic on the fast intra-host/ICI links and
+crosses the process (DCN) boundary exactly once per row.
+
+Configuration, by env var or keyword:
+
+  HS_COORDINATOR     ``host:port`` of process 0's coordinator service
+                     (default ``127.0.0.1:29500``)
+  HS_NUM_PROCESSES   world size
+  HS_PROCESS_ID      this process's rank in [0, world size)
+
+On a real TPU pod slice, ``jax.distributed.initialize()`` with no arguments
+discovers all of this from the TPU metadata service; the env-var path exists
+for CPU smoke tests and non-TPU clusters. A two-process localhost CPU run is
+exercised by tests/test_multihost.py.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize_from_env(
+    coordinator: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Initialize the multi-process JAX runtime from env/kwargs.
+
+    Returns True if ``jax.distributed.initialize`` ran, False when no
+    multi-process configuration is present (single-process mode: a no-op so
+    the same entry point works everywhere). Idempotent."""
+    global _initialized
+    if _initialized:
+        return True
+    num_processes = num_processes if num_processes is not None else _int_env("HS_NUM_PROCESSES")
+    if num_processes is None or num_processes <= 1:
+        return False
+    process_id = process_id if process_id is not None else _int_env("HS_PROCESS_ID")
+    if process_id is None:
+        raise ValueError("HS_PROCESS_ID must be set when HS_NUM_PROCESSES > 1")
+    coordinator = coordinator or os.environ.get("HS_COORDINATOR", "127.0.0.1:29500")
+
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True
+
+
+def shutdown() -> None:
+    global _initialized
+    if _initialized:
+        import jax
+
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def _int_env(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else None

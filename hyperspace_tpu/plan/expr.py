@@ -237,6 +237,49 @@ _COMPARES = {"=", "!=", "<", "<=", ">", ">="}
 _ARITH = {"+", "-", "*", "/", "%"}
 
 
+def _coerce_compare(l, r):
+    """SQL-style implicit casts for comparisons: a string literal against a
+    date column becomes a date (``d_date <= '2000-03-11'``), and an object
+    array holding SQL NULLs (None) compared with numbers becomes float with
+    NaN (NaN comparisons are False, matching NULL-is-unknown filtering)."""
+    l_, r_ = np.asarray(l), np.asarray(r)
+    lk, rk = l_.dtype, r_.dtype
+    if lk.kind == "M" and rk.kind in ("U", "S", "O"):
+        return l, r_.astype(l_.dtype)
+    if rk.kind == "M" and lk.kind in ("U", "S", "O"):
+        return l_.astype(r_.dtype), r
+    if lk == object and rk.kind in ("i", "u", "f"):
+        return _object_nums_to_float(l_), r
+    if rk == object and lk.kind in ("i", "u", "f"):
+        return l, _object_nums_to_float(r_)
+    return l, r
+
+
+def _missing_mask(v) -> np.ndarray:
+    """Missing-value mask under the framework convention: NaN for floats,
+    NaT for datetimes, None for object arrays; all-False otherwise."""
+    a = np.asarray(v)
+    if a.dtype.kind == "f":
+        return np.isnan(a)
+    if a.dtype.kind == "M":
+        return np.isnat(a)
+    if a.dtype == object:
+        return np.array(
+            [x is None or (isinstance(x, float) and x != x) for x in a.ravel()], dtype=bool
+        ).reshape(a.shape)
+    return np.zeros(a.shape, dtype=bool)
+
+
+def _object_nums_to_float(arr: np.ndarray):
+    """None -> NaN for numeric object arrays; non-numeric arrays unchanged."""
+    try:
+        return np.array(
+            [np.nan if v is None else float(v) for v in arr.ravel()], dtype=np.float64
+        ).reshape(arr.shape)
+    except (TypeError, ValueError):
+        return arr
+
+
 class BinaryOp(Expr):
     def __init__(self, op: str, left: Expr, right: Expr):
         self.op = op
@@ -279,18 +322,23 @@ class BinaryOp(Expr):
             if op == "!=":
                 return NullableBool(lv != rv, lu | ru)
             raise ValueError(f"Operator {op!r} undefined for boolean NULL operands")
-        if op == "=":
-            return np.asarray(l == r)
-        if op == "!=":
-            return np.asarray(l != r)
-        if op == "<":
-            return np.asarray(l < r)
-        if op == "<=":
-            return np.asarray(l <= r)
-        if op == ">":
-            return np.asarray(l > r)
-        if op == ">=":
-            return np.asarray(l >= r)
+        if op in _COMPARES:
+            l, r = _coerce_compare(l, r)
+            res = {
+                "=": lambda: np.asarray(l == r),
+                "!=": lambda: np.asarray(l != r),
+                "<": lambda: np.asarray(l < r),
+                "<=": lambda: np.asarray(l <= r),
+                ">": lambda: np.asarray(l > r),
+                ">=": lambda: np.asarray(l >= r),
+            }[op]()
+            # SQL NULL-is-unknown: a comparison touching NULL (NaN/NaT under
+            # the framework's missing-value convention) is three-valued, not
+            # definite — in particular NULL != x must not come out True
+            unknown = _missing_mask(l) | _missing_mask(r)
+            if np.any(unknown):
+                return NullableBool(res & ~unknown, unknown)
+            return res
         if op == "+":
             return l + r
         if op == "-":
@@ -442,6 +490,200 @@ def _kleene_or(l, r):
     known_true = (~lu & lv) | (~ru & rv)
     unknown = (lu | ru) & ~known_true
     return NullableBool(known_true, unknown)
+
+
+def _broadcast_rows(v, n: int) -> np.ndarray:
+    v = np.asarray(v)
+    return np.broadcast_to(v, (n,)) if v.ndim == 0 else v
+
+
+def _batch_rows(batch: Dict[str, np.ndarray]) -> int:
+    for c in batch.values():
+        if getattr(c, "ndim", 0):
+            return c.shape[0]
+    return 1
+
+
+class Case(Expr):
+    """SQL CASE WHEN ... THEN ... [ELSE ...] END; the unmatched default is
+    SQL NULL (NaN for numeric results, None for strings)."""
+
+    def __init__(self, branches, otherwise: Optional[Expr]):
+        self.branches = [(c, v) for c, v in branches]
+        self.otherwise = otherwise
+
+    def children(self) -> Sequence[Expr]:
+        out = []
+        for c, v in self.branches:
+            out.extend((c, v))
+        if self.otherwise is not None:
+            out.append(self.otherwise)
+        return tuple(out)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        n = _batch_rows(batch)
+        conds = [np.broadcast_to(as_bool_mask(c.eval(batch)), (n,)) for c, _ in self.branches]
+        vals = [_broadcast_rows(v.eval(batch), n) for _, v in self.branches]
+        otherwise = self.otherwise
+        if isinstance(otherwise, Lit) and otherwise.value is None:
+            otherwise = None  # ELSE NULL == no ELSE; keeps numeric dtype (NaN)
+        if otherwise is not None:
+            default = _broadcast_rows(otherwise.eval(batch), n)
+        elif any(v.dtype.kind in ("U", "S", "O") for v in vals):
+            default = np.full(n, None, dtype=object)
+        else:
+            default = np.full(n, np.nan)
+        return np.select(conds, vals, default=default)
+
+    def __repr__(self) -> str:
+        parts = [f"WHEN {c!r} THEN {v!r}" for c, v in self.branches]
+        if self.otherwise is not None:
+            parts.append(f"ELSE {self.otherwise!r}")
+        return f"CASE {' '.join(parts)} END"
+
+
+class Like(Expr):
+    """SQL LIKE with % (any run) and _ (any one char) wildcards."""
+
+    def __init__(self, child: Expr, pattern: str):
+        import re as _re
+
+        self.child = child
+        self.pattern = pattern
+        rx = "^" + _re.escape(pattern).replace("%", ".*").replace("_", ".") + "$"
+        self._rx = _re.compile(rx)
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        v = self.child.eval(batch)
+        return np.array(
+            [x is not None and self._rx.match(str(x)) is not None for x in np.asarray(v).ravel()],
+            dtype=bool,
+        )
+
+    def __repr__(self) -> str:
+        return f"({self.child!r} LIKE {self.pattern!r})"
+
+
+class Cast(Expr):
+    """SQL CAST(expr AS type); types: int/bigint, double/float/decimal,
+    date, string/char/varchar."""
+
+    def __init__(self, child: Expr, type_name: str):
+        self.child = child
+        self.type_name = type_name.lower()
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child,)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        v = np.asarray(self.child.eval(batch))
+        t = self.type_name
+        if t in ("int", "integer", "bigint", "smallint", "tinyint"):
+            return v.astype(np.int64)
+        if t in ("double", "float", "real") or t.startswith("decimal") or t.startswith("numeric"):
+            return v.astype(np.float64)
+        if t == "date":
+            return v.astype("datetime64[D]")
+        if t in ("string", "char", "varchar", "text") or t.startswith(("char", "varchar")):
+            return v.astype(str)
+        raise ValueError(f"Unsupported CAST target type {self.type_name!r}")
+
+    def __repr__(self) -> str:
+        return f"CAST({self.child!r} AS {self.type_name})"
+
+
+class Func(Expr):
+    """Scalar SQL function call with a numpy evaluation per function."""
+
+    SUPPORTED = (
+        "substr", "substring", "coalesce", "nullif", "abs", "round", "floor",
+        "ceil", "ceiling", "upper", "lower", "trim", "length", "concat",
+    )
+
+    def __init__(self, name: str, args: Sequence[Expr]):
+        self.name = name.lower()
+        if self.name not in self.SUPPORTED:
+            raise ValueError(f"Unsupported function {name!r}")
+        self.args = list(args)
+
+    def children(self) -> Sequence[Expr]:
+        return tuple(self.args)
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        n = _batch_rows(batch)
+        vals = [_broadcast_rows(a.eval(batch), n) for a in self.args]
+        f = self.name
+        if f in ("substr", "substring"):
+            s, start = vals[0], vals[1]
+            ln = vals[2] if len(vals) > 2 else None
+            out = []
+            for i, x in enumerate(s):
+                st = int(start[i]) - 1 if start.ndim else int(start) - 1
+                if ln is None:
+                    out.append(None if x is None else str(x)[st:])
+                else:
+                    ll = int(ln[i]) if getattr(ln, "ndim", 0) else int(ln)
+                    out.append(None if x is None else str(x)[st : st + ll])
+            return np.array(out, dtype=object)
+        if f == "coalesce":
+            out = vals[0].astype(object, copy=True) if vals[0].dtype == object else vals[0].copy()
+            for v in vals[1:]:
+                if out.dtype == object:
+                    miss = np.array([x is None or (isinstance(x, float) and x != x) for x in out])
+                elif out.dtype.kind == "f":
+                    miss = np.isnan(out)
+                else:
+                    break
+                if not miss.any():
+                    break
+                out = np.where(miss, v, out)
+            return out
+        if f == "nullif":
+            a, b = vals
+            eq = a == b
+            if a.dtype.kind == "f":
+                return np.where(eq, np.nan, a)
+            out = a.astype(object)
+            out[eq] = None
+            return out
+        if f == "abs":
+            return np.abs(vals[0])
+        if f == "round":
+            d = 0
+            if len(self.args) > 1:
+                a1 = self.args[1]
+                if isinstance(a1, Lit):
+                    d = int(a1.value)
+                elif getattr(vals[1], "size", 0):
+                    d = int(np.asarray(vals[1]).ravel()[0])
+            return np.round(vals[0], d)
+        if f == "floor":
+            return np.floor(vals[0])
+        if f in ("ceil", "ceiling"):
+            return np.ceil(vals[0])
+        if f == "upper":
+            return np.array([None if x is None else str(x).upper() for x in vals[0]], dtype=object)
+        if f == "lower":
+            return np.array([None if x is None else str(x).lower() for x in vals[0]], dtype=object)
+        if f == "trim":
+            return np.array([None if x is None else str(x).strip() for x in vals[0]], dtype=object)
+        if f == "length":
+            # NULL in -> NULL out (NaN under the missing-value convention)
+            return np.array(
+                [np.nan if x is None else float(len(str(x))) for x in vals[0]], dtype=np.float64
+            )
+        if f == "concat":
+            out = vals[0].astype(str)
+            for v in vals[1:]:
+                out = np.char.add(out, v.astype(str))
+            return out
+        raise ValueError(f"Unsupported function {self.name!r}")
+
+    def __repr__(self) -> str:
+        return f"{self.name}({', '.join(repr(a) for a in self.args)})"
 
 
 class SubqueryExpr(Expr):
@@ -596,4 +838,15 @@ def rewrite_columns(e: Expr, mapping: Dict[str, str]) -> Expr:
         return In(rewrite_columns(e.child, mapping), list(e.values))
     if isinstance(e, InSubquery):
         return InSubquery(rewrite_columns(e.child, mapping), e.plan, e.session)
+    if isinstance(e, Case):
+        return Case(
+            [(rewrite_columns(c, mapping), rewrite_columns(v, mapping)) for c, v in e.branches],
+            rewrite_columns(e.otherwise, mapping) if e.otherwise is not None else None,
+        )
+    if isinstance(e, Like):
+        return Like(rewrite_columns(e.child, mapping), e.pattern)
+    if isinstance(e, Cast):
+        return Cast(rewrite_columns(e.child, mapping), e.type_name)
+    if isinstance(e, Func):
+        return Func(e.name, [rewrite_columns(a, mapping) for a in e.args])
     return e

@@ -325,7 +325,10 @@ class Aggregate(LogicalPlan):
     offers around indexed scans (the reference delegates aggregation to
     Spark; index rewrites apply beneath this node untouched)."""
 
-    FNS = ("count", "sum", "min", "max", "avg")
+    FNS = (
+        "count", "sum", "min", "max", "avg",
+        "count_distinct", "sum_distinct", "avg_distinct", "stddev_samp",
+    )
 
     def __init__(self, keys: List[str], aggs: List[tuple], child: LogicalPlan):
         self.keys = list(keys)

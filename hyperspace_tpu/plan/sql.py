@@ -63,8 +63,9 @@ _TOKEN_RE = re.compile(
     \s*(
         (?P<string>'(?:[^']|'')*')
       | (?P<number>\d+\.\d+|\.\d+|\d+)
-      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)?)
-      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%)
+      | (?P<bq>`[^`]*`)
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*(?:\.[A-Za-z_][A-Za-z0-9_]*)*)
+      | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%|\|\|)
     )""",
     re.VERBOSE,
 )
@@ -73,8 +74,12 @@ _KEYWORDS = {
     "select", "distinct", "from", "where", "group", "by", "having", "order", "limit", "join", "on",
     "inner", "left", "right", "full", "outer", "and", "or", "not", "in", "is",
     "null", "between", "as", "asc", "desc", "date", "count", "sum", "min",
-    "max", "avg", "with",
+    "max", "avg", "with", "case", "when", "then", "else", "end", "like",
+    "union", "all", "exists", "interval", "cast", "over", "rollup",
 }
+
+# aggregate functions that tokenize as plain identifiers (not keywords)
+_IDENT_AGGS = {"stddev_samp": "stddev_samp", "stddev": "stddev_samp"}
 
 _AGG_FNS = ("count", "sum", "min", "max", "avg")
 
@@ -96,6 +101,8 @@ def _tokenize(text: str) -> List[Tuple[str, str]]:
                 out.append(("kw", word.lower()))
             else:
                 out.append(("ident", word))
+        elif m.group("bq") is not None:
+            out.append(("ident", m.group("bq")[1:-1]))
         elif m.group("string") is not None:
             out.append(("string", m.group("string")[1:-1].replace("''", "'")))
         elif m.group("number") is not None:
@@ -243,11 +250,36 @@ class SelectItem:
 
 
 class JoinClause:
-    def __init__(self, view: str, alias: str, how: str, on: List[Tuple[str, str]]):
-        self.view = view
-        self.alias = alias
+    def __init__(self, table_ref: "TableRef", how: str, on: List[Tuple[str, str]]):
+        self.table_ref = table_ref
         self.how = how
         self.on = on
+
+    @property
+    def view(self):
+        return self.table_ref.source
+
+    @property
+    def alias(self) -> str:
+        return self.table_ref.alias
+
+
+class TableRef:
+    """A FROM-clause entry: a named view or a derived table (sub-select)."""
+
+    def __init__(self, source, alias: str):
+        self.source = source  # str view name | Query (derived table)
+        self.alias = alias
+
+
+class FromElement:
+    """One comma-separated FROM element: a table ref plus any JOIN ... ON
+    clauses chained directly onto it (TPC-DS mixes both styles:
+    ``FROM a LEFT JOIN b ON (...), c, d``)."""
+
+    def __init__(self, table_ref: TableRef, joins: List["JoinClause"]):
+        self.table_ref = table_ref
+        self.joins = joins
 
 
 class Query:
@@ -255,14 +287,26 @@ class Query:
         self.ctes: List[Tuple[str, "Query"]] = []
         self.items: Optional[List[SelectItem]] = None  # None = SELECT *
         self.distinct = False
-        self.table = ""
-        self.alias = ""
-        self.joins: List[JoinClause] = []
+        self.from_elements: List[FromElement] = []
         self.where: Optional[Expr] = None
         self.group_by: List[str] = []
         self.having: Optional[Expr] = None
-        self.order_by: List[Tuple[str, bool]] = []
+        self.order_by: List[Tuple[Any, bool]] = []  # (column name | Expr, asc)
         self.limit: Optional[int] = None
+        self.unions: List[Tuple[bool, "Query"]] = []  # (is UNION ALL, rhs)
+
+    # -- compatibility accessors (single-table queries) --------------------
+    @property
+    def table(self):
+        return self.from_elements[0].table_ref.source if self.from_elements else ""
+
+    @property
+    def alias(self) -> str:
+        return self.from_elements[0].table_ref.alias if self.from_elements else ""
+
+    @property
+    def joins(self) -> List["JoinClause"]:
+        return [j for e in self.from_elements for j in e.joins]
 
 
 def parse(text: str) -> Query:
@@ -285,38 +329,10 @@ def parse(text: str) -> Query:
 
 
 def _parse_query(p: _Parser) -> Query:
-    q = Query()
-    p.expect_kw("select")
-    q.distinct = p.accept_kw("distinct") is not None
-    if p.accept_op("*"):
-        q.items = None
-    else:
-        q.items = [_parse_item(p)]
-        while p.accept_op(","):
-            q.items.append(_parse_item(p))
-    p.expect_kw("from")
-    q.table = p.expect_ident()
-    q.alias = _maybe_alias(p) or q.table
-    while True:
-        how = _parse_join_type(p)
-        if how is None:
-            break
-        view = p.expect_ident()
-        alias = _maybe_alias(p) or view
-        p.expect_kw("on")
-        on = [_parse_on_eq(p)]
-        while p.accept_kw("and"):
-            on.append(_parse_on_eq(p))
-        q.joins.append(JoinClause(view, alias, how, on))
-    if p.accept_kw("where"):
-        q.where = _parse_or(p)
-    if p.accept_kw("group"):
-        p.expect_kw("by")
-        q.group_by = [p.expect_ident()]
-        while p.accept_op(","):
-            q.group_by.append(p.expect_ident())
-    if p.accept_kw("having"):
-        q.having = _parse_or(p)
+    q = _parse_union_operand(p)
+    while p.accept_kw("union"):
+        all_ = p.accept_kw("all") is not None
+        q.unions.append((all_, _parse_union_operand(p)))
     if p.accept_kw("order"):
         p.expect_kw("by")
         q.order_by = [_parse_order_item(p)]
@@ -328,6 +344,81 @@ def _parse_query(p: _Parser) -> Query:
             raise SqlError("LIMIT expects a number")
         q.limit = int(t[1])
     return q
+
+
+def _parse_union_operand(p: _Parser) -> Query:
+    """A UNION operand: a bare SELECT core or a parenthesized (sub-)query."""
+    if p.peek() == ("op", "(") and p.peek(1) == ("kw", "select"):
+        p.i += 1
+        q = _parse_query(p)
+        p.expect_op(")")
+        if q.order_by or q.limit is not None:
+            # keep the inner ORDER BY/LIMIT scoped to the branch: wrap it as
+            # a derived table so outer union clauses attach to the wrapper
+            outer = Query()
+            outer.from_elements = [FromElement(TableRef(q, "__union_operand"), [])]
+            return outer
+        return q
+    return _parse_select_core(p)
+
+
+def _parse_select_core(p: _Parser) -> Query:
+    q = Query()
+    p.expect_kw("select")
+    q.distinct = p.accept_kw("distinct") is not None
+    if p.accept_op("*"):
+        q.items = None
+    else:
+        q.items = [_parse_item(p)]
+        while p.accept_op(","):
+            q.items.append(_parse_item(p))
+    p.expect_kw("from")
+    q.from_elements = [_parse_from_element(p)]
+    while p.accept_op(","):
+        q.from_elements.append(_parse_from_element(p))
+    if p.accept_kw("where"):
+        q.where = _parse_or(p)
+    if p.accept_kw("group"):
+        p.expect_kw("by")
+        if p.peek() == ("kw", "rollup"):
+            raise SqlError("GROUP BY ROLLUP is not supported")
+        q.group_by = [_parse_group_item(p)]
+        while p.accept_op(","):
+            q.group_by.append(_parse_group_item(p))
+    if p.accept_kw("having"):
+        q.having = _parse_or(p)
+    return q
+
+
+def _parse_from_element(p: _Parser) -> FromElement:
+    tref = _parse_table_ref(p)
+    joins: List[JoinClause] = []
+    while True:
+        how = _parse_join_type(p)
+        if how is None:
+            break
+        jref = _parse_table_ref(p)
+        p.expect_kw("on")
+        wrapped = p.accept_op("(") is not None
+        on = [_parse_on_eq(p)]
+        while p.accept_kw("and"):
+            on.append(_parse_on_eq(p))
+        if wrapped:
+            p.expect_op(")")
+        joins.append(JoinClause(jref, how, on))
+    return FromElement(tref, joins)
+
+
+def _parse_table_ref(p: _Parser) -> TableRef:
+    if p.accept_op("("):
+        sub = _parse_query(p)
+        p.expect_op(")")
+        alias = _maybe_alias(p)
+        if alias is None:
+            raise SqlError("A derived table (sub-select in FROM) needs an alias")
+        return TableRef(sub, alias)
+    name = p.expect_ident()
+    return TableRef(name, _maybe_alias(p) or name)
 
 
 def _maybe_alias(p: _Parser) -> Optional[str]:
@@ -365,12 +456,32 @@ def _parse_on_eq(p: _Parser) -> Tuple[str, str]:
     return a, b
 
 
-def _parse_order_item(p: _Parser) -> Tuple[str, bool]:
-    name = p.expect_ident()
+def _parse_group_item(p: _Parser) -> Any:
+    """A GROUP BY key: a (possibly qualified) column name, or an expression
+    (e.g. ``substr(w_warehouse_name, 1, 20)``) keyed by its source text."""
+    start = p.i
+    e = _parse_or(p)
+    if isinstance(e, Col):
+        return e.name
+    e._sql_text = p.text_since(start)
+    return e
+
+
+def _parse_order_item(p: _Parser) -> Tuple[Any, bool]:
+    start = p.i
+    e = _parse_or(p)
+    key: Any
+    if isinstance(e, Col):
+        key = e.name
+    elif isinstance(e, Lit) and isinstance(e.value, int):
+        key = int(e.value)  # ordinal: ORDER BY 1 sorts by the first item
+    else:
+        key = e
+        key._sql_text = p.text_since(start)  # for matching against item texts
     if p.accept_kw("desc"):
-        return name, False
+        return key, False
     p.accept_kw("asc")
-    return name, True
+    return key, True
 
 
 def _strip_qualifier(name: str) -> str:
@@ -415,17 +526,33 @@ def _parse_cmp(p: _Parser) -> Expr:
     negate = False
     if p.accept_kw("not"):
         negate = True
+    if p.accept_kw("like"):
+        from hyperspace_tpu.plan.expr import Like
+
+        t = p.next()
+        if t[0] != "string":
+            raise SqlError("LIKE expects a quoted pattern")
+        e = Like(left, t[1])
+        return ~e if negate else e
     if p.accept_kw("in"):
         p.expect_op("(")
         if p.peek() == ("kw", "select"):
             e: Expr = _InQuery(left, _parse_query(p))
             p.expect_op(")")
         else:
-            values = [_parse_literal_value(p)]
+            elems = [_parse_or(p)]
             while p.accept_op(","):
-                values.append(_parse_literal_value(p))
+                elems.append(_parse_or(p))
             p.expect_op(")")
-            e = left.isin(values)
+            folded = [_const_fold(x) for x in elems]
+            if all(isinstance(x, Lit) for x in folded):
+                e = left.isin([x.value for x in folded])
+            else:
+                # non-constant elements: expand to an OR of equalities
+                e = None
+                for x in folded:
+                    term = left == x
+                    e = term if e is None else (e | term)
         return ~e if negate else e
     if negate:
         raise SqlError("NOT must be followed by IN here")
@@ -441,13 +568,18 @@ def _parse_cmp(p: _Parser) -> Expr:
 
 
 def _parse_sum(p: _Parser) -> Expr:
+    from hyperspace_tpu.plan.expr import Func
+
     e = _parse_term(p)
     while True:
-        op = p.accept_op("+", "-")
+        op = p.accept_op("+", "-", "||")
         if op is None:
             return e
         rhs = _parse_term(p)
-        e = e + rhs if op == "+" else e - rhs
+        if op == "||":
+            e = Func("concat", [e, rhs])
+        else:
+            e = e + rhs if op == "+" else e - rhs
 
 
 def _parse_term(p: _Parser) -> Expr:
@@ -460,7 +592,14 @@ def _parse_term(p: _Parser) -> Expr:
         e = {"*": e * rhs, "/": e / rhs, "%": e % rhs}[op]
 
 
+def _no_window(p: _Parser) -> None:
+    if p.peek() == ("kw", "over"):
+        raise SqlError("Window functions (OVER ...) are not supported")
+
+
 def _parse_factor(p: _Parser) -> Expr:
+    from hyperspace_tpu.plan.expr import Cast, Func
+
     if p.accept_op("("):
         if p.peek() == ("kw", "select"):
             sub = _SubquerySelect(_parse_query(p))
@@ -477,20 +616,110 @@ def _parse_factor(p: _Parser) -> Expr:
     if t[0] == "kw" and t[1] in _AGG_FNS and p.peek(1) == ("op", "("):
         fn = p.next()[1]
         p.expect_op("(")
+        if p.accept_kw("distinct"):
+            if fn not in ("count", "sum", "avg"):
+                raise SqlError(f"{fn.upper()}(DISTINCT ...) is not supported")
+            fn = f"{fn}_distinct"
         if p.accept_op("*"):
             if fn != "count":
                 raise SqlError(f"{fn.upper()}(*) is not valid")
             p.expect_op(")")
+            _no_window(p)
             return _AggCall(fn, None, "*")
         start = p.i
         arg = _parse_sum(p)
         text = p.text_since(start)
         p.expect_op(")")
+        _no_window(p)
         return _AggCall(fn, arg, text)
+    if t == ("kw", "case"):
+        p.i += 1
+        return _parse_case(p)
+    if t == ("kw", "cast"):
+        p.i += 1
+        p.expect_op("(")
+        e = _parse_or(p)
+        p.expect_kw("as")
+        tt = p.next()
+        if tt[0] not in ("ident", "kw"):
+            raise SqlError(f"Expected a type name after CAST(... AS, got {tt[1]!r}")
+        type_name = tt[1]
+        if p.accept_op("("):  # type parameters, e.g. decimal(7,2)
+            while p.accept_op(")") is None:
+                p.next()
+        p.expect_op(")")
+        return Cast(e, type_name)
+    if t == ("kw", "interval"):
+        p.i += 1
+        num = p.next()
+        if num[0] != "number":
+            raise SqlError("INTERVAL expects a number")
+        unit = p.next()[1].lower()
+        if unit.startswith("day"):
+            return Lit(np.timedelta64(int(num[1]), "D"))
+        raise SqlError(f"INTERVAL unit {unit!r} is not supported (days only)")
+    if t == ("kw", "exists"):
+        raise SqlError("EXISTS subqueries are not supported")
+    if t[0] == "ident" and "." not in t[1] and p.peek(1) == ("op", "("):
+        name = p.next()[1]
+        p.expect_op("(")
+        agg = _IDENT_AGGS.get(name.lower())
+        if agg is not None:
+            start = p.i
+            arg = _parse_sum(p)
+            text = p.text_since(start)
+            p.expect_op(")")
+            _no_window(p)
+            return _AggCall(agg, arg, text)
+        args: List[Expr] = []
+        if p.accept_op(")") is None:
+            args.append(_parse_or(p))
+            while p.accept_op(","):
+                args.append(_parse_or(p))
+            p.expect_op(")")
+        _no_window(p)
+        try:
+            return Func(name, args)
+        except ValueError as e:
+            raise SqlError(str(e))
     if t[0] == "ident":
         p.i += 1
         return col(t[1])  # qualifiers resolve at plan time (alias map needed)
     return lit(_parse_literal_value(p))
+
+
+def _parse_case(p: _Parser) -> Expr:
+    from hyperspace_tpu.plan.expr import Case
+
+    subject = None
+    if p.peek() != ("kw", "when"):
+        subject = _parse_or(p)
+    branches = []
+    while p.accept_kw("when"):
+        c = _parse_or(p)
+        if subject is not None:
+            c = subject == c
+        p.expect_kw("then")
+        branches.append((c, _parse_or(p)))
+    otherwise = None
+    if p.accept_kw("else"):
+        otherwise = _parse_or(p)
+    p.expect_kw("end")
+    if not branches:
+        raise SqlError("CASE requires at least one WHEN branch")
+    return Case(branches, otherwise)
+
+
+def _const_fold(e: Expr) -> Expr:
+    """Fold a reference-free expression (e.g. ``1999 + 1`` in an IN list)
+    down to a literal; expressions with column references pass through."""
+    if isinstance(e, Lit) or e.references():
+        return e
+    try:
+        v = e.eval({})
+    except Exception:
+        return e
+    return Lit(v.item() if hasattr(v, "item") else v)
 
 
 def _parse_literal_value(p: _Parser) -> Any:
@@ -542,10 +771,21 @@ def _rewrite(e: Expr, mapping: Dict[str, str]) -> Expr:
         return IsNull(_rewrite(e.child, mapping))
     if isinstance(e, In):
         return In(_rewrite(e.child, mapping), list(e.values))
-    from hyperspace_tpu.plan.expr import InSubquery
+    from hyperspace_tpu.plan.expr import Case, Cast, Func, InSubquery, Like
 
     if isinstance(e, InSubquery):
         return InSubquery(_rewrite(e.child, mapping), e.plan, e.session)
+    if isinstance(e, Case):
+        return Case(
+            [(_rewrite(c, mapping), _rewrite(v, mapping)) for c, v in e.branches],
+            _rewrite(e.otherwise, mapping) if e.otherwise is not None else None,
+        )
+    if isinstance(e, Like):
+        return Like(_rewrite(e.child, mapping), e.pattern)
+    if isinstance(e, Cast):
+        return Cast(_rewrite(e.child, mapping), e.type_name)
+    if isinstance(e, Func):
+        return Func(e.name, [_rewrite(a, mapping) for a in e.args])
     return e
 
 
@@ -582,6 +822,22 @@ def _bind_subqueries(e: Expr, views, session) -> Expr:
         return IsNull(_bind_subqueries(e.child, views, session))
     if isinstance(e, In):
         return In(_bind_subqueries(e.child, views, session), list(e.values))
+    from hyperspace_tpu.plan.expr import Case, Cast, Func, Like
+
+    if isinstance(e, Case):
+        return Case(
+            [
+                (_bind_subqueries(c, views, session), _bind_subqueries(v, views, session))
+                for c, v in e.branches
+            ],
+            _bind_subqueries(e.otherwise, views, session) if e.otherwise is not None else None,
+        )
+    if isinstance(e, Like):
+        return Like(_bind_subqueries(e.child, views, session), e.pattern)
+    if isinstance(e, Cast):
+        return Cast(_bind_subqueries(e.child, views, session), e.type_name)
+    if isinstance(e, Func):
+        return Func(e.name, [_bind_subqueries(a, views, session) for a in e.args])
     return e
 
 
@@ -615,56 +871,89 @@ def _canonical_agg_name(fn: str, arg: Optional[Expr], text: str) -> str:
 
 
 def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa: F821
-    from hyperspace_tpu.plan.dataframe import DataFrame
-    from hyperspace_tpu.plan.logical import Compute, Rename, join_output_names
-
     if q.ctes:
         views = dict(views)
         for name, cq in q.ctes:
             views[name] = plan_query(cq, views)
+    if q.unions:
+        return _plan_union(q, views)
+    return _plan_single(q, views)
 
-    if q.table not in views:
-        raise SqlError(f"Unknown table/view {q.table!r}; register with create_or_replace_temp_view")
-    df = views[q.table]
-    session = df.session
-    # alias -> {lowercased source column -> its actual name in the joined
-    # frame}. Join dedup renames right-side duplicates ('x' -> 'x#r', 'x#r#r',
-    # ...; plan/logical.py join_output_names is the single source of truth),
-    # and this map tracks those renames per alias so qualified references
-    # stay correct through any number of joins.
-    alias_cols: Dict[str, Dict[str, str]] = {
-        q.alias.lower(): {c.lower(): c for c in df.plan.output_columns}
-    }
 
-    for j in q.joins:
-        if j.view not in views:
-            raise SqlError(f"Unknown table/view {j.view!r}")
-        right = views[j.view]
-        condition: Optional[Expr] = None
-        left_cols = {c.lower() for c in df.plan.output_columns}
-        for a, b in j.on:
-            an, bn = _resolve_side(a, b, j.alias, alias_cols, left_cols)
-            term = col(an) == col(bn)
-            condition = term if condition is None else (condition & term)
-        _, rename = join_output_names(df.plan.output_columns, right.plan.output_columns)
-        df = df.join(right, on=condition, how=j.how)
-        alias_cols[j.alias.lower()] = {
-            c.lower(): rename.get(c, c) for c in right.plan.output_columns
-        }
+def _plan_union(q: Query, views) -> "DataFrame":  # noqa: F821
+    """UNION [ALL] chain: branches align by position (Spark semantics), a
+    bare UNION deduplicates, and ORDER BY/LIMIT apply to the combined rows."""
+    import copy
+
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Rename, Union
+
+    head = copy.copy(q)
+    head.unions, head.order_by, head.limit = [], [], None
+    df = _plan_single(head, views)
+    base_cols = df.plan.output_columns
+    for all_, rhs in q.unions:
+        # an operand may itself be a parenthesized query with nested unions
+        f = plan_query(rhs, views)
+        cols = f.plan.output_columns
+        if len(cols) != len(base_cols):
+            raise SqlError(
+                f"UNION inputs have {len(base_cols)} vs {len(cols)} output columns"
+            )
+        if cols != base_cols:
+            mapping = {a: b for a, b in zip(cols, base_cols) if a != b}
+            try:
+                f = DataFrame(Rename(mapping, f.plan), f.session)
+            except ValueError as e:
+                raise SqlError(f"UNION column alignment failed: {e}")
+        df = DataFrame(Union([df.plan, f.plan]), df.session)
+        if not all_:
+            # left-associative: a bare UNION dedups the chain SO FAR only;
+            # a later UNION ALL keeps its duplicates
+            df = df.distinct()
+    if q.order_by:
+        keys, asc = [], []
+        out = set(base_cols)
+        for k, a in q.order_by:
+            if isinstance(k, int):
+                if not (1 <= k <= len(base_cols)):
+                    raise SqlError(f"ORDER BY position {k} is out of range")
+                name = base_cols[k - 1]
+            else:
+                name = _strip_qualifier(k) if isinstance(k, str) else None
+            if name is None or name not in out:
+                raise SqlError("ORDER BY over a UNION must reference output columns")
+            keys.append(name)
+            asc.append(a)
+        df = df.order_by(*keys, ascending=asc)
+    if q.limit is not None:
+        df = df.limit(q.limit)
+    return df
+
+
+def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa: F821
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Compute, Rename
+
+    df, alias_cols, session, where_rem = _plan_from(q, views)
 
     resolve_ref = _make_ref_resolver(df, alias_cols)
 
     def prep(e: Expr) -> Expr:
         return _bind_subqueries(_resolve_expr_refs(e, resolve_ref), views, session)
 
-    if q.where is not None:
-        where = prep(q.where)
+    if where_rem is not None:
+        where = prep(where_rem)
         for x in _walk(where):
             if isinstance(x, _AggCall):
                 raise SqlError(
                     f"Aggregate {x.fn.upper()}() is not allowed in WHERE; use HAVING"
                 )
         df = df.filter(where)
+
+    if q.items is None and any(c.startswith("__cross") for c in df.plan.output_columns):
+        # SELECT * must not expose the internal cross-join key columns
+        df = df.select(*[c for c in df.plan.output_columns if not c.startswith("__cross")])
 
     prepared = (
         [(it, prep(it.expr)) for it in q.items] if q.items is not None else None
@@ -680,10 +969,13 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
     renames: Dict[str, str] = {}
     names: List[str] = []  # projection, pre-rename
 
+    canonical_out: Dict[str, str] = {}
     if is_agg:
         if prepared is None:
             raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
-        df, names = _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session)
+        df, names, canonical_out = _plan_aggregate(
+            q, df, prepared, having_e, resolve_ref, renames, session
+        )
     elif prepared is not None:
         computes: List[Tuple[str, Expr]] = []
         for i, (it, e) in enumerate(prepared):
@@ -721,8 +1013,35 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
         pre_cols = set(df.plan.output_columns)
         final_by_src = {n: renames.get(n, n) for n in names}
         aliases_set = set(renames.values())
+        item_by_text: Dict[str, str] = {}
+        if q.items is not None:
+            for it_, nm_ in zip(q.items, names):
+                item_by_text.setdefault(it_.text, renames.get(nm_, nm_))
         for name, asc in q.order_by:
-            n = resolve_ref(name)
+            if isinstance(name, int):  # ordinal: 1-based SELECT item position
+                if not names or not (1 <= name <= len(names)):
+                    raise SqlError(f"ORDER BY position {name} is out of range")
+                nm = names[name - 1]
+                sort_specs.append((renames.get(nm, nm), asc))
+                continue
+            if not isinstance(name, str):
+                # expression key: an aggregate call maps to its output
+                # column; any other expression must repeat a SELECT item
+                resolved_k = _resolve_expr_refs(name, resolve_ref)
+                if isinstance(resolved_k, _AggCall):
+                    canon = _canonical_agg_name(resolved_k.fn, resolved_k.arg, resolved_k.text)
+                    n = canonical_out.get(canon, canon)
+                else:
+                    txt = getattr(name, "_sql_text", repr(name))
+                    target = item_by_text.get(txt)
+                    if target is None:
+                        raise SqlError(
+                            f"ORDER BY expression {txt!r} must appear in the SELECT list"
+                        )
+                    sort_specs.append((target, asc))
+                    continue
+            else:
+                n = resolve_ref(name)
             if names and n in final_by_src:
                 sort_specs.append((final_by_src[n], asc))
             elif n in aliases_set:
@@ -756,6 +1075,196 @@ def plan_query(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noqa:
     return df
 
 
+def _plan_from(q: Query, views):
+    """Plan the FROM clause: named views and derived tables, comma-separated
+    entries joined by the equality predicates WHERE provides (the classic
+    TPC-DS style ``FROM a, b WHERE a.k = b.k``), then explicit JOIN ... ON
+    clauses. Returns (df, alias_cols, session, remaining WHERE predicate).
+
+    alias_cols maps alias -> {lowercased source column -> its actual name in
+    the joined frame}: join dedup renames right-side duplicates ('x' ->
+    'x#r', 'x#r#r', ...; plan/logical.py join_output_names is the single
+    source of truth), and the map keeps qualified references correct through
+    any number of joins."""
+    from hyperspace_tpu.plan.expr import split_conjunctive
+    from hyperspace_tpu.plan.logical import join_output_names
+
+    if not q.from_elements:
+        raise SqlError("FROM clause is empty")
+
+    def frame_of(tref: TableRef):
+        if isinstance(tref.source, str):
+            if tref.source not in views:
+                raise SqlError(
+                    f"Unknown table/view {tref.source!r}; register with create_or_replace_temp_view"
+                )
+            return views[tref.source]
+        return plan_query(tref.source, views)
+
+    def build_element(elem: FromElement):
+        """One comma element: its table plus chained JOIN ... ON clauses.
+        Returns (frame, local alias map)."""
+        df_e = frame_of(elem.table_ref)
+        amap: Dict[str, Dict[str, str]] = {
+            elem.table_ref.alias.lower(): {c.lower(): c for c in df_e.plan.output_columns}
+        }
+        for j in elem.joins:
+            right = frame_of(j.table_ref)
+            condition: Optional[Expr] = None
+            left_cols = {c.lower() for c in df_e.plan.output_columns}
+            for a, b in j.on:
+                an, bn = _resolve_side(a, b, j.alias, amap, left_cols)
+                term = col(an) == col(bn)
+                condition = term if condition is None else (condition & term)
+            _, rename = join_output_names(df_e.plan.output_columns, right.plan.output_columns)
+            df_e = df_e.join(right, on=condition, how=j.how)
+            amap[j.alias.lower()] = {
+                c.lower(): rename.get(c, c) for c in right.plan.output_columns
+            }
+        return df_e, amap
+
+    built = [build_element(e) for e in q.from_elements]
+    df, alias_cols = built[0]
+    session = df.session
+
+    conjuncts: Optional[List[Expr]] = None
+    used: Set[int] = set()
+    if len(built) > 1:
+        conjuncts = split_conjunctive(q.where) if q.where is not None else []
+        pending = built[1:]
+        while pending:
+            progress = False
+            for idx, (frame, amap_r) in enumerate(pending):
+                links = []
+                for ci, term in enumerate(conjuncts):
+                    if ci in used:
+                        continue
+                    pair = _equi_link(term, alias_cols, df, frame, amap_r)
+                    if pair is not None:
+                        links.append((ci, pair))
+                if not links:
+                    continue
+                condition: Optional[Expr] = None
+                for ci, (ln, rn) in links:
+                    used.add(ci)
+                    term = col(ln) == col(rn)
+                    condition = term if condition is None else (condition & term)
+                _, rename = join_output_names(df.plan.output_columns, frame.plan.output_columns)
+                df = df.join(frame, on=condition, how="inner")
+                for al, m in amap_r.items():
+                    alias_cols[al] = {cl: rename.get(n, n) for cl, n in m.items()}
+                pending.pop(idx)
+                progress = True
+                break
+            if not progress:
+                # a frame guaranteed to hold one row (global aggregate /
+                # LIMIT 1 derived table, e.g. TPC-DS q28/q61/q88/q90) may
+                # cross-join via a constant key without row explosion
+                idx = next(
+                    (i for i, (fr, _) in enumerate(pending) if _is_single_row(fr.plan)),
+                    None,
+                )
+                if idx is None and _is_single_row(df.plan):
+                    idx = 0
+                if idx is not None:
+                    frame, amap_r = pending.pop(idx)
+                    df, rename = _cross_join(df, frame, session)
+                    for al, m in amap_r.items():
+                        alias_cols[al] = {cl: rename.get(n, n) for cl, n in m.items()}
+                    progress = True
+                    continue
+                left_aliases = sorted(
+                    al for _, m in pending for al in m
+                )
+                raise SqlError(
+                    f"Cannot join {left_aliases}: no equality predicate in "
+                    "WHERE links them to the other FROM tables (cartesian products "
+                    "are not supported)"
+                )
+
+    if q.where is None:
+        where_rem = None
+    elif conjuncts is None:
+        where_rem = q.where
+    else:
+        rest = [t for i, t in enumerate(conjuncts) if i not in used]
+        where_rem = None
+        for t in rest:
+            where_rem = t if where_rem is None else (where_rem & t)
+    return df, alias_cols, session, where_rem
+
+
+def _is_single_row(plan) -> bool:
+    """True when the plan provably yields at most one row (global aggregate
+    or LIMIT 1, under any stack of projections)."""
+    from hyperspace_tpu.plan import logical as L
+
+    node = plan
+    while isinstance(node, (L.Project, L.Rename, L.Compute, L.Sort)):
+        (node,) = node.children()
+    if isinstance(node, L.Limit):
+        return node.n <= 1
+    return isinstance(node, L.Aggregate) and not node.keys
+
+
+def _cross_join(df, frame, session):
+    """Cross join via a constant '__cross' key on both sides (the IR only
+    has equi-joins); callers guarantee one side is single-row."""
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Compute, join_output_names
+
+    def with_key(f):
+        if "__cross" in f.plan.output_columns:
+            return f
+        return DataFrame(Compute([("__cross", Lit(1))], f.plan), session)
+
+    left, right = with_key(df), with_key(frame)
+    _, rename = join_output_names(left.plan.output_columns, right.plan.output_columns)
+    out = left.join(right, on=col("__cross") == col("__cross"), how="inner")
+    return out, rename
+
+
+def _equi_link(term: Expr, alias_cols, left_df, right_frame, right_aliases):
+    """If ``term`` is ``Col = Col`` with one side resolving into the joined
+    composite and the other into the candidate right frame (any of its
+    aliases), return the (left actual name, right name) pair; else None."""
+    if not (
+        isinstance(term, BinaryOp)
+        and term.op == "="
+        and isinstance(term.left, Col)
+        and isinstance(term.right, Col)
+    ):
+        return None
+    left_lower = {c.lower(): c for c in left_df.plan.output_columns}
+    right_lower = {c.lower(): c for c in right_frame.plan.output_columns}
+
+    def classify(name: str):
+        if "." in name:
+            qual, rest = name.split(".", 1)
+            ql = qual.lower()
+            if ql in right_aliases:
+                got = right_aliases[ql].get(rest.lower())
+                return ("right", got) if got is not None else None
+            if ql in alias_cols:
+                got = alias_cols[ql].get(rest.lower())
+                return ("left", got) if got is not None else None
+            return None
+        ln = name.lower()
+        in_left, in_right = ln in left_lower, ln in right_lower
+        if in_left and not in_right:
+            return ("left", left_lower[ln])
+        if in_right and not in_left:
+            return ("right", right_lower[ln])
+        return None  # absent or ambiguous
+
+    a, b = classify(term.left.name), classify(term.right.name)
+    if a is not None and b is not None and {a[0], b[0]} == {"left", "right"}:
+        left = a if a[0] == "left" else b
+        right = a if a[0] == "right" else b
+        return left[1], right[1]
+    return None
+
+
 def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
     """Plan the aggregate branch: pre-aggregate computes for expression
     arguments, the Aggregate node, HAVING, and post-aggregate computes for
@@ -763,7 +1272,24 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
     from hyperspace_tpu.plan.dataframe import DataFrame
     from hyperspace_tpu.plan.logical import Aggregate, Compute
 
-    group_keys = [resolve_ref(g) for g in q.group_by]
+    group_keys: List[str] = []
+    group_computes: List[Tuple[str, Expr]] = []
+    group_text_to_key: Dict[str, str] = {}
+    for gi, g in enumerate(q.group_by):
+        if isinstance(g, str):
+            r = resolve_ref(g)
+            if r.lower() not in {k.lower() for k in group_keys}:  # GROUP BY a, a
+                group_keys.append(r)
+            continue
+        # expression group key (e.g. substr(col, 1, 20)): computed before the
+        # aggregate; SELECT items with the same source text reuse it
+        ge, unknown = _case_map(_resolve_expr_refs(g, resolve_ref), df.plan.output_columns)
+        if unknown:
+            raise SqlError(f"Unknown columns {unknown} in GROUP BY expression")
+        name = f"__gk{gi}"
+        group_computes.append((name, ge))
+        group_keys.append(name)
+        group_text_to_key[getattr(g, "_sql_text", "")] = name
     group_lower = {g.lower() for g in group_keys}
 
     pre_computes: List[Tuple[str, Expr]] = []
@@ -811,24 +1337,45 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
             return In(replace_aggs(e.child), list(e.values))
         return e
 
-    # first pass: items that ARE bare aggregate calls claim their alias as
+    # first pass: items matching a GROUP BY expression's text reuse its
+    # computed key; items that ARE bare aggregate calls claim their alias as
     # the aggregate's output name (matches the reference's Spark naming)
-    item_exprs: List[Optional[Expr]] = []
-    for it, e in prepared:
-        if isinstance(e, _AggCall):
-            out = register(e, preferred=it.alias)
-            item_exprs.append(Col(out))
-        else:
-            item_exprs.append(None)
+    item_exprs: List[Optional[Expr]] = [None] * len(prepared)
+    for idx, (it, e) in enumerate(prepared):
+        if not isinstance(e, Col) and it.text in group_text_to_key:
+            item_exprs[idx] = Col(group_text_to_key[it.text])
+        elif isinstance(e, _AggCall):
+            item_exprs[idx] = Col(register(e, preferred=it.alias))
     for idx, (it, e) in enumerate(prepared):
         if item_exprs[idx] is None:
             item_exprs[idx] = replace_aggs(e)
 
     if not aggs:
-        raise SqlError("GROUP BY requires at least one aggregate in SELECT")
+        if having_e is not None:
+            raise SqlError("GROUP BY requires at least one aggregate in SELECT")
+        # aggregate-less GROUP BY is DISTINCT over the group keys (a common
+        # TPC-DS idiom, e.g. q82)
+        if group_computes:
+            df = DataFrame(Compute(group_computes, df.plan), session)
+        names = []
+        for (it, _), e in zip(prepared, item_exprs):
+            if not isinstance(e, Col) or (
+                e.name.lower() not in group_lower and e.name not in group_keys
+            ):
+                raise SqlError("Column must appear in GROUP BY or an aggregate")
+            n = e.name if e.name in group_keys else next(
+                g for g in group_keys if g.lower() == e.name.lower()
+            )
+            names.append(n)
+            if it.alias and it.alias != n:
+                renames[n] = it.alias
+            elif n.startswith("__gk"):
+                renames[n] = it.alias or it.text
+        df = df.select(*names).distinct()
+        return df, names, canonical_out
 
-    if pre_computes:
-        df = DataFrame(Compute(pre_computes, df.plan), session)
+    if group_computes or pre_computes:
+        df = DataFrame(Compute(group_computes + pre_computes, df.plan), session)
     df = DataFrame(Aggregate(group_keys, aggs, df.plan), session)
 
     if having_e is not None:
@@ -861,6 +1408,8 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
             names.append(n)
             if it.alias and it.alias != n:
                 renames[n] = it.alias
+            elif n.startswith("__gk"):  # expression group key: name by text
+                renames[n] = it.alias or it.text
         else:
             e, unknown = _case_map(e, df.plan.output_columns)
             if unknown:
@@ -874,7 +1423,7 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
     if post_computes:
         df = DataFrame(Compute(post_computes, df.plan), session)
     _surface_plain_names([it for it, _ in prepared], names, renames)
-    return df, names
+    return df, names, canonical_out
 
 
 def _make_ref_resolver(df, alias_cols):

@@ -1,0 +1,118 @@
+"""Two-process localhost CPU mesh: the multi-host smoke path (SURVEY §5.8).
+
+The reference's multi-node behavior rides Spark's cluster runtime; here the
+equivalent is ``jax.distributed`` + the 2-D (dcn, ici) mesh, and this test
+runs the hierarchical re-bucketing exchange across a REAL OS process
+boundary: two processes, each holding 4 virtual CPU devices, form a 2x4
+mesh whose dcn axis is the process boundary; every row must land on the
+device owning its bucket and cross the process boundary at most once
+(ops/bucketize.rebucket_hierarchical)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r'''
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, sys.argv[1])
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from hyperspace_tpu.parallel.distributed import initialize_from_env, shutdown
+
+assert initialize_from_env(), "HS_* env must configure the two-process world"
+pid = int(os.environ["HS_PROCESS_ID"])
+assert jax.process_count() == 2, jax.process_count()
+assert jax.local_device_count() == 4, jax.local_device_count()
+assert jax.device_count() == 8
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from hyperspace_tpu.ops.bucketize import rebucket_hierarchical
+from hyperspace_tpu.parallel.mesh import make_mesh_2d, sharded_2d
+
+mesh = make_mesh_2d()  # n_slices defaults to jax.process_count()
+assert mesh.shape == {"dcn": 2, "ici": 4}, mesh.shape
+sh = sharded_2d(mesh)
+
+rows_per_dev = 32
+n = 8 * rows_per_dev
+num_buckets = 16
+rng = np.random.default_rng(11)
+buckets_global = (rng.integers(0, num_buckets, n)).astype(np.int32)
+vals_global = np.arange(n, dtype=np.float64)
+
+local = slice(pid * n // 2, (pid + 1) * n // 2)
+hb = jax.make_array_from_process_local_data(sh, buckets_global[local], (n,))
+arr = {"v": jax.make_array_from_process_local_data(sh, vals_global[local], (n,))}
+
+out, out_buckets, valid, overflow = rebucket_hierarchical(
+    mesh, arr, hb, capacity_ici=2 * rows_per_dev, capacity_dcn=2 * rows_per_dev
+)
+total_valid = int(jax.jit(lambda v: jnp.sum(v), out_shardings=NamedSharding(mesh, P()))(valid))
+total_overflow = int(jax.jit(lambda o: jnp.sum(o), out_shardings=NamedSharding(mesh, P()))(overflow))
+assert total_valid == n, f"rows not conserved: {total_valid} != {n}"
+assert total_overflow == 0, f"exchange overflowed: {total_overflow}"
+
+# every valid row on THIS process's addressable shards is owned here:
+# global device g = bucket % 8, and devices 4*pid..4*pid+3 are local
+for b_shard, v_shard in zip(out_buckets.addressable_shards, valid.addressable_shards):
+    b = np.asarray(b_shard.data).ravel()
+    m = np.asarray(v_shard.data).ravel()
+    owners = b[m] % 8
+    lo, hi = 4 * pid, 4 * pid + 4
+    assert ((owners >= lo) & (owners < hi)).all(), (pid, set(owners.tolist()))
+
+# matched values survive: global sum of valid v equals the input sum
+sv = float(jax.jit(
+    lambda v, m: jnp.sum(jnp.where(m, v, 0.0)), out_shardings=NamedSharding(mesh, P())
+)(out["v"], valid))
+assert abs(sv - vals_global.sum()) < 1e-6, (sv, vals_global.sum())
+
+shutdown()
+print(f"WORKER{pid} OK", flush=True)
+'''
+
+
+def test_two_process_hierarchical_rebucket(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    env_base = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        HS_COORDINATOR="127.0.0.1:29517",
+        HS_NUM_PROCESSES="2",
+    )
+    procs = []
+    for pid in range(2):
+        env = dict(env_base, HS_PROCESS_ID=str(pid))
+        procs.append(
+            subprocess.Popen(
+                [sys.executable, str(worker), REPO],
+                env=env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+            )
+        )
+    outs = []
+    for pid, p in enumerate(procs):
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+    for pid in range(2):
+        assert f"WORKER{pid} OK" in outs[pid]
+
+
+def test_initialize_noop_without_config(monkeypatch):
+    """Single-process mode: no env -> no-op, the same entry point works."""
+    from hyperspace_tpu.parallel.distributed import initialize_from_env
+
+    monkeypatch.delenv("HS_NUM_PROCESSES", raising=False)
+    assert initialize_from_env() is False

@@ -438,6 +438,86 @@ class TestSqlSubqueries:
         assert np.all(got["amount"] > mx - 1)
 
 
+class TestUnions:
+    def test_union_all_keeps_duplicates(self, session, views):
+        got = session.sql(
+            "SELECT region FROM sales WHERE amount > 95 "
+            "UNION ALL SELECT region FROM sales WHERE amount > 95"
+        ).collect()
+        one = session.sql("SELECT region FROM sales WHERE amount > 95").collect()
+        assert got["region"].shape[0] == 2 * one["region"].shape[0] > 0
+
+    def test_bare_union_dedups(self, session, views):
+        got = session.sql(
+            "SELECT region FROM sales UNION SELECT region FROM sales"
+        ).collect()
+        assert sorted(got["region"]) == sorted({f"r{i}" for i in range(8)})
+
+    def test_mixed_union_chain_left_associative(self, session, views):
+        # A UNION B dedups; the UNION ALL tail keeps its duplicates
+        got = session.sql(
+            "SELECT region FROM sales UNION SELECT region FROM sales "
+            "UNION ALL SELECT region FROM sales WHERE region = 'r1'"
+        ).collect()
+        n_r1 = session.sql("SELECT region FROM sales WHERE region = 'r1'").collect()[
+            "region"
+        ].shape[0]
+        assert got["region"].shape[0] == 8 + n_r1
+
+    def test_parenthesized_operand_limit_stays_scoped(self, session, views):
+        got = session.sql(
+            "(SELECT user FROM sales ORDER BY amount DESC LIMIT 5) "
+            "UNION ALL SELECT user FROM sales WHERE amount < 1"
+        ).collect()
+        low = session.sql("SELECT user FROM sales WHERE amount < 1").collect()
+        assert got["user"].shape[0] == 5 + low["user"].shape[0]
+
+    def test_union_order_and_limit_apply_to_whole(self, session, views):
+        got = session.sql(
+            "SELECT amount FROM sales WHERE region = 'r1' "
+            "UNION ALL SELECT amount FROM sales WHERE region = 'r2' "
+            "ORDER BY amount DESC LIMIT 4"
+        ).collect()
+        assert got["amount"].shape[0] == 4
+        assert np.all(np.diff(got["amount"]) <= 0)
+
+
+class TestNullSemantics:
+    @pytest.fixture()
+    def nully(self, session, tmp_path):
+        root = tmp_path / "nully"
+        root.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "k": np.array([1, 2, 3, 4], dtype=np.int64),
+                    "v": np.array([1.0, np.nan, 3.0, np.nan]),
+                    "s": np.array(["a", None, "ccc", None], dtype=object),
+                }
+            ),
+            root / "p.parquet",
+        )
+        session.read_parquet(str(root)).create_or_replace_temp_view("nully")
+
+    def test_not_equal_excludes_nulls(self, session, nully):
+        got = session.sql("SELECT k FROM nully WHERE v != 1").collect()
+        assert got["k"].tolist() == [3]  # NULL != 1 is NULL, not TRUE
+        got2 = session.sql("SELECT k FROM nully WHERE NOT v = 1").collect()
+        assert got2["k"].tolist() == [3]
+
+    def test_length_of_null_is_null(self, session, nully):
+        got = session.sql("SELECT k FROM nully WHERE length(s) < 2").collect()
+        assert got["k"].tolist() == [1]  # length(NULL) is NULL, not -1
+        avg = session.sql("SELECT AVG(length(s)) AS a FROM nully").collect()
+        assert np.isclose(avg["a"][0], 2.0)  # (1 + 3) / 2, NULLs skipped
+
+
+def test_cross_join_select_star_hides_internal_key(session, views):
+    got = session.sql("SELECT * FROM sales, (SELECT MAX(amount) AS mx FROM sales) m LIMIT 3").collect()
+    assert not any(c.startswith("__cross") for c in got), list(got)
+    assert "mx" in got
+
+
 def test_duplicate_alias_raises_sql_error(session, views):
     with pytest.raises(SqlError, match="alias"):
         session.sql("SELECT region AS amount, amount FROM sales")

@@ -1,0 +1,216 @@
+"""Real TPC-DS v1.4 query texts through the SQL front-end.
+
+The reference's gold standard runs the actual q1-q99 texts
+(ref: goldstandard/PlanStabilitySuite.scala:83-290, query files under
+src/test/resources/tpcds/queries). This suite parses those same texts with
+the framework's SQL dialect, plans them onto the IR, checks
+
+  - hyperspace-on results equal hyperspace-off results (checkAnswer), and
+  - the normalized optimized-plan text against approved files
+    (tests/approved_plans/tpcds_sql/, regen with HS_GENERATE_GOLDEN=1),
+
+and pins the queries the dialect cannot express, each with its reason —
+so a query silently starting (or stopping) to work fails the suite.
+
+Tables use the complete 24-table schema (tests/tpcds_schema.py). Query texts
+are read from the reference checkout; the whole module skips when it is not
+available.
+"""
+
+import glob
+import os
+import re
+import zlib
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan.sql import SqlError
+from tpcds_schema import TPCDS_SCHEMAS
+
+QUERIES_DIR = "/root/reference/src/test/resources/tpcds/queries"
+APPROVED_DIR = os.path.join(os.path.dirname(__file__), "approved_plans", "tpcds_sql")
+GENERATE = os.environ.get("HS_GENERATE_GOLDEN", "") == "1"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(QUERIES_DIR), reason="reference TPC-DS query texts not available"
+)
+
+# Queries the dialect cannot express, with the blocking feature. The parser
+# raises SqlError for each; if one starts parsing+planning, the test below
+# flags it for promotion into the expressible set.
+INEXPRESSIBLE = {
+    "q1": "correlated subquery (ctr1.ctr_store_sk referenced from inner query)",
+    "q2": "non-equijoin (week_seq = week_seq - 53 arithmetic join predicate)",
+    "q5": "GROUP BY ROLLUP",
+    "q6": "correlated subquery (i.i_category referenced from inner query)",
+    "q8": "INTERSECT set operation",
+    "q10": "EXISTS subqueries",
+    "q12": "window functions (OVER)",
+    "q13": "disjunctive join predicates (OR of AND blocks over join keys)",
+    "q14a": "INTERSECT set operation",
+    "q14b": "INTERSECT set operation",
+    "q16": "EXISTS subqueries",
+    "q18": "GROUP BY ROLLUP",
+    "q20": "window functions (OVER)",
+    "q22": "GROUP BY ROLLUP",
+    "q27": "GROUPING()/ROLLUP",
+    "q30": "correlated subquery (ctr1.ctr_state referenced from inner query)",
+    "q32": "correlated subquery (cs_item_sk = i_item_sk inner reference)",
+    "q35": "EXISTS subqueries",
+    "q36": "GROUPING()/ROLLUP",
+    "q38": "INTERSECT set operation",
+    "q41": "correlated subquery (i1.i_manufact referenced from inner query)",
+    "q44": "window functions (OVER)",
+    "q47": "window functions (OVER)",
+    "q48": "disjunctive join predicates (OR of AND blocks over join keys)",
+    "q49": "window functions (OVER)",
+    "q51": "window functions (OVER)",
+    "q53": "window functions (OVER)",
+    "q57": "window functions (OVER)",
+    "q63": "window functions (OVER)",
+    "q67": "window functions (OVER)",
+    "q69": "EXISTS subqueries",
+    "q70": "GROUPING()/window",
+    "q77": "GROUP BY ROLLUP",
+    "q80": "GROUP BY ROLLUP",
+    "q81": "correlated subquery (ctr1.ctr_state referenced from inner query)",
+    "q86": "GROUPING()/ROLLUP",
+    "q87": "EXCEPT set operation",
+    "q89": "window functions (OVER)",
+    "q92": "correlated subquery (ws_item_sk = i_item_sk inner reference)",
+    "q94": "EXISTS subqueries",
+    "q98": "window functions (OVER)",
+}
+
+
+def _all_query_names():
+    files = glob.glob(os.path.join(QUERIES_DIR, "q*.sql"))
+    return sorted(
+        (os.path.basename(f)[:-4] for f in files),
+        key=lambda s: (int(re.search(r"\d+", s).group()), s),
+    )
+
+
+EXPRESSIBLE = [q for q in _all_query_names()] if os.path.isdir(QUERIES_DIR) else []
+EXPRESSIBLE = [q for q in EXPRESSIBLE if q not in INEXPRESSIBLE]
+
+
+def _query_text(qname):
+    with open(os.path.join(QUERIES_DIR, f"{qname}.sql")) as f:
+        return f.read()
+
+
+INDEXES = [
+    ("store_sales", "ss_item", ["ss_item_sk"], ["ss_ext_sales_price", "ss_sold_date_sk"]),
+    ("store_sales", "ss_date", ["ss_sold_date_sk"], ["ss_item_sk", "ss_ext_sales_price", "ss_quantity"]),
+    ("store_sales", "ss_customer", ["ss_customer_sk"], ["ss_net_profit"]),
+    ("catalog_sales", "cs_date", ["cs_sold_date_sk"], ["cs_item_sk", "cs_ext_sales_price"]),
+    ("web_sales", "ws_date", ["ws_sold_date_sk"], ["ws_item_sk", "ws_ext_sales_price"]),
+    ("item", "i_sk", ["i_item_sk"], ["i_brand_id", "i_category", "i_current_price"]),
+    ("date_dim", "d_sk", ["d_date_sk"], ["d_year", "d_moy"]),
+    ("customer", "c_sk", ["c_customer_sk"], ["c_current_addr_sk", "c_birth_year"]),
+]
+
+
+@pytest.fixture(scope="module")
+def tpcds(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpcds_sql"))
+    sysp = os.path.join(root, "_indexes")
+    os.makedirs(sysp)
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sysp, hst.keys.NUM_BUCKETS: 4})
+    hst.set_session(sess)
+    hs = hst.Hyperspace(sess)
+    n = 40
+    for name, schema in TPCDS_SCHEMAS.items():
+        rng = np.random.default_rng(zlib.crc32(name.encode()))
+        cols = {}
+        for cname, t in schema.items():
+            if cname.endswith("_year"):
+                cols[cname] = rng.integers(1998, 2003, n).astype(np.int64)
+            elif cname.endswith(("_moy", "_month_seq")):
+                cols[cname] = rng.integers(1, 13, n).astype(np.int64)
+            elif t == "I":
+                # near-unique surrogate keys keep tiny-data joins ~1:1 (real
+                # TPC-DS keys are unique; low cardinality would explode the
+                # multi-way CTE self-joins of q4/q11/q31)
+                cols[cname] = rng.integers(0, n, n).astype(np.int64)
+            elif t == "F":
+                cols[cname] = np.round(rng.uniform(0, 100, n), 2)
+            elif t == "D":
+                cols[cname] = np.datetime64("1998-01-01") + rng.integers(0, 1800, n).astype(
+                    "timedelta64[D]"
+                )
+            else:
+                cols[cname] = np.array([f"{cname[:6]}_{v}" for v in rng.integers(0, n, n)])
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        pq.write_table(pa.table(cols), os.path.join(d, "part-00000.parquet"))
+        sess.read_parquet(d).create_or_replace_temp_view(name)
+    for table, idx_name, indexed, included in INDEXES:
+        hs.create_index(
+            sess._temp_views[table], hst.CoveringIndexConfig(idx_name, indexed, included)
+        )
+    sess.enable_hyperspace()
+    yield sess, root
+    hst.set_session(None)
+
+
+def _normalize(text, root):
+    return text.replace(root, "<TPCDS>")
+
+
+def _rows(batch):
+    def norm(v):
+        if isinstance(v, float) and v != v:
+            return "NaN"  # NaN == NaN for row-set comparison
+        return v
+
+    cols = sorted(batch.keys())
+    if not cols:
+        return []
+    return sorted(
+        tuple(norm(v) for v in row) for row in zip(*[batch[k].tolist() for k in cols])
+    )
+
+
+@pytest.mark.parametrize("qname", EXPRESSIBLE)
+def test_query_plans_and_answers(tpcds, qname):
+    sess, root = tpcds
+    q = sess.sql(_query_text(qname))
+
+    plan_text = _normalize(q.optimized_plan().pretty(), root)
+    path = os.path.join(APPROVED_DIR, f"{qname}.txt")
+    if GENERATE:
+        os.makedirs(APPROVED_DIR, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(plan_text)
+    else:
+        with open(path) as f:
+            assert plan_text == f.read(), (
+                f"plan for {qname} changed; review and regen with HS_GENERATE_GOLDEN=1"
+            )
+
+    on = q.collect()
+    sess.disable_hyperspace()
+    try:
+        off = q.collect()
+    finally:
+        sess.enable_hyperspace()
+    assert sorted(on.keys()) == sorted(off.keys()), qname
+    assert _rows(on) == _rows(off), f"{qname}: results differ with hyperspace on vs off"
+
+
+@pytest.mark.parametrize("qname", sorted(INEXPRESSIBLE, key=lambda s: (int(re.search(r"\d+", s).group()), s)))
+def test_inexpressible_queries_still_raise(tpcds, qname):
+    """Each inexpressible query must still fail with SqlError (so the
+    blocking feature is accurately documented); if one starts working, move
+    it to the expressible set."""
+    sess, _ = tpcds
+    # correlated subqueries surface as resolver ValueErrors from the inner
+    # plan; everything else as SqlError
+    with pytest.raises((SqlError, ValueError)):
+        sess.sql(_query_text(qname)).collect()
