@@ -1,0 +1,175 @@
+"""CPU-engine baseline for the BASELINE.md configs (Spark-CPU stand-in).
+
+BASELINE.md's >=5x target is framed against a Spark-CPU baseline, but this
+environment cannot install pyspark (no package installs; not in the image).
+The closest measurable stand-in available is a pandas/pyarrow pipeline doing
+exactly the same work per query — full scan + filter/join with no index —
+which is what Spark's executors do for these shapes on a single node, minus
+JVM/task-scheduling overhead (i.e. this baseline is, if anything, FASTER
+than single-node Spark would be, making the reported speedups conservative).
+
+Usage:
+    python benchmarks/baseline_cpu.py [config1|config2|config3|config5|all] [--sf 0.2]
+
+Each config prints one JSON line with cold (scan parquet + compute, what a
+Spark query does) and warm (table already in memory) latencies.
+
+Methodology mirror of benchmarks/run.py: one warm-up, then median of --reps.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks import datagen  # noqa: E402
+
+
+def _read(path_dir: str):
+    import pyarrow.dataset as pads
+
+    return pads.dataset(path_dir, format="parquet").to_table()
+
+
+def _timed(fn, reps: int) -> float:
+    fn()  # warm
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times)
+
+
+def _emit(config: int, metric: str, cold_ms: float, warm_ms: float, extra=None):
+    row = {
+        "config": config,
+        "engine": "pandas-cpu (Spark-CPU stand-in; see module docstring)",
+        "metric": metric,
+        "cold_ms": round(cold_ms, 4),
+        "warm_ms": round(warm_ms, 4),
+    }
+    if extra:
+        row.update(extra)
+    print(json.dumps(row), flush=True)
+
+
+def config1(root, args):
+    data = datagen.gen_sample(root)
+
+    def cold():
+        t = _read(data).to_pandas(date_as_object=False)
+        return t[t["dept"] == 7][["value", "name"]]
+
+    warm_t = _read(data).to_pandas(date_as_object=False)
+
+    def warm():
+        return warm_t[warm_t["dept"] == 7][["value", "name"]]
+
+    _emit(1, "sample_filter_query_latency", _timed(cold, args.reps) * 1000,
+          _timed(warm, args.reps) * 1000)
+
+
+def config2(root, args):
+    data = datagen.gen_lineitem(root, args.sf)
+    day = np.datetime64("1995-06-15")
+
+    def cold():
+        t = _read(data).to_pandas(date_as_object=False)
+        return t[t["l_shipdate"] == day][["l_orderkey", "l_extendedprice"]]
+
+    warm_t = _read(data).to_pandas(date_as_object=False)
+
+    def warm():
+        return warm_t[warm_t["l_shipdate"] == day][["l_orderkey", "l_extendedprice"]]
+
+    _emit(2, "tpch_shipdate_filter_latency", _timed(cold, args.reps) * 1000,
+          _timed(warm, args.reps) * 1000, {"sf": args.sf})
+
+
+def config3(root, args):
+    li_d = datagen.gen_lineitem(root, args.sf)
+    o_d = datagen.gen_orders(root, args.sf)
+
+    def cold():
+        li = _read(li_d).to_pandas(date_as_object=False)
+        o = _read(o_d).to_pandas(date_as_object=False)
+        return li.merge(o, left_on="l_orderkey", right_on="o_orderkey")[
+            ["l_extendedprice", "o_totalprice"]
+        ]
+
+    li_t, o_t = _read(li_d).to_pandas(date_as_object=False), _read(o_d).to_pandas(date_as_object=False)
+
+    def warm():
+        return li_t.merge(o_t, left_on="l_orderkey", right_on="o_orderkey")[
+            ["l_extendedprice", "o_totalprice"]
+        ]
+
+    _emit(3, "tpch_indexed_join_latency", _timed(cold, args.reps) * 1000,
+          _timed(warm, args.reps) * 1000, {"sf": args.sf})
+
+
+def config5(root, args):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    n = max(10_000, int(1_000_000 * args.sf))
+    d = os.path.join(root, "plain_li")
+    os.makedirs(d)
+    for seed in (0, 1):
+        r = np.random.default_rng(seed)
+        pq.write_table(
+            pa.table(
+                {
+                    "k": r.integers(0, 1_000_000, n // 2).astype(np.int64),
+                    "price": np.round(r.uniform(0, 1000, n // 2), 2),
+                }
+            ),
+            os.path.join(d, f"part-{seed}.parquet"),
+        )
+    probe = int(np.random.default_rng(1).integers(0, 1_000_000, n // 2)[0])
+
+    def cold():
+        t = _read(d).to_pandas(date_as_object=False)
+        return t[t["k"] == probe][["price"]]
+
+    warm_t = _read(d).to_pandas(date_as_object=False)
+
+    def warm():
+        return warm_t[warm_t["k"] == probe][["price"]]
+
+    _emit(5, "delta_incremental_plus_skipping_latency", _timed(cold, args.reps) * 1000,
+          _timed(warm, args.reps) * 1000, {"sf": args.sf})
+
+
+CONFIGS = {"config1": config1, "config2": config2, "config3": config3, "config5": config5}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("which", nargs="?", default="all")
+    ap.add_argument("--sf", type=float, default=0.2)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+    which = list(CONFIGS) if args.which == "all" else [args.which]
+    for name in which:
+        root = tempfile.mkdtemp(prefix=f"hs_base_{name}_")
+        try:
+            CONFIGS[name](root, args)
+        finally:
+            import shutil
+
+            shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

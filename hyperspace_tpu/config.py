@@ -42,6 +42,7 @@ class keys:
     TPU_BUILD_DISTRIBUTED_MIN_ROWS = "hyperspace.tpu.build.distributedMinRows"
     TPU_QUERY_DEVICE_EXECUTION = "hyperspace.tpu.query.deviceExecution"
     TPU_QUERY_DEVICE_MIN_ROWS = "hyperspace.tpu.query.deviceMinRows"
+    TPU_JOIN_DEVICE_MATERIALIZE = "hyperspace.tpu.join.deviceMaterialize"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -88,6 +89,10 @@ DEFAULTS: Dict[str, Any] = {
     # compute it offloads; the executor keeps small batches on host. Tune to 0
     # on co-located TPU hosts where the whole pipeline stays device-resident.
     keys.TPU_QUERY_DEVICE_MIN_ROWS: 1 << 25,
+    # Inner-join pair expansion + numeric column gather on device (host
+    # gathers only string/object columns); False reverts to the host
+    # expansion for every column.
+    keys.TPU_JOIN_DEVICE_MATERIALIZE: True,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -231,6 +236,10 @@ class HyperspaceConf:
     @property
     def device_exec_min_rows(self) -> int:
         return int(self.get(keys.TPU_QUERY_DEVICE_MIN_ROWS))
+
+    @property
+    def join_device_materialize(self) -> bool:
+        return bool(self.get(keys.TPU_JOIN_DEVICE_MATERIALIZE))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

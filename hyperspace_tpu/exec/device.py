@@ -220,7 +220,12 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
             return f
         raise DeviceUnsupported(f"unsupported numeric expr {type(e).__name__}")
 
-    def string_compare(col: Col, op: str, lit_value) -> "callable":
+    # Boolean subtrees compile to (value, unknown) Kleene pairs so NULL stays
+    # three-valued on device exactly as on host (expr.NullableBool): a NULL
+    # operand makes the comparison unknown — in particular NULL != x and
+    # NOT(NULL = x) must not come out true. The top level keeps definite-TRUE
+    # rows only (value & ~unknown).
+    def string_compare(col: Col, op: str, lit_value):
         codec = codecs[col.name]
         if codec.kind != "string" or not isinstance(lit_value, str):
             # mixed-type compares have host-defined semantics; don't guess
@@ -229,40 +234,81 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
         lo = slots.add(np.int32(lo_v))
         hi = slots.add(np.int32(hi_v))
         name = col.name
+        unknown = lambda cols, lits: cols[name] < 0  # null code is -1
         if op == "=":
-            return lambda cols, lits: (cols[name] >= lits[lo]) & (cols[name] < lits[hi])
+            return (lambda cols, lits: (cols[name] >= lits[lo]) & (cols[name] < lits[hi]), unknown)
         if op == "!=":
-            # null codes (-1) satisfy != like the host's elementwise None != "x"
-            return lambda cols, lits: (cols[name] < lits[lo]) | (cols[name] >= lits[hi])
+            return (lambda cols, lits: (cols[name] < lits[lo]) | (cols[name] >= lits[hi]), unknown)
         if op == "<":
-            return lambda cols, lits: (cols[name] < lits[lo]) & (cols[name] >= 0)
+            return (lambda cols, lits: cols[name] < lits[lo], unknown)
         if op == "<=":
-            return lambda cols, lits: (cols[name] < lits[hi]) & (cols[name] >= 0)
+            return (lambda cols, lits: cols[name] < lits[hi], unknown)
         if op == ">":
-            return lambda cols, lits: cols[name] >= lits[hi]
+            return (lambda cols, lits: cols[name] >= lits[hi], unknown)
         if op == ">=":
-            return lambda cols, lits: cols[name] >= lits[lo]
+            return (lambda cols, lits: cols[name] >= lits[lo], unknown)
         raise DeviceUnsupported(f"unsupported string compare {op}")
+
+    def _num_unknown(x):
+        return jnp.isnan(x) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.zeros(jnp.shape(x), bool)
+
+    def _compare(lf, rf, op: str):
+        def value(cols, lits):
+            l, r = lf(cols, lits), rf(cols, lits)
+            if op == "=":
+                return l == r
+            if op == "!=":
+                return l != r
+            if op == "<":
+                return l < r
+            if op == "<=":
+                return l <= r
+            if op == ">":
+                return l > r
+            return l >= r
+
+        def unknown(cols, lits):
+            return _num_unknown(lf(cols, lits)) | _num_unknown(rf(cols, lits))
+
+        return value, unknown
 
     def build_bool(e: Expr):
         if isinstance(e, BinaryOp) and e.op in ("AND", "OR"):
-            lf, rf = build_bool(e.left), build_bool(e.right)
+            (lv, lu), (rv, ru) = build_bool(e.left), build_bool(e.right)
             if e.op == "AND":
-                return lambda cols, lits: lf(cols, lits) & rf(cols, lits)
-            return lambda cols, lits: lf(cols, lits) | rf(cols, lits)
+                # unknown unless either side is definitely false
+                return (
+                    lambda cols, lits: lv(cols, lits) & rv(cols, lits),
+                    lambda cols, lits: (lu(cols, lits) | ru(cols, lits))
+                    & ~(~lv(cols, lits) & ~lu(cols, lits))
+                    & ~(~rv(cols, lits) & ~ru(cols, lits)),
+                )
+            return (
+                lambda cols, lits: (lv(cols, lits) & ~lu(cols, lits))
+                | (rv(cols, lits) & ~ru(cols, lits)),
+                lambda cols, lits: (lu(cols, lits) | ru(cols, lits))
+                & ~(lv(cols, lits) & ~lu(cols, lits))
+                & ~(rv(cols, lits) & ~ru(cols, lits)),
+            )
         if isinstance(e, Not):
-            cf = build_bool(e.child)
-            return lambda cols, lits: ~cf(cols, lits)
+            cv, cu = build_bool(e.child)
+            return (lambda cols, lits: ~cv(cols, lits), cu)
         if isinstance(e, IsNull):
             c = e.child
             if isinstance(c, Col):
                 codec = codecs[c.name]
                 name = c.name
+                no_unknown = lambda cols, lits: jnp.zeros(cols[name].shape, bool)
                 if codec.kind == "string":
-                    return lambda cols, lits: cols[name] < 0
+                    return (lambda cols, lits: cols[name] < 0, no_unknown)
                 if codec.kind == "numeric":
-                    return lambda cols, lits: jnp.isnan(cols[name]) if cols[name].dtype == jnp.float64 else jnp.zeros(cols[name].shape, bool)
-                return lambda cols, lits: jnp.zeros(cols[name].shape, bool)
+                    return (
+                        lambda cols, lits: jnp.isnan(cols[name])
+                        if cols[name].dtype == jnp.float64
+                        else jnp.zeros(cols[name].shape, bool),
+                        no_unknown,
+                    )
+                return (lambda cols, lits: jnp.zeros(cols[name].shape, bool), no_unknown)
             raise DeviceUnsupported("IS NULL on non-column")
         if isinstance(e, In):
             child = e.child
@@ -284,13 +330,15 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                     cf = build_num(child)
                     num = _literal_numeric(codecs[child.name], val)
                     i = slots.add(_as_lit_scalar(num))
-                    terms.append(lambda cols, lits, cf=cf, i=i: cf(cols, lits) == lits[i])
-            def f(cols, lits):
-                m = terms[0](cols, lits)
-                for t in terms[1:]:
-                    m = m | t(cols, lits)
+                    terms.append(_compare(cf, lambda cols, lits, i=i: lits[i], "="))
+
+            def value(cols, lits):
+                m = terms[0][0](cols, lits)
+                for tv, _ in terms[1:]:
+                    m = m | tv(cols, lits)
                 return m
-            return f
+
+            return value, terms[0][1]  # all terms share the child's null mask
         if isinstance(e, BinaryOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
             left, right, op = e.left, e.right, e.op
             # normalize: Col OP Lit
@@ -312,20 +360,11 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
             raise DeviceUnsupported("input_file_name() is host-only")
         raise DeviceUnsupported(f"unsupported boolean expr {type(e).__name__}")
 
-    def _compare(lf, rf, op: str):
-        if op == "=":
-            return lambda cols, lits: lf(cols, lits) == rf(cols, lits)
-        if op == "!=":
-            return lambda cols, lits: lf(cols, lits) != rf(cols, lits)
-        if op == "<":
-            return lambda cols, lits: lf(cols, lits) < rf(cols, lits)
-        if op == "<=":
-            return lambda cols, lits: lf(cols, lits) <= rf(cols, lits)
-        if op == ">":
-            return lambda cols, lits: lf(cols, lits) > rf(cols, lits)
-        return lambda cols, lits: lf(cols, lits) >= rf(cols, lits)
+    vf, uf = build_bool(expr)
 
-    fn = build_bool(expr)
+    def fn(cols, lits):
+        return vf(cols, lits) & ~uf(cols, lits)
+
     return fn, tuple(slots.values)
 
 
@@ -930,11 +969,12 @@ def _device_key_eligible(side: L.LogicalPlan, key: str) -> bool:
 
 def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     """Single entry point for the bucketed-SMJ paths: one compatibility
-    analysis, then device or host spans by the input-rows threshold (device
-    handles single int64-comparable keys; composite and string keys use the
-    host rank path). Raises DeviceUnsupported when the join isn't a
-    compatible bucketed pair (the executor then falls back to its generic
-    merge join)."""
+    analysis, then device or host spans by the input-rows threshold. Every
+    key shape rides the device span program — single int/date keys feed it
+    directly, composite and string keys through the shared per-bucket rank
+    encodings (_encoded_join_keys). Raises DeviceUnsupported when the join
+    isn't a compatible bucketed pair (the executor then falls back to its
+    generic merge join)."""
     compat = join_sides_compatible(plan)
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
@@ -948,12 +988,7 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
                 total = 0
                 break
     setup = _bucketed_join_setup(session, plan, compat)
-    if (
-        total >= session.conf.device_exec_min_rows
-        and len(lkeys) == 1
-        and _device_key_eligible(lside, lkeys[0])
-        and _device_key_eligible(rside, rkeys[0])
-    ):
+    if total >= session.conf.device_exec_min_rows:
         try:
             return device_bucketed_join(session, plan, _compat=compat, _setup=setup)
         except DeviceUnsupported:
@@ -1015,16 +1050,6 @@ def _expand_join_pairs(
     out_cols = plan.output_columns
     lout = list(lcols_needed)
     rout = list(rcols_needed)
-
-    def column_source(name: str):
-        """(side batches, source column name) for one output column."""
-        if name in lout:
-            return lbuckets, name, True
-        if name.endswith("#r") and name[:-2] in rout:
-            return rbuckets, name[:-2], False
-        if name in rout:
-            return rbuckets, name, False
-        raise DeviceUnsupported(f"join output column {name!r} not found on either side")
 
     # pass 1: per-bucket gather index arrays; -1 marks a null (unmatched) row
     from hyperspace_tpu import native
@@ -1106,20 +1131,17 @@ def _expand_join_pairs(
             total += rr
             has_null_left = True
 
-    sources = {name: column_source(name) for name in out_cols}
+    sources = {name: _join_column_source(name, lout, rout) for name in out_cols}
     participating = sorted({p[0] for p in pieces})
 
     def out_dtype(name: str) -> np.dtype:
-        src, col, is_left = sources[name]
+        is_left, col = sources[name]
+        src = lbuckets if is_left else rbuckets
         # promote across participating buckets (a nullable int column decodes
         # as float64 only in buckets whose files hold nulls), matching what
         # np.concatenate of per-bucket results used to do
-        dtypes = [src[b][col].dtype for b in (participating or src) if col in src.get(b, {})]
-        if not dtypes:
-            raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
-        if any(dt == object for dt in dtypes):
-            return np.dtype(object)
-        dt = np.result_type(*dtypes)
+        part = participating or sorted(src)
+        dt = _join_column_dtype(name, sources[name], lbuckets, rbuckets, part)
         nullable = (is_left and has_null_left) or (not is_left and has_null_right)
         if nullable and dt.kind == "b":
             return np.dtype(object)  # pandas merge: bool + NaN -> object
@@ -1144,7 +1166,8 @@ def _expand_join_pairs(
     for b, ct, make in pieces:
         lidx, ridx = make()
         for name in out_cols:
-            src, col, is_left = sources[name]
+            is_left, col = sources[name]
+            src = lbuckets if is_left else rbuckets
             idx = lidx if is_left else ridx
             arr = src.get(b, {}).get(col)
             if arr is None or arr.shape[0] == 0:
@@ -1178,12 +1201,12 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = (
-        _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
-    )
-    if len(lkeys) != 1:
-        raise DeviceUnsupported("device span program is single-key; composite keys -> host")
-    lkey, rkey = lkeys[0], rkeys[0]
+    setup = _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
+    lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = setup
+    # shared per-bucket int64 encodings: identity for single int/date keys,
+    # dense cross-side ranks for composite/string keys — so every key shape
+    # rides the device span program
+    lkeys_by_bucket, rkeys_by_bucket = _encoded_join_keys(plan, setup, _compat)
 
     SENTINEL = np.int64(2**62)
     mesh = session.mesh
@@ -1191,22 +1214,33 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     axis = mesh.axis_names[0]
     nb_padded = nb + ((-nb) % n_dev)
 
-    def stack_side(buckets: Dict[int, B.Batch], key: str):
+    def stack_side(buckets: Dict[int, B.Batch], keymap: Dict[int, np.ndarray]):
         lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
         width = max(max(lens), 1)
         keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
         for b in range(nb_padded):
-            if lens[b]:
-                keys_mat[b, : lens[b]] = _join_key_of(buckets[b], key)
+            enc = keymap.get(b)
+            if enc is not None and enc.shape[0]:
+                keys_mat[b, : enc.shape[0]] = enc
         return keys_mat, np.asarray(lens, dtype=np.int64)
 
-    lmat, llens = stack_side(lbuckets, lkey)
-    rmat, rlens = stack_side(rbuckets, rkey)
+    lmat, llens = stack_side(lbuckets, lkeys_by_bucket)
+    rmat, rlens = stack_side(rbuckets, rkeys_by_bucket)
 
     sharding = NamedSharding(mesh, P(axis))
 
     spans = _bucketed_span_program(mesh, axis)
     lo, hi = spans(jax.device_put(lmat, sharding), jax.device_put(rmat, sharding))
+
+    if plan.how == "inner" and session.conf.join_device_materialize:
+        try:
+            return _device_materialize_inner(
+                session, plan, lbuckets, rbuckets, lcols_needed, rcols_needed,
+                lo, hi, llens, rlens, nb, nb_padded,
+            )
+        except DeviceUnsupported:
+            pass  # e.g. typed-empty output or odd column shapes -> host gather
+
     lo = np.asarray(lo)
     hi = np.asarray(hi)
 
@@ -1217,12 +1251,13 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
 
 
-def _make_host_span_of(session, plan: L.Join, setup, compat):
-    """Build ``span_of(b) -> (lo, hi)`` over the pre-sorted per-bucket runs.
-    Single int64-comparable keys feed the native merge walk directly;
-    composite and string keys are first encoded per bucket into shared dense
-    int64 ranks (order-preserving across both sides), cached across queries
-    on the sides' immutable file + filter identity."""
+def _encoded_join_keys(plan: L.Join, setup, compat):
+    """Per-bucket int64 key arrays for both sides, order-preserving and
+    cross-side comparable. Single int64-comparable keys pass through;
+    composite and string keys encode per bucket into shared dense int64
+    ranks, cached across queries on the sides' immutable file + filter
+    identity. The SAME arrays feed the host merge walk and the device span
+    program, so both backends cover every key shape."""
     lbuckets, rbuckets, lkeys, rkeys, _nb, _lc, _rc = setup
 
     single_int = len(lkeys) == 1
@@ -1254,6 +1289,206 @@ def _make_host_span_of(session, plan: L.Join, setup, compat):
             if cache_key is not None:
                 nbytes = sum(a.nbytes for d in (lkeys_by_bucket, rkeys_by_bucket) for a in d.values())
                 _RANK_CACHE.put(cache_key, (lkeys_by_bucket, rkeys_by_bucket), nbytes)
+    return lkeys_by_bucket, rkeys_by_bucket
+
+
+def _join_column_source(name: str, lout, rout) -> Tuple[bool, str]:
+    """(is_left, source column name) for one join output column; the join's
+    '#r'-suffixed duplicates resolve to the right side (the single naming
+    convention of plan/logical.join_output_names)."""
+    if name in lout:
+        return True, name
+    if name.endswith("#r") and name[:-2] in rout:
+        return False, name[:-2]
+    if name in rout:
+        return False, name
+    raise DeviceUnsupported(f"join output column {name!r} not found on either side")
+
+
+def _join_column_dtype(name: str, source, lbuckets, rbuckets, participating) -> np.dtype:
+    """Column dtype promoted across the participating buckets (a nullable int
+    column decodes as float64 only in buckets whose files hold nulls)."""
+    is_left, col = source
+    src = lbuckets if is_left else rbuckets
+    dtypes = [src[b][col].dtype for b in participating if col in src.get(b, {})]
+    if not dtypes:
+        raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
+    if any(dt == object for dt in dtypes):
+        return np.dtype(object)
+    return np.result_type(*dtypes)
+
+
+from functools import lru_cache
+
+
+@lru_cache(maxsize=32)
+def _expand_gather_program(n_pad: int):
+    """Jitted inner-join materialization: expand every (left row, matching
+    right row) pair AND gather the numeric payload columns in one device
+    program — the host receives final columns only (SURVEY §2.9
+    "device-local merge-join kernel"). One compile per output size class.
+
+    Shapes: ``lo``/``hi``/``llens`` describe the span matrices ((nb, Wl) and
+    (nb,)); ``lcols``/``rcols`` are tuples of (nb, Wl)/(nb, Wr) rectangles.
+    Output slot ``t`` maps to its (bucket, left row, right row) via ONE
+    global searchsorted over the flattened inclusive pair-count cumsum — no
+    (n_pad, Wl) intermediates, so memory stays O(rows + pairs)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(lo, hi, llens, rlens, lcols, rcols, total):
+        nb, wl = lo.shape
+        # clamp spans to the right side's REAL rows: a left-only bucket's
+        # SENTINEL padding keys would otherwise "match" the right rectangle's
+        # SENTINEL padding region
+        lo = jnp.minimum(lo, rlens[:, None])
+        hi = jnp.minimum(hi, rlens[:, None])
+        col_idx = jnp.arange(wl)[None, :]
+        counts = jnp.where(col_idx < llens[:, None], hi - lo, 0)
+        flat_counts = counts.reshape(-1)
+        g_incl = jnp.cumsum(flat_counts)
+        g_excl = g_incl - flat_counts
+        t = jnp.arange(n_pad, dtype=g_incl.dtype)
+        f = jnp.clip(jnp.searchsorted(g_incl, t, side="right"), 0, flat_counts.shape[0] - 1)
+        valid = t < total
+        b = f // wl
+        i = f % wl
+        p = t - g_excl[f]
+        j = jnp.clip(lo.reshape(-1)[f] + p, 0, None)
+        louts = tuple(c.reshape(-1)[f] for c in lcols)
+        routs = tuple(c[b, jnp.clip(j, 0, c.shape[1] - 1)] for c in rcols)
+        return louts, routs, b, i, j, valid
+
+    return run
+
+
+def _device_materialize_inner(
+    session, plan: L.Join, lbuckets, rbuckets, lcols_needed, rcols_needed,
+    lo_dev, hi_dev, llens, rlens, nb, nb_padded,
+) -> B.Batch:
+    """Device-side materialization of a compatible bucketed INNER join: pair
+    expansion and numeric column gathers run on device; only string/object
+    columns gather host-side (by the downloaded index arrays)."""
+    import jax
+    import jax.numpy as jnp
+
+    from hyperspace_tpu.ops.sort import padded_size
+
+    if plan.how != "inner":
+        raise DeviceUnsupported("device materialization covers inner joins")
+    out_cols = plan.output_columns
+
+    participating = sorted(set(lbuckets) & set(rbuckets))
+    if not participating:
+        # no overlapping buckets: empty inner join; let the host path build
+        # the typed empty columns it already knows how to produce
+        raise DeviceUnsupported("no overlapping buckets")
+
+    sources = {
+        name: _join_column_source(name, lcols_needed, rcols_needed) for name in out_cols
+    }
+    dtypes = {
+        name: _join_column_dtype(name, sources[name], lbuckets, rbuckets, participating)
+        for name in out_cols
+    }
+    device_cols = [n for n in out_cols if dtypes[n].kind in ("i", "u", "f", "b", "M", "m")]
+    host_cols = [n for n in out_cols if n not in device_cols]
+
+    # pair totals size the static output; one tiny d2h (nb ints)
+    wl = lo_dev.shape[1]
+    llens_np = np.asarray(llens)
+    rlens_np = np.asarray(rlens)
+    bucket_totals = np.asarray(
+        jax.jit(
+            lambda lo, hi, ll, rl: jnp.sum(
+                jnp.where(
+                    jnp.arange(lo.shape[1])[None, :] < ll[:, None],
+                    jnp.minimum(hi, rl[:, None]) - jnp.minimum(lo, rl[:, None]),
+                    0,
+                ),
+                axis=1,
+            )
+        )(lo_dev, hi_dev, jnp.asarray(llens_np), jnp.asarray(rlens_np))
+    )
+    total = int(bucket_totals.sum())
+    out: B.Batch = {}
+    if total == 0:
+        for name in out_cols:
+            dt = dtypes[name]
+            out[name] = np.empty(0, dtype=dt)
+        return out
+    n_pad = padded_size(total)
+
+    def rectangles(side_buckets, cols, width_of):
+        """(name -> (nb_padded, W) device-feedable rectangle) per column."""
+        mats = {}
+        for name in cols:
+            is_left, col = sources[name]
+            dt = dtypes[name]
+            view_int = dt.kind in ("M", "m")
+            base = np.dtype(np.int64) if view_int else (dt if dt.kind != "b" else np.dtype(np.int64))
+            width = max(width_of, 1)
+            mat = np.zeros((nb_padded, width), dtype=base)
+            for b in participating:
+                arr = side_buckets[b].get(col)
+                if arr is None:
+                    raise DeviceUnsupported(f"column {col!r} absent in bucket {b}")
+                v = arr.view("int64") if view_int else arr
+                mat[b, : v.shape[0]] = v.astype(base, copy=False)
+            mats[name] = mat
+        return mats
+
+    l_device = [n for n in device_cols if sources[n][0]]
+    r_device = [n for n in device_cols if not sources[n][0]]
+    wr = max((B.num_rows(rbuckets[b]) for b in participating), default=1)
+    lmats = rectangles(lbuckets, l_device, wl)
+    rmats = rectangles(rbuckets, r_device, wr)
+
+    run = _expand_gather_program(n_pad)
+    louts, routs, b_idx, i_idx, j_idx, valid = run(
+        lo_dev,
+        hi_dev,
+        jax.device_put(llens_np),
+        jax.device_put(rlens_np),
+        tuple(jax.device_put(lmats[n]) for n in l_device),
+        tuple(jax.device_put(rmats[n]) for n in r_device),
+        np.int64(total),
+    )
+
+    for name, arr in zip(l_device, louts):
+        v = np.asarray(arr)[:total]
+        dt = dtypes[name]
+        out[name] = v.view(dt) if dt.kind in ("M", "m") else v.astype(dt, copy=False)
+    for name, arr in zip(r_device, routs):
+        v = np.asarray(arr)[:total]
+        dt = dtypes[name]
+        out[name] = v.view(dt) if dt.kind in ("M", "m") else v.astype(dt, copy=False)
+
+    if host_cols:
+        # string/object columns: download the (bucket-ordered) index arrays
+        # once and gather host-side, bucket by bucket
+        b_np = np.asarray(b_idx)[:total]
+        i_np = np.asarray(i_idx)[:total]
+        j_np = np.asarray(j_idx)[:total]
+        offsets = np.concatenate([[0], np.cumsum(bucket_totals)])
+        for name in host_cols:
+            is_left, col = sources[name]
+            src = lbuckets if is_left else rbuckets
+            idx = i_np if is_left else j_np
+            res = np.empty(total, dtype=object)
+            for b in participating:
+                s, e = int(offsets[b]), int(offsets[b + 1])
+                if e > s:
+                    res[s:e] = src[b][col][idx[s:e]]
+            out[name] = res
+    return {name: out[name] for name in out_cols}
+
+
+def _make_host_span_of(session, plan: L.Join, setup, compat):
+    """Build ``span_of(b) -> (lo, hi)`` over the pre-sorted per-bucket runs
+    using the shared per-bucket key encodings."""
+    lkeys_by_bucket, rkeys_by_bucket = _encoded_join_keys(plan, setup, compat)
 
     from hyperspace_tpu import native
 

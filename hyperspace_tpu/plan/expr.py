@@ -270,6 +270,16 @@ def _missing_mask(v) -> np.ndarray:
     return np.zeros(a.shape, dtype=bool)
 
 
+def _object_fill(type_name: str):
+    """Neutral stand-in for NULL slots while converting an object array (the
+    real NULLs are re-applied after the conversion; see Cast.eval)."""
+    if type_name == "date":
+        return "1970-01-01"
+    if type_name in ("string", "char", "varchar", "text") or type_name.startswith(("char", "varchar")):
+        return ""
+    return 0
+
+
 def _object_nums_to_float(arr: np.ndarray):
     """None -> NaN for numeric object arrays; non-numeric arrays unchanged."""
     try:
@@ -556,12 +566,16 @@ class Like(Expr):
     def children(self) -> Sequence[Expr]:
         return (self.child,)
 
-    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        v = self.child.eval(batch)
-        return np.array(
-            [x is not None and self._rx.match(str(x)) is not None for x in np.asarray(v).ravel()],
+    def eval(self, batch: Dict[str, np.ndarray]):
+        v = np.asarray(self.child.eval(batch))
+        value = np.array(
+            [x is not None and self._rx.match(str(x)) is not None for x in v.ravel()],
             dtype=bool,
         )
+        unknown = _missing_mask(v).ravel()
+        if unknown.any():  # NULL LIKE p is unknown (so NOT LIKE excludes it too)
+            return NullableBool(value, unknown)
+        return value
 
     def __repr__(self) -> str:
         return f"({self.child!r} LIKE {self.pattern!r})"
@@ -581,14 +595,31 @@ class Cast(Expr):
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         v = np.asarray(self.child.eval(batch))
         t = self.type_name
+        missing = _missing_mask(v)
+        has_missing = bool(np.any(missing))
+        if v.dtype == object and has_missing:
+            # NULL-free view for the conversion; NULLs re-applied after
+            v = np.where(missing, _object_fill(t), v)
         if t in ("int", "integer", "bigint", "smallint", "tinyint"):
+            if has_missing:  # CAST(NULL AS int) is NULL: int64 can't hold it
+                out = v.astype(np.float64)
+                out[missing] = np.nan
+                return out
             return v.astype(np.int64)
         if t in ("double", "float", "real") or t.startswith("decimal") or t.startswith("numeric"):
-            return v.astype(np.float64)
+            out = v.astype(np.float64)
+            if has_missing:
+                out[missing] = np.nan
+            return out
         if t == "date":
-            return v.astype("datetime64[D]")
+            out = v.astype("datetime64[D]")
+            if has_missing:
+                out[missing] = np.datetime64("NaT")
+            return out
         if t in ("string", "char", "varchar", "text") or t.startswith(("char", "varchar")):
-            return v.astype(str)
+            out = v.astype(object)
+            out = np.array([None if m else str(x) for x, m in zip(out.ravel(), missing.ravel())], dtype=object)
+            return out.reshape(v.shape)
         raise ValueError(f"Unsupported CAST target type {self.type_name!r}")
 
     def __repr__(self) -> str:
@@ -631,12 +662,9 @@ class Func(Expr):
         if f == "coalesce":
             out = vals[0].astype(object, copy=True) if vals[0].dtype == object else vals[0].copy()
             for v in vals[1:]:
-                if out.dtype == object:
-                    miss = np.array([x is None or (isinstance(x, float) and x != x) for x in out])
-                elif out.dtype.kind == "f":
-                    miss = np.isnan(out)
-                else:
+                if out.dtype.kind not in ("O", "f", "M"):
                     break
+                miss = _missing_mask(out)
                 if not miss.any():
                     break
                 out = np.where(miss, v, out)
@@ -676,9 +704,15 @@ class Func(Expr):
                 [np.nan if x is None else float(len(str(x))) for x in vals[0]], dtype=np.float64
             )
         if f == "concat":
-            out = vals[0].astype(str)
+            # SQL concat: any NULL operand -> NULL result
+            missing = _missing_mask(vals[0])
+            out = np.where(missing, "", vals[0].astype(str)).astype(object)
             for v in vals[1:]:
-                out = np.char.add(out, v.astype(str))
+                m = _missing_mask(v)
+                missing = missing | m
+                out = np.char.add(out.astype(str), np.where(m, "", v.astype(str))).astype(object)
+            if missing.any():
+                out[missing] = None
             return out
         raise ValueError(f"Unsupported function {self.name!r}")
 

@@ -505,6 +505,22 @@ class TestNullSemantics:
         got2 = session.sql("SELECT k FROM nully WHERE NOT v = 1").collect()
         assert got2["k"].tolist() == [3]
 
+    def test_not_like_excludes_nulls(self, session, nully):
+        got = session.sql("SELECT k FROM nully WHERE s NOT LIKE 'a%'").collect()
+        assert got["k"].tolist() == [3]  # NULL NOT LIKE p is NULL
+
+    def test_cast_null_propagates(self, session, nully):
+        got = session.sql("SELECT cast(v AS int) AS iv, k FROM nully").collect()
+        assert np.isnan(got["iv"][1]) and np.isnan(got["iv"][3])  # not -2^63
+        assert got["iv"][0] == 1 and got["iv"][2] == 3
+        s = session.sql("SELECT cast(s AS string) AS cs FROM nully").collect()
+        assert s["cs"][1] is None and s["cs"][3] is None  # not 'None'
+
+    def test_concat_null_propagates(self, session, nully):
+        got = session.sql("SELECT s || 'x' AS c FROM nully").collect()
+        assert got["c"][0] == "ax" and got["c"][2] == "cccx"
+        assert got["c"][1] is None and got["c"][3] is None
+
     def test_length_of_null_is_null(self, session, nully):
         got = session.sql("SELECT k FROM nully WHERE length(s) < 2").collect()
         assert got["k"].tolist() == [1]  # length(NULL) is NULL, not -1
