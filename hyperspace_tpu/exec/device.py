@@ -252,7 +252,24 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
     def _num_unknown(x):
         return jnp.isnan(x) if jnp.issubdtype(x.dtype, jnp.floating) else jnp.zeros(jnp.shape(x), bool)
 
-    def _compare(lf, rf, op: str):
+    _NAT = np.iinfo(np.int64).min  # NaT under the int64 epoch view
+
+    def num_unknown_expr(e: Expr):
+        """Missing-value mask of a numeric-valued subexpression: NaN for
+        float columns, NaT (INT64_MIN epoch view) for datetime columns,
+        propagated through arithmetic."""
+        if isinstance(e, Col):
+            codec = codecs[e.name]
+            name = e.name
+            if codec.kind == "datetime":
+                return lambda cols, lits: cols[name] == _NAT
+            return lambda cols, lits: _num_unknown(cols[name])
+        if isinstance(e, BinaryOp) and e.op in ("+", "-", "*", "/", "%"):
+            lu, ru = num_unknown_expr(e.left), num_unknown_expr(e.right)
+            return lambda cols, lits: lu(cols, lits) | ru(cols, lits)
+        return lambda cols, lits: jnp.zeros((), bool)
+
+    def _compare(lf, rf, op: str, lu=None, ru=None):
         def value(cols, lits):
             l, r = lf(cols, lits), rf(cols, lits)
             if op == "=":
@@ -268,7 +285,12 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
             return l >= r
 
         def unknown(cols, lits):
-            return _num_unknown(lf(cols, lits)) | _num_unknown(rf(cols, lits))
+            u = _num_unknown(lf(cols, lits)) | _num_unknown(rf(cols, lits))
+            if lu is not None:
+                u = u | lu(cols, lits)
+            if ru is not None:
+                u = u | ru(cols, lits)
+            return u
 
         return value, unknown
 
@@ -308,6 +330,9 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                         else jnp.zeros(cols[name].shape, bool),
                         no_unknown,
                     )
+                if codec.kind == "datetime":  # NaT under the int64 epoch view
+                    nat = np.iinfo(np.int64).min
+                    return (lambda cols, lits: cols[name] == nat, no_unknown)
                 return (lambda cols, lits: jnp.zeros(cols[name].shape, bool), no_unknown)
             raise DeviceUnsupported("IS NULL on non-column")
         if isinstance(e, In):
@@ -330,7 +355,9 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                     cf = build_num(child)
                     num = _literal_numeric(codecs[child.name], val)
                     i = slots.add(_as_lit_scalar(num))
-                    terms.append(_compare(cf, lambda cols, lits, i=i: lits[i], "="))
+                    terms.append(
+                        _compare(cf, lambda cols, lits, i=i: lits[i], "=", lu=num_unknown_expr(child))
+                    )
 
             def value(cols, lits):
                 m = terms[0][0](cols, lits)
@@ -353,9 +380,12 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                 lf = build_num(left)
                 val = _literal_numeric(codec, right.value)
                 i = slots.add(_as_lit_scalar(val))
-                return _compare(lf, lambda cols, lits: lits[i], op)
+                return _compare(lf, lambda cols, lits: lits[i], op, lu=num_unknown_expr(left))
             # general numeric compare (col-vs-col, arithmetic)
-            return _compare(build_num(left), build_num(right), op)
+            return _compare(
+                build_num(left), build_num(right), op,
+                lu=num_unknown_expr(left), ru=num_unknown_expr(right),
+            )
         if isinstance(e, InputFileName):
             raise DeviceUnsupported("input_file_name() is host-only")
         raise DeviceUnsupported(f"unsupported boolean expr {type(e).__name__}")
@@ -945,28 +975,6 @@ def _rank_cache_key(lside, rside, lkeys: List[str], rkeys: List[str]):
     return tuple(parts)
 
 
-def _device_key_eligible(side: L.LogicalPlan, key: str) -> bool:
-    """Cheap (footer-only) check that a side's join key can ride the device
-    span program (int64-comparable). Sides without an index leaf carrying the
-    key are conservatively host-routed."""
-    import pyarrow as pa
-    import pyarrow.parquet as pq
-
-    scans = L.collect(side, lambda x: isinstance(x, L.IndexScan))
-    scan = scans[0] if scans else None
-    if scan is None or not scan.files or key not in scan.columns:
-        return False
-    try:
-        field = pq.read_schema(scan.files[0]).field(scan.file_column_of(key))
-    except (OSError, KeyError):
-        return False
-    return bool(
-        pa.types.is_integer(field.type)
-        or pa.types.is_temporal(field.type)
-        or pa.types.is_boolean(field.type)
-    )
-
-
 def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     """Single entry point for the bucketed-SMJ paths: one compatibility
     analysis, then device or host spans by the input-rows threshold. Every
@@ -1363,6 +1371,32 @@ def _expand_gather_program(n_pad: int):
     return run
 
 
+@lru_cache(maxsize=1)
+def _bucket_pair_totals_fn():
+    """One jitted per-bucket matched-pair-count reduction shared by every
+    device-materialized join (a fresh jit per call would recompile on the
+    query hot path)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def run(lo, hi, ll, rl):
+        return jnp.sum(
+            jnp.where(
+                jnp.arange(lo.shape[1])[None, :] < ll[:, None],
+                jnp.minimum(hi, rl[:, None]) - jnp.minimum(lo, rl[:, None]),
+                0,
+            ),
+            axis=1,
+        )
+
+    return run
+
+
+def _bucket_pair_totals(lo, hi, ll, rl):
+    return _bucket_pair_totals_fn()(lo, hi, ll, rl)
+
+
 def _device_materialize_inner(
     session, plan: L.Join, lbuckets, rbuckets, lcols_needed, rcols_needed,
     lo_dev, hi_dev, llens, rlens, nb, nb_padded,
@@ -1400,16 +1434,7 @@ def _device_materialize_inner(
     llens_np = np.asarray(llens)
     rlens_np = np.asarray(rlens)
     bucket_totals = np.asarray(
-        jax.jit(
-            lambda lo, hi, ll, rl: jnp.sum(
-                jnp.where(
-                    jnp.arange(lo.shape[1])[None, :] < ll[:, None],
-                    jnp.minimum(hi, rl[:, None]) - jnp.minimum(lo, rl[:, None]),
-                    0,
-                ),
-                axis=1,
-            )
-        )(lo_dev, hi_dev, jnp.asarray(llens_np), jnp.asarray(rlens_np))
+        _bucket_pair_totals(lo_dev, hi_dev, jnp.asarray(llens_np), jnp.asarray(rlens_np))
     )
     total = int(bucket_totals.sum())
     out: B.Batch = {}

@@ -500,7 +500,8 @@ class Executor:
 
         def materialize_key(batch: B.Batch, name: str) -> bool:
             """Ensure ``name`` is a column of ``batch``; a dotted nested key
-            is extracted from its root struct column on demand."""
+            is extracted from its root struct column on demand, and casing
+            resolves like the analyzer's (Spark-default case-insensitive)."""
             if name in batch:
                 return True
             from hyperspace_tpu.plan.expr import get_column
@@ -508,6 +509,11 @@ class Executor:
             got = get_column(batch, name)
             if got is not None:
                 batch[name] = got
+                return True
+            lowered = {k.lower(): k for k in batch}
+            actual = lowered.get(name.lower())
+            if actual is not None:
+                batch[name] = batch[actual]
                 return True
             return False
 

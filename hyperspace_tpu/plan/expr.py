@@ -394,11 +394,8 @@ class IsNull(Expr):
             return np.ones((), dtype=bool) if n is None else np.ones(n, dtype=bool)
         if isinstance(v, NullableBool):
             return np.array(v.unknown)  # IS NULL of a three-valued boolean
-        if v.dtype.kind == "f":
-            return np.isnan(v)
-        if v.dtype == object:
-            return np.asarray([x is None for x in v])
-        return np.zeros(v.shape, dtype=bool)
+        # one definition of "missing" everywhere: NaN, NaT, or None
+        return _missing_mask(v)
 
     def __repr__(self) -> str:
         return f"({self.child!r} IS NULL)"
@@ -602,7 +599,7 @@ class Cast(Expr):
             v = np.where(missing, _object_fill(t), v)
         if t in ("int", "integer", "bigint", "smallint", "tinyint"):
             if has_missing:  # CAST(NULL AS int) is NULL: int64 can't hold it
-                out = v.astype(np.float64)
+                out = np.trunc(v.astype(np.float64))  # int cast truncates
                 out[missing] = np.nan
                 return out
             return v.astype(np.int64)

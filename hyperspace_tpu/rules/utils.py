@@ -290,11 +290,13 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         left_cols = set(plan.left.output_columns)
         right_cols = set(plan.right.output_columns)
 
-        def on_side(c: str, side: set) -> bool:
-            # a dotted nested ref belongs to the side holding its root struct
-            from hyperspace_tpu.plan.expr import column_root_member
+        from hyperspace_tpu.plan.expr import column_root_member
 
-            return column_root_member(c, side) is not None
+        def on_side(c: str, side: set):
+            # a dotted nested ref belongs to the side holding its root
+            # struct; the RESOLVED (exact-cased) name is what the scans can
+            # actually keep, so that is what gets recorded as needed
+            return column_root_member(c, side)
 
         if needed is None:
             l_needed = r_needed = None
@@ -307,15 +309,21 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
                     r_needed.add(c[:-2])
                     if c[:-2] in left_cols:
                         l_needed.add(c[:-2])
-                elif on_side(c, left_cols):
-                    l_needed.add(c)
-                elif on_side(c, right_cols):
-                    r_needed.add(c)
+                    continue
+                lr = on_side(c, left_cols)
+                if lr is not None:
+                    l_needed.add(lr)
+                    continue
+                rr = on_side(c, right_cols)
+                if rr is not None:
+                    r_needed.add(rr)
             for c in plan.condition.references():
-                if on_side(c, left_cols):
-                    l_needed.add(c)
-                if on_side(c, right_cols):
-                    r_needed.add(c)
+                lr = on_side(c, left_cols)
+                if lr is not None:
+                    l_needed.add(lr)
+                rr = on_side(c, right_cols)
+                if rr is not None:
+                    r_needed.add(rr)
         return L.Join(
             prune_columns(plan.left, l_needed),
             prune_columns(plan.right, r_needed),

@@ -129,6 +129,30 @@ class TestDeviceFilter:
         out2 = run_both(session, q2)
         assert set(out2["v"].tolist()) == {2}
 
+    def test_nat_dates_three_valued_on_device(self, session, hs, tmp_path):
+        """NaT (NULL date) comparisons are unknown on device exactly as on
+        host: != and NOT(=) must not keep the NaT row, IS NULL must find it."""
+        root = tmp_path / "nat"
+        root.mkdir()
+        days = np.array(["2024-01-01", "NaT", "2024-03-01"], dtype="datetime64[D]")
+        pq.write_table(
+            pa.table({"d": days, "v": np.arange(3, dtype=np.int64)}),
+            root / "p.parquet",
+        )
+        df = session.read_parquet(str(root))
+        hs = hst.Hyperspace(session)
+        hs.create_index(df, hst.CoveringIndexConfig("natIdx", ["d"], ["v"]))
+        session.enable_hyperspace()
+        q = df.filter(col("d") != np.datetime64("2024-01-01")).select("v")
+        out = run_both(session, q)
+        assert set(out["v"].tolist()) == {2}
+        q2 = df.filter(~(col("d") == np.datetime64("2024-01-01"))).select("v")
+        out2 = run_both(session, q2)
+        assert set(out2["v"].tolist()) == {2}
+        q3 = df.filter(col("d").is_null()).select("v")
+        out3 = run_both(session, q3)
+        assert set(out3["v"].tolist()) == {1}
+
     def test_predicate_compiler_rejects_host_only(self, session):
         from hyperspace_tpu.plan.expr import input_file_name
 

@@ -1,0 +1,405 @@
+"""E2E scenario matrix, porting the reference suite's remaining axes.
+
+The reference's E2EHyperspaceRulesTest (1,109 lines) sweeps enable/disable
+sequencing, case sensitivity in queries AND index configs, catalog/view
+sources, aliased-column limits, filter-subquery join children, globbing ×
+hybrid scan, and refresh-then-query per refresh mode; its source-integration
+suites repeat the refresh matrix on Delta and Iceberg
+(ref: src/test/scala/com/microsoft/hyperspace/index/E2EHyperspaceRulesTest.scala:75-1016,
+DeltaLakeIntegrationTest.scala, IcebergIntegrationTest.scala).
+
+Every scenario here asserts the two reference invariants: the rewritten plan
+scans index files (verifyIndexUsage), and results equal the no-index run
+(checkAnswer).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+def index_scans(q):
+    return [p for p in L.collect(q.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
+
+
+def rows(batch):
+    cols = sorted(batch.keys())
+    def norm(v):
+        return "NaN" if isinstance(v, float) and v != v else v
+    return sorted(tuple(norm(v) for v in r) for r in zip(*[batch[k].tolist() for k in cols]))
+
+
+def check_answer(session, q):
+    """checkAnswer: results equal with hyperspace on vs off."""
+    session.enable_hyperspace()
+    on = q.collect()
+    session.disable_hyperspace()
+    off = q.collect()
+    session.enable_hyperspace()
+    assert sorted(on.keys()) == sorted(off.keys())
+    assert rows(on) == rows(off)
+    return on
+
+
+def write_sample(d, n=400, seed=0, start=0):
+    rng = np.random.default_rng(seed)
+    pq.write_table(
+        pa.table(
+            {
+                "Query": np.array([f"q{v}" for v in rng.integers(0, 30, n)]),
+                "imprs": rng.integers(0, 100, n).astype(np.int64),
+                "clicks": rng.integers(0, 10, n).astype(np.int64),
+            }
+        ),
+        os.path.join(d, f"part-{start:05d}.parquet"),
+    )
+
+
+class TestEnableDisableSequencing:
+    """(ref: E2EHyperspaceRulesTest:75-123, 403-519)"""
+
+    def test_enable_disable_enable(self, session, hs, tmp_path):
+        d = tmp_path / "seq"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("seqIdx", ["Query"], ["imprs"]))
+        q = df.filter(hst.col("Query") == "q3").select("imprs")
+        session.enable_hyperspace()
+        assert index_scans(q)
+        session.disable_hyperspace()
+        assert not index_scans(q)
+        session.enable_hyperspace()
+        assert index_scans(q)
+
+    def test_is_hyperspace_enabled(self, session, hs, tmp_path):
+        assert not session.is_hyperspace_enabled()
+        session.enable_hyperspace()
+        assert session.is_hyperspace_enabled()
+        session.disable_hyperspace()
+        assert not session.is_hyperspace_enabled()
+
+    def test_double_enable_is_idempotent(self, session, hs, tmp_path):
+        d = tmp_path / "dbl"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("dblIdx", ["Query"], ["imprs"]))
+        session.enable_hyperspace()
+        session.enable_hyperspace()
+        q = df.filter(hst.col("Query") == "q1").select("imprs")
+        assert len(index_scans(q)) == 1
+        check_answer(session, q)
+
+
+class TestCaseSensitivity:
+    """Differently-cased column names in configs, queries, and SQL all
+    resolve to the same index (ref: E2EHyperspaceRulesTest:124-228)."""
+
+    def test_filter_query_case_insensitive(self, session, hs, tmp_path):
+        d = tmp_path / "cs1"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        # config uses different casing than the data ("QUERY" vs "Query")
+        hs.create_index(df, hst.CoveringIndexConfig("csIdx", ["QUERY"], ["IMPRS"]))
+        q = df.filter(hst.col("query") == "q7").select("imprs")
+        session.enable_hyperspace()
+        assert index_scans(q), q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_join_query_case_insensitive(self, session, hs, tmp_path):
+        l, r = tmp_path / "cs_l", tmp_path / "cs_r"
+        l.mkdir(), r.mkdir()
+        write_sample(str(l), seed=1)
+        pq.write_table(
+            pa.table({"query": np.array([f"q{i}" for i in range(30)]),
+                      "budget": np.arange(30.0)}),
+            r / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf, rdf = session.read_parquet(str(l)), session.read_parquet(str(r))
+        hs.create_index(ldf, hst.CoveringIndexConfig("csJL", ["Query"], ["imprs"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("csJR", ["QUERY"], ["budget"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=hst.col("QUERY") == hst.col("query")).select("imprs", "budget")
+        assert len(index_scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_sql_case_insensitive(self, session, hs, tmp_path):
+        d = tmp_path / "cs2"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        df.create_or_replace_temp_view("casey")
+        hs.create_index(df, hst.CoveringIndexConfig("csSql", ["Query"], ["imprs"]))
+        session.enable_hyperspace()
+        q = session.sql("SELECT IMPRS FROM casey WHERE QUERY = 'q2'")
+        assert index_scans(q), q.optimized_plan().pretty()
+        check_answer(session, q)
+
+
+class TestViewSources:
+    """Temp views as query sources (the reference's catalog temp
+    tables/views scenario, E2EHyperspaceRulesTest:266-288)."""
+
+    def test_join_on_temp_views(self, session, hs, tmp_path):
+        l, r = tmp_path / "v_l", tmp_path / "v_r"
+        l.mkdir(), r.mkdir()
+        write_sample(str(l), seed=2)
+        pq.write_table(
+            pa.table({"Query": np.array([f"q{i}" for i in range(30)]),
+                      "rank": np.arange(30, dtype=np.int64)}),
+            r / "p.parquet",
+        )
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf, rdf = session.read_parquet(str(l)), session.read_parquet(str(r))
+        ldf.create_or_replace_temp_view("clicks_v")
+        rdf.create_or_replace_temp_view("ranks_v")
+        hs.create_index(ldf, hst.CoveringIndexConfig("vJL", ["Query"], ["clicks"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("vJR", ["Query"], ["rank"]))
+        session.enable_hyperspace()
+        q = session.sql(
+            "SELECT clicks, rank FROM clicks_v c JOIN ranks_v r ON c.Query = r.Query"
+        )
+        assert len(index_scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_view_over_filtered_frame_not_rewritten_wrongly(self, session, hs, tmp_path):
+        d = tmp_path / "v2"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("vF", ["Query"], ["imprs"]))
+        filtered = df.filter(hst.col("imprs") > 50)
+        filtered.create_or_replace_temp_view("hot")
+        session.enable_hyperspace()
+        # index does NOT cover 'clicks': the view query must stay unrewritten
+        q = session.sql("SELECT clicks FROM hot WHERE Query = 'q1'")
+        assert not index_scans(q)
+        check_answer(session, q)
+
+
+class TestJoinShapes:
+    def test_join_children_with_filters(self, session, hs, tmp_path):
+        """Both join children are filter sub-queries
+        (ref: E2EHyperspaceRulesTest:372-402)."""
+        l, r = tmp_path / "f_l", tmp_path / "f_r"
+        l.mkdir(), r.mkdir()
+        write_sample(str(l), seed=3)
+        write_sample(str(r), seed=4)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf, rdf = session.read_parquet(str(l)), session.read_parquet(str(r))
+        hs.create_index(ldf, hst.CoveringIndexConfig("fJL", ["Query"], ["imprs", "clicks"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("fJR", ["Query"], ["imprs", "clicks"]))
+        session.enable_hyperspace()
+        q = (
+            ldf.filter(hst.col("clicks") >= 2)
+            .join(rdf.filter(hst.col("clicks") <= 4), on="Query")
+            .select("Query", "imprs", "imprs#r")
+        )
+        assert len(index_scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_aliased_columns_not_supported(self, session, hs, tmp_path):
+        """A join over renamed columns is not rewritten (the reference's
+        'alias columns is not supported', E2EHyperspaceRulesTest:229-265)."""
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.logical import Rename
+
+        l, r = tmp_path / "a_l", tmp_path / "a_r"
+        l.mkdir(), r.mkdir()
+        write_sample(str(l), seed=5)
+        write_sample(str(r), seed=6)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        ldf, rdf = session.read_parquet(str(l)), session.read_parquet(str(r))
+        hs.create_index(ldf, hst.CoveringIndexConfig("aJL", ["Query"], ["imprs"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("aJR", ["Query"], ["imprs"]))
+        session.enable_hyperspace()
+        renamed = DataFrame(Rename({"Query": "q2_alias"}, ldf.plan), session)
+        q = renamed.join(rdf, on=hst.col("q2_alias") == hst.col("Query")).select(
+            "q2_alias", "imprs"
+        )
+        assert not index_scans(q)  # rewrite would mis-bind the renamed key
+        check_answer(session, q)
+
+
+class TestGlobbingHybrid:
+    """Globbing pattern × appended data × hybrid scan
+    (ref: E2EHyperspaceRulesTest:926-985)."""
+
+    def test_glob_pattern_with_appends_hybrid_scan(self, session, hs, tmp_path):
+        base = tmp_path / "glob"
+        (base / "2024").mkdir(parents=True)
+        (base / "2025").mkdir()
+        write_sample(str(base / "2024"), seed=7)
+        write_sample(str(base / "2025"), seed=8)
+        pattern = str(base / "*")
+        session.conf.set(hst.keys.GLOBBING_PATTERN, pattern)
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+        df = session.read_parquet(str(base))
+        hs.create_index(df, hst.CoveringIndexConfig("globIdx", ["Query"], ["imprs"]))
+        # append under a NEW glob-matched dir after indexing
+        (base / "2026").mkdir()
+        write_sample(str(base / "2026"), seed=9, start=1)
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.9)
+        try:
+            df2 = session.read_parquet(str(base))
+            q = df2.filter(hst.col("Query") == "q5").select("imprs")
+            session.enable_hyperspace()
+            plan = q.optimized_plan()
+            assert any(
+                isinstance(p, (L.IndexScan, L.BucketUnion)) for p in L.collect(plan, lambda x: True)
+            ), plan.pretty()
+            check_answer(session, q)
+        finally:
+            session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, False)
+            session.conf.unset(hst.keys.GLOBBING_PATTERN)
+
+
+def _refresh_then_query_matrix_case(session, hs, make_source, refresh_mode, name):
+    """Shared scenario: index -> mutate source -> refreshIndex(mode) ->
+    query must use the index and match the no-index answer."""
+    df, mutate = make_source()
+    hs.create_index(df, hst.CoveringIndexConfig(name, ["k"], ["v"]))
+    df2 = mutate()
+    if refresh_mode == "quick":
+        session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.99)
+    try:
+        hs.refresh_index(name, refresh_mode)
+        q = df2.filter(hst.col("k") == 3).select("v")
+        session.enable_hyperspace()
+        plan = q.optimized_plan()
+        used = any(
+            isinstance(p, (L.IndexScan, L.BucketUnion)) for p in L.collect(plan, lambda x: True)
+        )
+        assert used, f"{name}/{refresh_mode}: {plan.pretty()}"
+        check_answer(session, q)
+    finally:
+        if refresh_mode == "quick":
+            session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, False)
+
+
+def _table(seed, n=300):
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {"k": rng.integers(0, 20, n).astype(np.int64), "v": np.round(rng.uniform(0, 10, n), 3)}
+    )
+
+
+class TestRefreshModeSourceMatrix:
+    """refresh-then-query per refresh mode × source format
+    (ref: RefreshIndexTest, DeltaLakeIntegrationTest, IcebergIntegrationTest)."""
+
+    @pytest.fixture(autouse=True)
+    def _buckets(self, session):
+        session.conf.set(hst.keys.NUM_BUCKETS, 4)
+        session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+
+    @pytest.mark.parametrize("mode", ["full", "incremental", "quick"])
+    def test_parquet(self, session, hs, tmp_path, mode):
+        d = tmp_path / f"pq_{mode}"
+        d.mkdir()
+
+        def make():
+            pq.write_table(_table(1), d / "p0.parquet")
+            df = session.read_parquet(str(d))
+
+            def mutate():
+                pq.write_table(_table(2), d / "p1.parquet")
+                return session.read_parquet(str(d))
+
+            return df, mutate
+
+        _refresh_then_query_matrix_case(session, hs, make, mode, f"pqM_{mode}")
+
+    @pytest.mark.parametrize("mode", ["full", "incremental", "quick"])
+    def test_delta(self, session, hs, tmp_path, mode):
+        from hyperspace_tpu.sources.delta import write_delta_table
+
+        d = str(tmp_path / f"dl_{mode}")
+
+        def make():
+            write_delta_table(_table(3), d)
+            df = session.read_delta(d)
+
+            def mutate():
+                write_delta_table(_table(4), d)
+                return session.read_delta(d)
+
+            return df, mutate
+
+        _refresh_then_query_matrix_case(session, hs, make, mode, f"dlM_{mode}")
+
+    @pytest.mark.parametrize("mode", ["full", "incremental", "quick"])
+    def test_iceberg(self, session, hs, tmp_path, mode):
+        from hyperspace_tpu.sources.iceberg import write_iceberg_table
+
+        d = str(tmp_path / f"ib_{mode}")
+
+        def make():
+            write_iceberg_table(_table(5), d)
+            df = session.read_iceberg(d)
+
+            def mutate():
+                write_iceberg_table(_table(6), d)
+                return session.read_iceberg(d)
+
+            return df, mutate
+
+        _refresh_then_query_matrix_case(session, hs, make, mode, f"ibM_{mode}")
+
+    def test_incremental_with_deleted_files(self, session, hs, tmp_path):
+        """(ref: E2EHyperspaceRulesTest:520 'index usage after incremental
+        refresh with some source data file deleted')"""
+        d = tmp_path / "pq_del"
+        d.mkdir()
+        pq.write_table(_table(7), d / "p0.parquet")
+        pq.write_table(_table(8), d / "p1.parquet")
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("delIdx", ["k"], ["v"]))
+        os.remove(d / "p1.parquet")
+        hs.refresh_index("delIdx", "incremental")
+        df2 = session.read_parquet(str(d))
+        q = df2.filter(hst.col("k") == 3).select("v")
+        session.enable_hyperspace()
+        assert index_scans(q), q.optimized_plan().pretty()
+        on = check_answer(session, q)
+        want = _table(7).to_pandas()
+        assert sorted(on["v"].tolist()) == sorted(
+            want[want["k"] == 3]["v"].round(3).tolist()
+        )
+
+
+class TestUnsupportedIndexes:
+    """Rules skip indexes of other kinds (ref: E2EHyperspaceRulesTest:1008-1023)."""
+
+    def test_filter_rule_ignores_dataskipping_for_covering_rewrite(self, session, hs, tmp_path):
+        d = tmp_path / "unsup"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        hs.create_index(
+            df, hst.DataSkippingIndexConfig("dsOnly", hst.MinMaxSketch("imprs"))
+        )
+        session.enable_hyperspace()
+        # no covering index exists: the plan keeps scanning source files
+        q = df.filter(hst.col("Query") == "q1").select("imprs")
+        assert not index_scans(q)
+        check_answer(session, q)
