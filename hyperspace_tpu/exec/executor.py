@@ -11,7 +11,7 @@ here the framework owns it (SURVEY.md §7 design stance).
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow.dataset as pads
@@ -169,9 +169,30 @@ class Executor:
     def execute(self, plan: L.LogicalPlan, required_columns: Optional[List[str]] = None) -> B.Batch:
         from hyperspace_tpu.plan.expr import subquery_scope
 
-        with subquery_scope():  # each subquery runs once per outermost execute
-            with_file_names = _plan_needs_file_names(plan)
-            batch = self._exec(plan, with_file_names)
+        # sub-plans referenced more than once (a CTE used N times holds ONE
+        # plan object) execute once per collect; only those roots memoize
+        counts: Dict[int, int] = {}
+
+        def walk(p: L.LogicalPlan) -> None:
+            c = counts.get(id(p), 0) + 1
+            counts[id(p)] = c
+            if c == 1:
+                for ch in p.children():
+                    walk(ch)
+
+        walk(plan)
+        # NOTE: joins served by the device bucketed-SMJ path decode their
+        # sides from index files directly (with their own byte-capped
+        # caches), so this memo pays off on the host execution paths
+        self._shared = {pid for pid, c in counts.items() if c > 1}
+        self._memo: Dict[Tuple[int, bool], B.Batch] = {}
+        try:
+            with subquery_scope():  # each subquery runs once per execute
+                with_file_names = _plan_needs_file_names(plan)
+                batch = self._exec(plan, with_file_names)
+        finally:
+            self._memo = {}
+            self._shared = set()
         if required_columns is not None:
             batch = B.select(batch, required_columns)
         elif INPUT_FILE_NAME in batch:
@@ -179,6 +200,19 @@ class Executor:
         return batch
 
     def _exec(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
+        # hits hand out shallow copies so callers may add derived keys
+        # without cross-talk (arrays themselves are never mutated)
+        if id(plan) in getattr(self, "_shared", ()):
+            key = (id(plan), with_file_names)
+            hit = self._memo.get(key)
+            if hit is not None:
+                return dict(hit)
+            batch = self._exec_inner(plan, with_file_names)
+            self._memo[key] = batch
+            return dict(batch)
+        return self._exec_inner(plan, with_file_names)
+
+    def _exec_inner(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
         if isinstance(plan, L.Scan):
             return self._exec_scan(plan, with_file_names)
 

@@ -144,6 +144,11 @@ def tpcds(tmp_path_factory):
                 cols[cname] = np.datetime64("1998-01-01") + rng.integers(0, 1800, n).astype(
                     "timedelta64[D]"
                 )
+            elif cname.endswith("_id"):
+                # business ids are UNIQUE in real TPC-DS data; collisions here
+                # make the q4/q11/q31 CTE self-join chains explode
+                # multiplicatively (observed 9.6M rows from 40-row tables)
+                cols[cname] = np.array([f"{cname[:6]}_{i:05d}" for i in rng.permutation(n)])
             else:
                 cols[cname] = np.array([f"{cname[:6]}_{v}" for v in rng.integers(0, n, n)])
         d = os.path.join(root, name)
