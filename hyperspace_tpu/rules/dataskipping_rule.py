@@ -37,6 +37,8 @@ from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.rules.utils import destructure_linear
 
 RULE_NAME = "DataSkippingIndexRule"
+# ceiling of max(1, int(40 x pruned)) + 1 below (see score.py short-circuit)
+MAX_SCORE = 41
 
 _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
 

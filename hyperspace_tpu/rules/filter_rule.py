@@ -23,6 +23,8 @@ from hyperspace_tpu.rules.utils import (
 )
 
 RULE_NAME = "FilterIndexRule"
+# ceiling of the 50 x coverage score below (see score.py short-circuit)
+MAX_SCORE = 50
 
 
 def _filter_column_filter(

@@ -33,6 +33,9 @@ from hyperspace_tpu.rules.utils import (
 )
 
 RULE_NAME = "JoinIndexRule"
+# ceiling of the 70+70 coverage score below — the optimizer short-circuits
+# rules that cannot beat the current best, keyed on this constant
+MAX_SCORE = 140
 
 
 def _attribute_mapping(

@@ -17,39 +17,88 @@ from hyperspace_tpu.rules.dataskipping_rule import apply_data_skipping_rule
 from hyperspace_tpu.rules.filter_rule import apply_filter_index_rule
 from hyperspace_tpu.rules.join_rule import apply_join_index_rule
 
-RULES = (apply_filter_index_rule, apply_join_index_rule, apply_data_skipping_rule)
+from hyperspace_tpu.rules import dataskipping_rule as _ds
+from hyperspace_tpu.rules import filter_rule as _fr
+from hyperspace_tpu.rules import join_rule as _jr
+
+# (rule, its maximum possible score) — tried highest-max first so the
+# beaten-rule short-circuit bites as early as possible
+RULES = (
+    (apply_join_index_rule, _jr.MAX_SCORE),
+    (apply_filter_index_rule, _fr.MAX_SCORE),
+    (apply_data_skipping_rule, _ds.MAX_SCORE),
+)
+
+# linear-chain nodes: when the chain TOP destructures, a rule applied there
+# requires a subset of the columns any lower application would (and sees a
+# superset of the filter conjuncts), so it succeeds whenever a lower one
+# does — re-evaluating rules below such a top is pure overhead on the
+# per-query hot path. When the top does NOT destructure (e.g. a filter over
+# a computed column pins the chain), interior nodes stay eligible.
+_CHAIN_NODES = (L.Project, L.Filter, L.Compute)
 
 
 class ScoreBasedIndexPlanOptimizer:
     def __init__(self, ctx: RuleContext):
         self.ctx = ctx
         self._memo: Dict[int, Tuple[L.LogicalPlan, int]] = {}
+        self._multi_parent: set = set()
 
     def apply(self, plan: L.LogicalPlan, candidates) -> Tuple[L.LogicalPlan, int]:
+        counts: Dict[int, int] = {}
+
+        def walk(p: L.LogicalPlan) -> None:
+            c = counts.get(id(p), 0) + 1
+            counts[id(p)] = c
+            if c == 1:
+                for ch in p.children():
+                    walk(ch)
+
+        walk(plan)
+        # a sub-plan with several parents (a CTE referenced N times) always
+        # gets the full rule set and ONE memo entry, so the rewritten tree
+        # keeps sharing a single object (the executor's shared-subplan memo
+        # depends on that identity)
+        self._multi_parent = {pid for pid, c in counts.items() if c > 1}
         return self._rec(plan, candidates)
 
-    def _rec(self, plan: L.LogicalPlan, candidates) -> Tuple[L.LogicalPlan, int]:
+    def _rec(
+        self, plan: L.LogicalPlan, candidates, in_chain: bool = False
+    ) -> Tuple[L.LogicalPlan, int]:
+        if id(plan) in self._multi_parent:
+            in_chain = False
         key = id(plan)
         if key in self._memo:
             return self._memo[key]
+
+        # exhaustive mode for whyNot: every rule must run at every node so
+        # the per-index disqualification reasons get collected
+        analysis = self.ctx.analysis_enabled
+        from hyperspace_tpu.rules.utils import destructure_linear
+
+        chain_top = isinstance(plan, _CHAIN_NODES) and destructure_linear(plan) is not None
 
         # NoOp path: optimize children independently (score = sum)
         children = list(plan.children())
         best_plan, best_score = plan, 0
         if children:
+            child_in_chain = chain_top and len(children) == 1
             new_children = []
             child_score = 0
             for c in children:
-                nc, s = self._rec(c, candidates)
+                nc, s = self._rec(c, candidates, in_chain=child_in_chain)
                 new_children.append(nc)
                 child_score += s
             if child_score > 0:
                 best_plan, best_score = plan.with_children(new_children), child_score
 
-        for rule in RULES:
-            transformed, score = rule(self.ctx, plan, candidates)
-            if score > best_score:
-                best_plan, best_score = transformed, score
+        if analysis or not in_chain:
+            for rule, max_score in RULES:
+                if max_score <= best_score and not analysis:
+                    continue  # cannot beat the current best (ties keep it)
+                transformed, score = rule(self.ctx, plan, candidates)
+                if score > best_score:
+                    best_plan, best_score = transformed, score
 
         self._memo[key] = (best_plan, best_score)
         return best_plan, best_score

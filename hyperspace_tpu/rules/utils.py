@@ -161,27 +161,32 @@ def transform_plan_to_use_index(
     else:
         new_scan = _hybrid_scan_plan(ctx, entry, scan, required_all, bucket_spec)
 
-    # canonical rebuild Project→Compute*→Filter→IndexScan: filters sit
-    # DIRECTLY above the scan (the executor's device fast paths match that
-    # shape) and Compute nodes (SQL expression SELECT items) re-apply above,
-    # in their original order
-    node, outer_cols, computes = sub_plan, None, []
+    # canonical rebuild: every Filter sinks DIRECTLY above the scan (the
+    # executor's device fast paths match that shape); Project and Compute
+    # nodes re-apply above in their original relative order, with Projects
+    # narrowed to the columns actually available and no-op Projects elided
+    ops = []  # top-down chain ops
+    node = sub_plan
     while not isinstance(node, L.Scan):
-        if isinstance(node, L.Project) and outer_cols is None:
-            outer_cols = list(node.columns)
-        if isinstance(node, L.Compute):
-            computes.append(node)
+        if isinstance(node, L.Project):
+            ops.append(("project", list(node.columns)))
+        elif isinstance(node, L.Compute):
+            ops.append(("compute", node.exprs))
         (node,) = node.children()
 
     out: L.LogicalPlan = new_scan
     if condition is not None:
         out = L.Filter(condition, out)
-    for comp in reversed(computes):  # innermost compute first
-        out = L.Compute(comp.exprs, out)
-    if outer_cols is not None:
-        out = L.Project(outer_cols, out)
-    elif set(out.output_columns) != set(required):
-        out = L.Project(list(required), out)
+    for kind, payload in reversed(ops):  # innermost op first
+        if kind == "compute":
+            out = L.Compute(payload, out)
+        else:
+            avail = set(out.output_columns)
+            cols = [c for c in payload if c in avail]
+            if cols != list(out.output_columns):  # elide no-op projections
+                out = L.Project(cols, out)
+    if set(out.output_columns) != set(sub_plan.output_columns):
+        out = L.Project(list(sub_plan.output_columns), out)
     return out
 
 

@@ -271,6 +271,31 @@ class TestColumnPruning:
         session.enable_hyperspace()
         assert_batches_equal(q.collect(), baseline)
 
+    def test_filter_over_computed_column_still_rewrites_interior(self, session, hs, sample_parquet):
+        """A filter over a computed column pins the chain top (it cannot move
+        below the Compute), but the interior Filter->Scan must still rewrite
+        to the index — the optimizer's chain-top shortcut must not skip it."""
+        from hyperspace_tpu.plan import logical as L
+        from hyperspace_tpu.plan.dataframe import DataFrame
+
+        hs.create_index(
+            session.read_parquet(sample_parquet),
+            hst.CoveringIndexConfig("computedIdx", ["c1"], ["c2", "c3", "c4"]),
+        )
+        session.enable_hyperspace()
+        df = session.read_parquet(sample_parquet).filter(hst.col("c1") == 7)
+        computed = DataFrame(
+            L.Compute([("dbl", hst.col("c2") * 2)], df.plan), session
+        ).filter(hst.col("dbl") > 100).select("dbl")
+        plan = computed.optimized_plan()
+        assert any(
+            isinstance(p, L.IndexScan) for p in L.collect(plan, lambda x: True)
+        ), plan.pretty()
+        on = np.sort(computed.collect()["dbl"])
+        session.disable_hyperspace()
+        off = np.sort(computed.collect()["dbl"])
+        assert np.array_equal(on, off)
+
     def test_no_rewrite_returns_untouched_plan(self, session, hs, sample_parquet):
         df = session.read_parquet(sample_parquet)
         hs.create_index(df, hst.CoveringIndexConfig("unusedIdx", ["c1"], ["c2"]))
