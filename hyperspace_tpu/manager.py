@@ -110,13 +110,18 @@ class IndexCollectionManager:
         return index_statistics(self.session, entry, extended)
 
     def indexes(self):
-        """Summary of all indexes as a pandas DataFrame
-        (ref: Hyperspace.indexes returning a Spark DataFrame)."""
+        """Summary of all indexes as a pandas DataFrame; vacuumed
+        (DOESNOTEXIST) entries are filtered out
+        (ref: IndexCollectionManager.scala:109-118)."""
         import pandas as pd
 
         from hyperspace_tpu.stats import index_statistics
 
-        rows = [index_statistics(self.session, e, False) for e in self.get_indexes(list(states.STABLE_STATES))]
+        rows = [
+            index_statistics(self.session, e, False)
+            for e in self.get_indexes(list(states.STABLE_STATES))
+            if e.state != states.DOESNOTEXIST
+        ]
         return pd.DataFrame(rows)
 
 
