@@ -45,6 +45,11 @@ def initialize_from_env(
     num_processes = num_processes if num_processes is not None else _int_env("HS_NUM_PROCESSES")
     if num_processes is None or num_processes <= 1:
         return False
+    if _jax_runtime_up():
+        # a launcher already called jax.distributed.initialize() itself
+        # (e.g. the no-argument TPU-pod path); don't initialize twice
+        _initialized = True
+        return True
     process_id = process_id if process_id is not None else _int_env("HS_PROCESS_ID")
     if process_id is None:
         raise ValueError("HS_PROCESS_ID must be set when HS_NUM_PROCESSES > 1")
@@ -59,6 +64,15 @@ def initialize_from_env(
     )
     _initialized = True
     return True
+
+
+def _jax_runtime_up() -> bool:
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
 
 
 def shutdown() -> None:

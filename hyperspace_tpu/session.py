@@ -20,6 +20,11 @@ from hyperspace_tpu.sources.manager import FileBasedSourceProviderManager
 
 class Session:
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        # multi-process runtimes (HS_NUM_PROCESSES et al.) come up before any
+        # device is touched; a no-op in single-process mode (SURVEY §5.8)
+        from hyperspace_tpu.parallel.distributed import initialize_from_env
+
+        initialize_from_env()
         self.conf = HyperspaceConf(conf)
         self.provider_manager = FileBasedSourceProviderManager(self)
         self.hyperspace_enabled = False

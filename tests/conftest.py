@@ -67,3 +67,39 @@ def session(tmp_system_path):
     hst.set_session(sess)
     yield sess
     hst.set_session(None)
+
+
+# --- shared E2E helpers (the reference's verifyIndexUsage/checkAnswer) ------
+
+
+def index_scans(q):
+    """IndexScan nodes of the optimized plan (verifyIndexUsage side)."""
+    from hyperspace_tpu.plan import logical as L
+
+    return [p for p in L.collect(q.optimized_plan(), lambda x: True) if isinstance(p, L.IndexScan)]
+
+
+def sorted_rows(batch):
+    """Row-set normal form: sorted tuples with NaN made comparable."""
+
+    def norm(v):
+        return "NaN" if isinstance(v, float) and v != v else v
+
+    cols = sorted(batch.keys())
+    if not cols:
+        return []
+    return sorted(tuple(norm(v) for v in r) for r in zip(*[batch[k].tolist() for k in cols]))
+
+
+def check_answer(session, q):
+    """Full row-set equality with hyperspace on vs off (checkAnswer)."""
+    session.enable_hyperspace()
+    on = q.collect()
+    session.disable_hyperspace()
+    try:
+        off = q.collect()
+    finally:
+        session.enable_hyperspace()
+    assert sorted(on.keys()) == sorted(off.keys())
+    assert sorted_rows(on) == sorted_rows(off)
+    return on

@@ -31,27 +31,7 @@ def hs(session):
     return hst.Hyperspace(session)
 
 
-def index_scans(q):
-    return [p for p in L.collect(q.optimized_plan(), lambda p: True) if isinstance(p, L.IndexScan)]
-
-
-def rows(batch):
-    cols = sorted(batch.keys())
-    def norm(v):
-        return "NaN" if isinstance(v, float) and v != v else v
-    return sorted(tuple(norm(v) for v in r) for r in zip(*[batch[k].tolist() for k in cols]))
-
-
-def check_answer(session, q):
-    """checkAnswer: results equal with hyperspace on vs off."""
-    session.enable_hyperspace()
-    on = q.collect()
-    session.disable_hyperspace()
-    off = q.collect()
-    session.enable_hyperspace()
-    assert sorted(on.keys()) == sorted(off.keys())
-    assert rows(on) == rows(off)
-    return on
+from conftest import check_answer, index_scans  # noqa: E402
 
 
 def write_sample(d, n=400, seed=0, start=0):

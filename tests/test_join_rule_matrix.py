@@ -1,0 +1,221 @@
+"""JoinIndexRule scenario matrix, porting the reference's JoinIndexRuleTest
+breadth (614 lines — ref:
+src/test/scala/com/microsoft/hyperspace/index/covering/JoinIndexRuleTest.scala:120-570):
+non-equality / OR / literal join conditions, one-to-one attribute mapping,
+composite keys in every predicate order, repeated predicates, and swapped
+attributes."""
+
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import col, lit
+
+
+@pytest.fixture()
+def hs(session):
+    return hst.Hyperspace(session)
+
+
+@pytest.fixture()
+def two_sides(session, hs, tmp_path):
+    session.conf.set(hst.keys.NUM_BUCKETS, 4)
+    rng = np.random.default_rng(12)
+    l, r = tmp_path / "jl", tmp_path / "jr"
+    l.mkdir(), r.mkdir()
+    n = 600
+    pq.write_table(
+        pa.table(
+            {
+                "t1c1": rng.integers(0, 40, n).astype(np.int64),
+                "t1c2": np.array([f"s{v}" for v in rng.integers(0, 10, n)]),
+                "t1c3": rng.integers(0, 20, n).astype(np.int64),
+                "t1c4": rng.standard_normal(n),
+            }
+        ),
+        l / "p.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "t2c1": rng.integers(0, 40, n).astype(np.int64),
+                "t2c2": np.array([f"s{v}" for v in rng.integers(0, 10, n)]),
+                "t2c3": rng.integers(0, 20, n).astype(np.int64),
+                "t2c4": rng.standard_normal(n),
+            }
+        ),
+        r / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(l)), session.read_parquet(str(r))
+    return ldf, rdf
+
+
+from conftest import check_answer, index_scans as scans  # noqa: E402
+
+
+class TestEligibility:
+    def test_applies_with_matching_indexes(self, session, hs, two_sides):
+        ldf, rdf = two_sides
+        hs.create_index(ldf, hst.CoveringIndexConfig("e1L", ["t1c1"], ["t1c4"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("e1R", ["t2c1"], ["t2c4"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=col("t1c1") == col("t2c1")).select("t1c4", "t2c4")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_no_rewrite_for_non_equality_condition(self, session, hs, two_sides):
+        """(ref: JoinIndexRuleTest:171-186)"""
+        ldf, rdf = two_sides
+        hs.create_index(ldf, hst.CoveringIndexConfig("neL", ["t1c1"], ["t1c4"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("neR", ["t2c1"], ["t2c4"]))
+        session.enable_hyperspace()
+        # a non-equality condition plans but is never rewritten (and the
+        # executor rejects it at run time: only conjunctive equi-joins exist)
+        q = ldf.join(rdf, on=col("t1c1") > col("t2c1"), how="inner")
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        with pytest.raises(NotImplementedError, match="equi-join"):
+            q.collect()
+
+    def test_no_rewrite_for_or_condition(self, session, hs, two_sides):
+        """(ref: JoinIndexRuleTest:187-202)"""
+        ldf, rdf = two_sides
+        session.enable_hyperspace()
+        q = ldf.join(
+            rdf, on=(col("t1c1") == col("t2c1")) | (col("t1c3") == col("t2c3"))
+        )
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        with pytest.raises(NotImplementedError, match="equi-join"):
+            q.collect()
+
+    def test_no_rewrite_for_literal_condition(self, session, hs, two_sides):
+        """(ref: JoinIndexRuleTest:203-218)"""
+        ldf, rdf = two_sides
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=col("t1c1") == lit(5))
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        with pytest.raises(NotImplementedError, match="equi-join"):
+            q.collect()
+
+    def test_no_rewrite_when_one_side_unindexed(self, session, hs, two_sides):
+        """(ref: JoinIndexRuleTest:219-239)"""
+        ldf, rdf = two_sides
+        hs.create_index(ldf, hst.CoveringIndexConfig("halfL", ["t1c1"], ["t1c4"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=col("t1c1") == col("t2c1")).select("t1c4", "t2c4")
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_no_rewrite_when_index_missing_required_column(self, session, hs, two_sides):
+        ldf, rdf = two_sides
+        hs.create_index(ldf, hst.CoveringIndexConfig("mcL", ["t1c1"], ["t1c4"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("mcR", ["t2c1"], ["t2c4"]))
+        session.enable_hyperspace()
+        # t2c3 is not covered by mcR -> no rewrite on either side
+        q = ldf.join(rdf, on=col("t1c1") == col("t2c1")).select("t1c4", "t2c3")
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+
+class TestCompositeKeys:
+    """(ref: JoinIndexRuleTest:403-521 composite AND equi-joins)"""
+
+    def _indexes(self, hs, ldf, rdf, tag):
+        hs.create_index(
+            ldf, hst.CoveringIndexConfig(f"{tag}L", ["t1c1", "t1c2"], ["t1c4"])
+        )
+        hs.create_index(
+            rdf, hst.CoveringIndexConfig(f"{tag}R", ["t2c1", "t2c2"], ["t2c4"])
+        )
+
+    def test_composite_and_join(self, session, hs, two_sides):
+        ldf, rdf = two_sides
+        self._indexes(hs, ldf, rdf, "ca")
+        session.enable_hyperspace()
+        q = ldf.join(
+            rdf, on=(col("t1c1") == col("t2c1")) & (col("t1c2") == col("t2c2"))
+        ).select("t1c4", "t2c4")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_composite_predicate_order_flipped(self, session, hs, two_sides):
+        """Predicates in the opposite order of the index's column order
+        still match (ref: :419-435)."""
+        ldf, rdf = two_sides
+        self._indexes(hs, ldf, rdf, "cf")
+        session.enable_hyperspace()
+        q = ldf.join(
+            rdf, on=(col("t1c2") == col("t2c2")) & (col("t1c1") == col("t2c1"))
+        ).select("t1c4", "t2c4")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_composite_swapped_attributes(self, session, hs, two_sides):
+        """Each equality written right-side-first (ref: :436-451)."""
+        ldf, rdf = two_sides
+        self._indexes(hs, ldf, rdf, "cs")
+        session.enable_hyperspace()
+        q = ldf.join(
+            rdf, on=(col("t2c1") == col("t1c1")) & (col("t2c2") == col("t1c2"))
+        ).select("t1c4", "t2c4")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_repeated_predicates_dedupe(self, session, hs, two_sides):
+        """The same equality repeated must not break matching (ref: :506-521)."""
+        ldf, rdf = two_sides
+        self._indexes(hs, ldf, rdf, "cr")
+        session.enable_hyperspace()
+        q = ldf.join(
+            rdf,
+            on=(col("t1c1") == col("t2c1"))
+            & (col("t1c2") == col("t2c2"))
+            & (col("t1c1") == col("t2c1")),
+        ).select("t1c4", "t2c4")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_no_one_to_one_mapping_rejected(self, session, hs, two_sides):
+        """t1c1 equated with BOTH t2c1 and t2c3: not a one-to-one attribute
+        mapping -> no rewrite (ref: :452-505)."""
+        ldf, rdf = two_sides
+        self._indexes(hs, ldf, rdf, "cm")
+        session.enable_hyperspace()
+        q = ldf.join(
+            rdf, on=(col("t1c1") == col("t2c1")) & (col("t1c1") == col("t2c3"))
+        ).select("t1c4", "t2c4")
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_subset_key_join_not_served_by_composite_index(self, session, hs, two_sides):
+        """A single-key join cannot use a two-key bucketed index (bucketing
+        hashes both columns; ref: JoinColumnFilter indexed == join cols)."""
+        ldf, rdf = two_sides
+        self._indexes(hs, ldf, rdf, "ss")
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=col("t1c1") == col("t2c1")).select("t1c4", "t2c4")
+        assert len(scans(q)) == 0, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+
+class TestCaseAndSelfJoin:
+    def test_case_insensitive_key_matching(self, session, hs, two_sides):
+        """(ref: JoinIndexRuleTest:130-141)"""
+        ldf, rdf = two_sides
+        hs.create_index(ldf, hst.CoveringIndexConfig("ciL", ["T1C1"], ["t1c4"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("ciR", ["T2C1"], ["t2c4"]))
+        session.enable_hyperspace()
+        q = ldf.join(rdf, on=col("t1c1") == col("T2C1")).select("t1c4", "t2c4")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_self_join_uses_same_index_twice(self, session, hs, two_sides):
+        ldf, _ = two_sides
+        hs.create_index(ldf, hst.CoveringIndexConfig("selfI", ["t1c1"], ["t1c4"]))
+        session.enable_hyperspace()
+        q = ldf.join(ldf, on=col("t1c1") == col("t1c1")).select("t1c4", "t1c4#r")
+        assert len(scans(q)) == 2, q.optimized_plan().pretty()
+        check_answer(session, q)
