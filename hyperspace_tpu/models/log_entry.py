@@ -206,15 +206,14 @@ class Content:
     @classmethod
     def from_directory(cls, path: str, tracker: Optional["FileIdTracker"] = None) -> "Content":
         """Scan ``path`` recursively, assigning ids via ``tracker``."""
+        from hyperspace_tpu.utils.file_utils import walk_data_files
+
         infos: List[FileInfo] = []
-        for root_dir, _dirs, names in os.walk(path):
-            for name in names:
-                if name.startswith(".") or name.startswith("_"):
-                    continue
-                fi = FileInfo.from_path(os.path.join(root_dir, name))
-                if tracker is not None:
-                    fi.file_id = tracker.add_file(fi)
-                infos.append(fi)
+        for fpath in walk_data_files(path):
+            fi = FileInfo.from_path(fpath)
+            if tracker is not None:
+                fi.file_id = tracker.add_file(fi)
+            infos.append(fi)
         if not infos:
             # Represent an empty content tree rooted at path itself.
             return cls(Directory.from_leaf_files([]))

@@ -26,7 +26,16 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-_initialized = False
+#: True only when THIS module called jax.distributed.initialize — shutdown()
+#: must never tear down a runtime a launcher owns
+_owns_runtime = False
+
+
+def configured_from_env() -> bool:
+    """True when the env explicitly configures a multi-process world (both
+    HS_NUM_PROCESSES > 1 and HS_PROCESS_ID set)."""
+    n = _int_env("HS_NUM_PROCESSES")
+    return n is not None and n > 1 and _int_env("HS_PROCESS_ID") is not None
 
 
 def initialize_from_env(
@@ -36,20 +45,15 @@ def initialize_from_env(
 ) -> bool:
     """Initialize the multi-process JAX runtime from env/kwargs.
 
-    Returns True if ``jax.distributed.initialize`` ran, False when no
-    multi-process configuration is present (single-process mode: a no-op so
-    the same entry point works everywhere). Idempotent."""
-    global _initialized
-    if _initialized:
+    Returns True when a multi-process runtime is up after the call — whether
+    this call started it, a previous one did, or a launcher initialized
+    jax.distributed itself; False in single-process mode. Idempotent."""
+    global _owns_runtime
+    if _owns_runtime or _jax_runtime_up():
         return True
     num_processes = num_processes if num_processes is not None else _int_env("HS_NUM_PROCESSES")
     if num_processes is None or num_processes <= 1:
         return False
-    if _jax_runtime_up():
-        # a launcher already called jax.distributed.initialize() itself
-        # (e.g. the no-argument TPU-pod path); don't initialize twice
-        _initialized = True
-        return True
     process_id = process_id if process_id is not None else _int_env("HS_PROCESS_ID")
     if process_id is None:
         raise ValueError("HS_PROCESS_ID must be set when HS_NUM_PROCESSES > 1")
@@ -62,7 +66,7 @@ def initialize_from_env(
         num_processes=num_processes,
         process_id=process_id,
     )
-    _initialized = True
+    _owns_runtime = True
     return True
 
 
@@ -76,12 +80,13 @@ def _jax_runtime_up() -> bool:
 
 
 def shutdown() -> None:
-    global _initialized
-    if _initialized:
+    """Tear down the runtime — only if this module started it."""
+    global _owns_runtime
+    if _owns_runtime:
         import jax
 
         jax.distributed.shutdown()
-        _initialized = False
+        _owns_runtime = False
 
 
 def _int_env(name: str) -> Optional[int]:

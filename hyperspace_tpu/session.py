@@ -20,11 +20,14 @@ from hyperspace_tpu.sources.manager import FileBasedSourceProviderManager
 
 class Session:
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
-        # multi-process runtimes (HS_NUM_PROCESSES et al.) come up before any
-        # device is touched; a no-op in single-process mode (SURVEY §5.8)
-        from hyperspace_tpu.parallel.distributed import initialize_from_env
+        # multi-process runtimes come up before any device is touched —
+        # ONLY when the env explicitly configures one (both HS_NUM_PROCESSES
+        # and HS_PROCESS_ID), so Session() stays side-effect-free otherwise
+        # (SURVEY §5.8)
+        from hyperspace_tpu.parallel.distributed import configured_from_env, initialize_from_env
 
-        initialize_from_env()
+        if configured_from_env():
+            initialize_from_env()
         self.conf = HyperspaceConf(conf)
         self.provider_manager = FileBasedSourceProviderManager(self)
         self.hyperspace_enabled = False

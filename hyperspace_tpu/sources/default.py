@@ -34,13 +34,9 @@ from hyperspace_tpu.sources.formats import (
 
 
 def _list_data_files(root: str) -> List[str]:
-    out = []
-    for dirpath, _dirs, names in os.walk(root):
-        for n in sorted(names):
-            if n.startswith(".") or n.startswith("_"):
-                continue
-            out.append(os.path.join(dirpath, n))
-    return sorted(out)
+    from hyperspace_tpu.utils.file_utils import walk_data_files
+
+    return sorted(walk_data_files(root))
 
 
 class DefaultFileBasedRelation(FileBasedRelation):

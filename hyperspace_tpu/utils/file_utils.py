@@ -64,6 +64,20 @@ def write_atomic(path: PathLike, data: bytes) -> None:
         raise
 
 
+def walk_data_files(root: PathLike):
+    """Yield data-file paths under ``root``, excluding hidden/meta entries
+    (dot- or underscore-prefixed) at ANY depth — files and whole directories
+    alike. The one DataPathFilter used by source listing and index-content
+    scans (ref: HS/util/PathUtils.scala:33-39 DataPathFilter)."""
+    import os
+
+    for dirpath, dirs, names in os.walk(str(root)):
+        dirs[:] = [d for d in dirs if not d.startswith((".", "_"))]
+        for n in sorted(names):
+            if not n.startswith((".", "_")):
+                yield os.path.join(dirpath, n)
+
+
 def delete_recursively(path: PathLike) -> None:
     path = Path(path)
     if path.is_dir():

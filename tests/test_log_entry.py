@@ -50,6 +50,89 @@ class TestContentTree:
         assert out == files
         assert out[0].size == 11 and out[0].modified_time == 22 and out[0].file_id == 3
 
+    def test_from_directory_empty_or_nonexistent(self, tmp_path):
+        """(ref: IndexLogEntryTest:363-384 'fromDirectory where the directory
+        is empty or nonexistent')"""
+        from hyperspace_tpu.models.log_entry import Content
+
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        c1 = Content.from_directory(str(empty))
+        assert c1.files == [] and c1.total_size == 0
+        c2 = Content.from_directory(str(tmp_path / "nope"))
+        assert c2.files == []
+
+    def test_from_leaf_files_gap_in_directories(self):
+        """A file under a/b/c with no files in a or a/b keeps the full path
+        (ref: IndexLogEntryTest:442-527 'gap in directories')."""
+        from hyperspace_tpu.models.log_entry import Content, FileInfo
+
+        c = Content.from_leaf_files(
+            [
+                FileInfo("/a/b/c/f1.parquet", 10, 1, 0),
+                FileInfo("/a/g.parquet", 20, 2, 1),
+            ]
+        )
+        assert sorted(c.files) == ["/a/b/c/f1.parquet", "/a/g.parquet"]
+        infos = {f.name: f for f in c.file_infos()}
+        assert infos["/a/b/c/f1.parquet"].size == 10
+        assert infos["/a/g.parquet"].file_id == 1
+
+    def test_from_directory_excludes_hidden_and_underscore(self, tmp_path):
+        """PathFilter parity: dot- and underscore-prefixed entries never enter
+        the tree (ref: IndexLogEntryTest:385-441 pathfilter)."""
+        from hyperspace_tpu.models.log_entry import Content
+
+        d = tmp_path / "pf"
+        d.mkdir()
+        (d / "ok.parquet").write_bytes(b"x" * 4)
+        (d / ".hidden").write_bytes(b"y")
+        (d / "_SUCCESS").write_bytes(b"")
+        (d / "_hyperspace_log").mkdir()
+        (d / "_hyperspace_log" / "0").write_bytes(b"{}")
+        c = Content.from_directory(str(d))
+        assert [os.path.basename(f) for f in c.files] == ["ok.parquet"]
+
+    def test_source_listing_skips_hidden_directories(self, tmp_path, session):
+        """Meta directories nested INSIDE a data dir (.cache/, _checkpoints/)
+        must not reach scans or index builds (DataPathFilter parity at the
+        source level, not just the index-content level)."""
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        d = tmp_path / "src"
+        d.mkdir()
+        pq.write_table(
+            pa.table({"k": np.arange(10, dtype=np.int64)}), d / "ok.parquet"
+        )
+        (d / ".cache").mkdir()
+        (d / ".cache" / "x.parquet").write_bytes(b"junk")
+        (d / "_chk").mkdir()
+        (d / "_chk" / "0").write_bytes(b"junk")
+        df = session.read_parquet(str(d))
+        files = [fi.name for fi in df.plan.relation.all_file_infos()]
+        assert [os.path.basename(f) for f in files] == ["ok.parquet"]
+        assert df.collect()["k"].shape[0] == 10
+
+    def test_merge_overlapping_directories(self):
+        """(ref: IndexLogEntryTest:566-620 'merge works as expected when
+        directories overlap')"""
+        from hyperspace_tpu.models.log_entry import Content, FileInfo
+
+        a = Content.from_leaf_files(
+            [FileInfo("/r/x/f1", 1, 1, 0), FileInfo("/r/y/f2", 2, 2, 1)]
+        )
+        b = Content.from_leaf_files(
+            [FileInfo("/r/x/f3", 3, 3, 2), FileInfo("/r/z/f4", 4, 4, 3)]
+        )
+        m = a.merge(b)
+        assert sorted(m.files) == ["/r/x/f1", "/r/x/f3", "/r/y/f2", "/r/z/f4"]
+        assert m.total_size == 10
+        # a file present in BOTH trees is kept once
+        m2 = a.merge(a)
+        assert sorted(m2.files) == sorted(a.files)
+
     def test_merge_unions_files(self):
         a = Content.from_leaf_files([fi("/d/x/1"), fi("/d/x/2")])
         b = Content.from_leaf_files([fi("/d/x/2"), fi("/d/y/3")])
