@@ -1,0 +1,136 @@
+"""Sketch-evaluator predicate matrix — the unit-level extractor semantics the
+reference pins in its 946-line ExtractorsTest
+(ref: src/test/scala/com/microsoft/hyperspace/index/dataskipping/util/ExtractorsTest.scala):
+literal-on-either-side comparisons, NOT push-down, IN, AND/OR composition,
+null-aggregate handling, and unprunable shapes."""
+
+import numpy as np
+
+from hyperspace_tpu.indexes.dataskipping import MinMaxSketch, ValueListSketch
+from hyperspace_tpu.plan.expr import BinaryOp, Not, col, lit
+from hyperspace_tpu.rules.dataskipping_rule import _SketchEvaluator
+
+
+def evaluator():
+    """Three files with k ranges [0,10], [20,30], [40,50] (file 1 constant 25
+    variant is built by tests that need it)."""
+    s = MinMaxSketch("k")
+    mn, mx = s.output_names()
+    cols = {mn: np.array([0, 20, 40]), mx: np.array([10, 30, 50])}
+    return _SketchEvaluator([s], cols, 3), s
+
+
+class TestComparisonExtraction:
+    def test_equality_both_literal_sides(self):
+        ev, _ = evaluator()
+        assert ev.eval(col("k") == 25).tolist() == [False, True, False]
+        # literal on the LEFT flips the operator (EqualToExtractor's lit-expr arm)
+        assert ev.eval(lit(25) == col("k")).tolist() == [False, True, False]
+
+    def test_less_than_both_literal_sides(self):
+        ev, _ = evaluator()
+        assert ev.eval(col("k") < 15).tolist() == [True, False, False]
+        # 15 > k is the same predicate written literal-first
+        assert ev.eval(lit(15) > col("k")).tolist() == [True, False, False]
+
+    def test_greater_equal_boundary(self):
+        ev, _ = evaluator()
+        assert ev.eval(col("k") >= 30).tolist() == [False, True, True]
+        assert ev.eval(col("k") > 30).tolist() == [False, False, True]
+
+    def test_not_equal_prunes_constant_files_only(self):
+        s = MinMaxSketch("k")
+        mn, mx = s.output_names()
+        # file 1 holds ONLY the value 25 (min == max == 25)
+        ev = _SketchEvaluator([s], {mn: np.array([0, 25, 40]), mx: np.array([10, 25, 50])}, 3)
+        assert ev.eval(col("k") != 25).tolist() == [True, False, True]
+
+    def test_not_pushes_through_comparisons(self):
+        ev, _ = evaluator()
+        # NOT(k < 15) == k >= 15: file 0 spans [0,10] -> prunable
+        assert ev.eval(~(col("k") < 15)).tolist() == [False, True, True]
+        assert ev.eval(~(col("k") == 25)).tolist() == [True, True, True]  # ranges, not constants
+
+    def test_col_vs_col_unprunable(self):
+        ev, _ = evaluator()
+        assert ev.eval(col("k") == col("k")) is None
+
+    def test_unknown_column_unprunable(self):
+        ev, _ = evaluator()
+        assert ev.eval(col("z") == 1) is None
+
+    def test_arithmetic_unprunable(self):
+        ev, _ = evaluator()
+        assert ev.eval((col("k") + 1) == 25) is None
+
+
+class TestComposition:
+    def test_and_intersects_or_falls_back_per_side(self):
+        ev, _ = evaluator()
+        m = ev.eval((col("k") >= 15) & (col("k") <= 35))
+        assert m.tolist() == [False, True, False]
+        # AND with an unprunable side keeps the prunable side's mask
+        m2 = ev.eval((col("k") >= 15) & (col("z") == 1))
+        assert m2.tolist() == [False, True, True]
+
+    def test_or_requires_both_sides_prunable(self):
+        ev, _ = evaluator()
+        m = ev.eval((col("k") < 5) | (col("k") > 45))
+        assert m.tolist() == [True, False, True]
+        assert ev.eval((col("k") < 5) | (col("z") == 1)) is None
+
+    def test_in_unions_membership(self):
+        ev, _ = evaluator()
+        m = ev.eval(col("k").isin(5, 45))
+        assert m.tolist() == [True, False, True]
+
+    def test_between_via_and(self):
+        ev, _ = evaluator()
+        m = ev.eval((col("k") >= 22) & (col("k") <= 28))
+        assert m.tolist() == [False, True, False]
+
+
+class TestNullAggregates:
+    def test_all_null_file_always_kept(self):
+        s = MinMaxSketch("k")
+        mn, mx = s.output_names()
+        ev = _SketchEvaluator(
+            [s],
+            {mn: np.array([0.0, np.nan, 40.0]), mx: np.array([10.0, np.nan, 50.0])},
+            3,
+        )
+        # the NaN-aggregate file (all-null column values) survives everything
+        assert ev.eval(col("k") == 5).tolist() == [True, True, False]
+        assert ev.eval(col("k") > 100).tolist() == [False, True, False]
+
+
+class TestMultipleSketches:
+    def test_sketches_on_same_column_intersect(self):
+        mmx = MinMaxSketch("k")
+        vls = ValueListSketch("k")
+        mn, mx = mmx.output_names()
+        (vname,) = vls.output_names()
+        cols = {
+            mn: np.array([0, 20]),
+            mx: np.array([10, 30]),
+            # file 0's actual values are only {2, 4}: the value list refutes
+            # k = 5 even though the min/max range [0,10] cannot
+            vname: np.array([np.array([2, 4]), np.array([25])], dtype=object),
+        }
+        ev = _SketchEvaluator([mmx, vls], cols, 2)
+        assert ev.eval(col("k") == 5).tolist() == [False, False]
+        assert ev.eval(col("k") == 2).tolist() == [True, False]
+
+    def test_overflowed_value_list_keeps_file(self):
+        vls = ValueListSketch("k")
+        (vname,) = vls.output_names()
+        ev = _SketchEvaluator([vls], {vname: np.array([None, np.array([7])], dtype=object)}, 2)
+        # file 0's list overflowed (None): must stay
+        assert ev.eval(col("k") == 7).tolist() == [True, True]
+        assert ev.eval(col("k") == 8).tolist() == [True, False]
+
+    def test_incomparable_literal_is_unprunable_not_an_error(self):
+        ev, _ = evaluator()
+        assert ev.eval(col("k") == "not-a-number") is None or isinstance(
+            ev.eval(col("k") == "not-a-number"), np.ndarray
+        )
