@@ -367,6 +367,60 @@ class TestRefreshModeSourceMatrix:
         )
 
 
+class TestSignatureInvalidation:
+    """FileSignatureFilter behaviors (ref: CandidateIndexCollectorTest:89-303,
+    FileSignatureFilter.scala:33-192): any change to the source fileset
+    disqualifies a stale index outside hybrid scan, and a refresh
+    re-qualifies it."""
+
+    def test_in_place_rewrite_disqualifies(self, session, hs, tmp_path):
+        d = tmp_path / "sig1"
+        d.mkdir()
+        write_sample(str(d))
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("sigIdx", ["Query"], ["imprs"]))
+        session.enable_hyperspace()
+        q0 = df.filter(hst.col("Query") == "q1").select("imprs")
+        assert index_scans(q0)
+        # rewrite the SAME file name with different content
+        write_sample(str(d), seed=99)
+        df2 = session.read_parquet(str(d))
+        q = df2.filter(hst.col("Query") == "q1").select("imprs")
+        assert not index_scans(q), q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_deleted_file_without_lineage_disqualifies(self, session, hs, tmp_path):
+        d = tmp_path / "sig2"
+        d.mkdir()
+        write_sample(str(d), seed=1)
+        write_sample(str(d), seed=2, start=1)
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("sigDel", ["Query"], ["imprs"]))
+        os.remove(d / "part-00001.parquet")
+        session.enable_hyperspace()
+        df2 = session.read_parquet(str(d))
+        q = df2.filter(hst.col("Query") == "q1").select("imprs")
+        # no lineage: the index cannot subtract the deleted file's rows
+        assert not index_scans(q), q.optimized_plan().pretty()
+        check_answer(session, q)
+
+    def test_full_refresh_requalifies(self, session, hs, tmp_path):
+        d = tmp_path / "sig3"
+        d.mkdir()
+        write_sample(str(d), seed=3)
+        df = session.read_parquet(str(d))
+        hs.create_index(df, hst.CoveringIndexConfig("sigRe", ["Query"], ["imprs"]))
+        write_sample(str(d), seed=4, start=1)  # append -> stale
+        session.enable_hyperspace()
+        df2 = session.read_parquet(str(d))
+        q = df2.filter(hst.col("Query") == "q1").select("imprs")
+        assert not index_scans(q)
+        hs.refresh_index("sigRe", "full")
+        q2 = session.read_parquet(str(d)).filter(hst.col("Query") == "q1").select("imprs")
+        assert index_scans(q2), q2.optimized_plan().pretty()
+        check_answer(session, q2)
+
+
 class TestUnsupportedIndexes:
     """Rules skip indexes of other kinds (ref: E2EHyperspaceRulesTest:1008-1023)."""
 
