@@ -162,6 +162,114 @@ def _prune_partitions(scan: L.Scan, condition) -> Optional[List[str]]:
     return [f for f, keep in zip(files, mask) if keep]
 
 
+def _key_codes(arr: np.ndarray, asc: bool) -> np.ndarray:
+    """Per-row int64 sort codes for one key column: rank by value (negated
+    for descending), missing values (NaN/NaT/None) last in BOTH directions.
+    The single ordering definition shared by the Sort node and windows."""
+    n = arr.shape[0]
+    if arr.dtype == object:
+        missing = np.array(
+            [v is None or (isinstance(v, float) and v != v) for v in arr], dtype=bool
+        )
+        conv = np.where(missing, "", arr.astype(str))
+    elif arr.dtype.kind == "f":
+        missing = np.isnan(arr)
+        conv = np.where(missing, 0.0, arr)
+    elif arr.dtype.kind == "M":
+        missing = np.isnat(arr)
+        fill = arr[~missing][0] if (~missing).any() else arr
+        conv = np.where(missing, fill, arr)
+    else:
+        missing = np.zeros(n, dtype=bool)
+        conv = arr
+    _, codes = np.unique(conv, return_inverse=True)
+    keyvals = (codes if asc else -codes).astype(np.int64)
+    keyvals[missing] = np.iinfo(np.int64).max
+    return keyvals
+
+
+def _order_codes(child: B.Batch, keys) -> np.ndarray:
+    """One int64 composite code per row whose ordering equals the
+    lexicographic (column, ascending) ordering — equal tuples share a code."""
+    n = B.num_rows(child)
+    per_key = [_key_codes(child[name], asc) for name, asc in keys]
+    # composite: lexsort, then bump a counter at each tuple change
+    sort_order = np.lexsort(per_key[::-1])
+    changed = np.zeros(n, dtype=bool)
+    if n:
+        changed[0] = False
+        for kv in per_key:
+            s = kv[sort_order]
+            changed[1:] |= s[1:] != s[:-1]
+    composite = np.cumsum(changed)
+    out = np.empty(n, dtype=np.int64)
+    out[sort_order] = composite
+    return out
+
+
+def _window_column(child: B.Batch, spec, caches=None) -> np.ndarray:
+    """Evaluate one window spec over the batch (pandas per-partition ops).
+    ``caches`` memoizes partition ngroups and order codes across the sibling
+    specs of one Window node (q47/q57 compute several windows over the same
+    keys)."""
+    import pandas as pd
+
+    part_cache, codes_cache = caches if caches is not None else ({}, {})
+    out_name, fn, arg, pcols, orders, cumulative = spec
+    n = B.num_rows(child)
+    # one int per row identifying its partition
+    part = part_cache.get(tuple(pcols))
+    if part is None:
+        if pcols:
+            part = pd.DataFrame({c: child[c] for c in pcols}).groupby(
+                list(pcols), dropna=False, sort=False
+            ).ngroup().to_numpy()
+        else:
+            part = np.zeros(n, dtype=np.int64)
+        part_cache[tuple(pcols)] = part
+
+    def order_codes():
+        key = tuple(orders)
+        got = codes_cache.get(key)
+        if got is None:
+            got = codes_cache[key] = _order_codes(child, orders)
+        return got
+
+    if fn in ("rank", "dense_rank", "row_number"):
+        method = {"rank": "min", "dense_rank": "dense", "row_number": "first"}[fn]
+        s = pd.Series(order_codes())
+        return s.groupby(part).rank(method=method).astype(np.int64).to_numpy()
+
+    pd_fn = {"sum": "sum", "min": "min", "max": "max", "avg": "mean", "count": "count"}[fn]
+    vals = pd.Series(child[arg]) if arg is not None else pd.Series(np.ones(n, dtype=np.int64))
+    if cumulative and orders:
+        # explicit ROWS UNBOUNDED PRECEDING .. CURRENT ROW
+        codes = order_codes()
+        pos = np.lexsort((np.arange(n), part, codes))
+        inv = np.empty(n, dtype=np.int64)
+        inv[pos] = np.arange(n)
+        sv = vals.iloc[pos].reset_index(drop=True)
+        sp = part[pos]
+        if fn == "count":
+            cum = sv.notna().groupby(sp).cumsum()
+        elif fn == "sum":
+            cum = sv.groupby(sp).cumsum()
+        else:
+            # expanding() emits rows grouped by partition: drop the group
+            # level and sort back to sv's positional order before inverting
+            cum = (
+                sv.groupby(sp)
+                .expanding()
+                .agg(pd_fn)
+                .reset_index(level=0, drop=True)
+                .sort_index()
+            )
+        return np.asarray(cum)[inv]
+    if fn == "count" and arg is None:
+        return pd.Series(np.ones(n, dtype=np.int64)).groupby(part).transform("size").to_numpy()
+    return vals.groupby(part).transform(pd_fn).to_numpy()
+
+
 class Executor:
     def __init__(self, session):
         self.session = session
@@ -297,27 +405,21 @@ class Executor:
                 arr = get_column(child, name)
                 if arr is None:
                     raise KeyError(f"Sort key {name!r} not found")
-                arr = arr[order]
-                if arr.dtype == object:
-                    missing = np.array(
-                        [v is None or (isinstance(v, float) and v != v) for v in arr], dtype=bool
-                    )
-                    conv = np.where(missing, "", arr.astype(str))
-                elif arr.dtype.kind == "f":
-                    missing = np.isnan(arr)
-                    conv = np.where(missing, 0.0, arr)
-                else:
-                    missing = np.zeros(arr.shape[0], dtype=bool)
-                    conv = arr
-                _, codes = np.unique(conv, return_inverse=True)
-                keyvals = (codes if asc else -codes).astype(np.int64)
-                keyvals[missing] = np.iinfo(np.int64).max
+                keyvals = _key_codes(arr[order], asc)
                 order = order[np.argsort(keyvals, kind="stable")]
             return {k: v[order] for k, v in child.items()}
 
         if isinstance(plan, L.Limit):
             child = self._exec(plan.child, with_file_names)
             return {k: v[: plan.n] for k, v in child.items()}
+
+        if isinstance(plan, L.Window):
+            child = self._exec(plan.child, with_file_names)
+            out = dict(child)
+            caches = ({}, {})  # partition ngroups / order codes, shared by specs
+            for spec in plan.specs:
+                out[spec[0]] = _window_column(child, spec, caches)
+            return out
 
         if isinstance(plan, L.Rename):
             child = self._exec(plan.child, with_file_names)

@@ -359,6 +359,54 @@ class Aggregate(LogicalPlan):
         return f"Aggregate(keys={self.keys}, [{', '.join(parts)}])"
 
 
+class Window(LogicalPlan):
+    """Window functions: appends one column per spec, preserving row count
+    and order. Each spec is (out_name, fn, arg_col_or_None, partition_cols,
+    order_keys, cumulative) with fn in rank/dense_rank/row_number/
+    count/sum/min/max/avg; ``order_keys`` are (column, ascending) pairs;
+    ``cumulative`` marks an explicit ROWS UNBOUNDED PRECEDING..CURRENT ROW
+    frame for aggregate fns. (The reference delegates windows to Spark; the
+    TPC-DS q12/q47/q51/q53-family shapes drive this surface.)"""
+
+    FNS = ("rank", "dense_rank", "row_number", "count", "sum", "min", "max", "avg")
+
+    def __init__(self, specs: List[tuple], child: LogicalPlan):
+        taken = set(child.output_columns)
+        for spec in specs:
+            out, fn, arg, parts, orders, cumulative = spec
+            if fn not in self.FNS:
+                raise ValueError(f"Unsupported window fn {fn!r}; one of {self.FNS}")
+            if out in taken:
+                raise ValueError(f"Window output {out!r} collides with an existing column")
+            taken.add(out)
+        self.specs = [tuple(s) for s in specs]
+        self.child = child
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.child,)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.child.output_columns + [s[0] for s in self.specs]
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "Window":
+        (child,) = children
+        return Window(self.specs, child)
+
+    def describe(self) -> str:
+        parts = []
+        for out, fn, arg, pcols, orders, cumulative in self.specs:
+            over = []
+            if pcols:
+                over.append(f"partition by {list(pcols)}")
+            if orders:
+                over.append(f"order by {list(orders)}")
+            if cumulative:
+                over.append("rows unbounded preceding")
+            parts.append(f"{out}={fn}({arg or ''}) over ({', '.join(over)})")
+        return f"Window({'; '.join(parts)})"
+
+
 class Rename(LogicalPlan):
     """Column renaming (SQL ``AS`` aliases). Purely cosmetic at the top of a
     plan: data and row order pass through, only names change (the reference

@@ -78,6 +78,13 @@ _KEYWORDS = {
     "union", "all", "exists", "interval", "cast", "over", "rollup",
 }
 
+#: OVER-clause words matched contextually (NOT reserved: a column named
+#: "partition" or "row" stays a valid identifier everywhere else)
+_OVER_WORDS = {"partition", "rows", "unbounded", "preceding", "current", "row"}
+
+#: window-only function names (tokenize as plain identifiers)
+_WINDOW_FNS = {"rank", "dense_rank", "row_number"}
+
 # aggregate functions that tokenize as plain identifiers (not keywords)
 _IDENT_AGGS = {"stddev_samp": "stddev_samp", "stddev": "stddev_samp"}
 
@@ -190,6 +197,31 @@ class _AggCall(Expr):
 
     def __repr__(self) -> str:
         return f"{self.fn}({self.text})"
+
+
+class _WindowCall(Expr):
+    """Parse-time window-function marker (``fn(arg) OVER (...)``);
+    plan_query replaces it with a reference to a Window node output."""
+
+    def __init__(self, fn: str, arg: Optional[Expr], partition, orders, cumulative: bool, text: str):
+        self.fn = fn
+        self.arg = arg
+        self.partition = list(partition)  # List[Expr]
+        self.orders = list(orders)  # List[(Expr, asc)]
+        self.cumulative = cumulative
+        self.text = text
+
+    def children(self) -> Sequence[Expr]:
+        out = list(self.partition) + [e for e, _ in self.orders]
+        if self.arg is not None:
+            out.append(self.arg)
+        return tuple(out)
+
+    def eval(self, batch):
+        raise SqlError(f"Unplanned window function {self.fn}()")
+
+    def __repr__(self) -> str:
+        return f"{self.fn}({self.text}) over (...)"
 
 
 class _SubquerySelect(Expr):
@@ -592,9 +624,62 @@ def _parse_term(p: _Parser) -> Expr:
         e = {"*": e * rhs, "/": e / rhs, "%": e % rhs}[op]
 
 
-def _no_window(p: _Parser) -> None:
+def _accept_word(p: _Parser, word: str) -> bool:
+    """Accept a contextual (non-reserved) word, whatever its token kind."""
+    t = p.peek()
+    if t is not None and t[0] in ("ident", "kw") and t[1].lower() == word:
+        p.i += 1
+        return True
+    return False
+
+
+def _expect_word(p: _Parser, word: str) -> None:
+    if not _accept_word(p, word):
+        raise SqlError(f"Expected {word.upper()} at {p._where()}")
+
+
+def _parse_over(p: _Parser):
+    """The OVER clause: ([PARTITION BY ...] [ORDER BY ...] [ROWS BETWEEN
+    UNBOUNDED PRECEDING AND CURRENT ROW]). Any other frame spec errors."""
+    p.expect_kw("over")
+    p.expect_op("(")
+    partition, orders, cumulative = [], [], False
+    if _accept_word(p, "partition"):
+        p.expect_kw("by")
+        partition.append(_parse_sum(p))
+        while p.accept_op(","):
+            partition.append(_parse_sum(p))
+    if p.accept_kw("order"):
+        p.expect_kw("by")
+
+        def item():
+            e = _parse_sum(p)
+            if p.accept_kw("desc"):
+                return (e, False)
+            p.accept_kw("asc")
+            return (e, True)
+
+        orders.append(item())
+        while p.accept_op(","):
+            orders.append(item())
+    if _accept_word(p, "rows"):
+        p.expect_kw("between")
+        _expect_word(p, "unbounded")
+        _expect_word(p, "preceding")
+        p.expect_kw("and")
+        _expect_word(p, "current")
+        _expect_word(p, "row")
+        cumulative = True
+    p.expect_op(")")
+    return partition, orders, cumulative
+
+
+def _maybe_window(p: _Parser, fn: str, arg: Optional[Expr], text: str) -> Expr:
+    """An aggregate call becomes a window function when OVER follows."""
     if p.peek() == ("kw", "over"):
-        raise SqlError("Window functions (OVER ...) are not supported")
+        partition, orders, cumulative = _parse_over(p)
+        return _WindowCall(fn, arg, partition, orders, cumulative, text)
+    return _AggCall(fn, arg, text)
 
 
 def _parse_factor(p: _Parser) -> Expr:
@@ -624,14 +709,12 @@ def _parse_factor(p: _Parser) -> Expr:
             if fn != "count":
                 raise SqlError(f"{fn.upper()}(*) is not valid")
             p.expect_op(")")
-            _no_window(p)
-            return _AggCall(fn, None, "*")
+            return _maybe_window(p, fn, None, "*")
         start = p.i
         arg = _parse_sum(p)
         text = p.text_since(start)
         p.expect_op(")")
-        _no_window(p)
-        return _AggCall(fn, arg, text)
+        return _maybe_window(p, fn, arg, text)
     if t == ("kw", "case"):
         p.i += 1
         return _parse_case(p)
@@ -663,13 +746,22 @@ def _parse_factor(p: _Parser) -> Expr:
     if t[0] == "ident" and "." not in t[1] and p.peek(1) == ("op", "("):
         name = p.next()[1]
         p.expect_op("(")
+        if name.lower() in _WINDOW_FNS:
+            p.expect_op(")")
+            if p.peek() != ("kw", "over"):
+                raise SqlError(f"{name}() requires an OVER clause")
+            partition, orders, cumulative = _parse_over(p)
+            if not orders:
+                raise SqlError(f"{name}() requires ORDER BY in its OVER clause")
+            return _WindowCall(name.lower(), None, partition, orders, cumulative, "")
         agg = _IDENT_AGGS.get(name.lower())
         if agg is not None:
             start = p.i
             arg = _parse_sum(p)
             text = p.text_since(start)
             p.expect_op(")")
-            _no_window(p)
+            if p.peek() == ("kw", "over"):
+                raise SqlError(f"{name}() window form is not supported")
             return _AggCall(agg, arg, text)
         args: List[Expr] = []
         if p.accept_op(")") is None:
@@ -677,7 +769,8 @@ def _parse_factor(p: _Parser) -> Expr:
             while p.accept_op(","):
                 args.append(_parse_or(p))
             p.expect_op(")")
-        _no_window(p)
+        if p.peek() == ("kw", "over"):
+            raise SqlError(f"Window function {name}() is not supported")
         try:
             return Func(name, args)
         except ValueError as e:
@@ -761,6 +854,15 @@ def _rewrite(e: Expr, mapping: Dict[str, str]) -> Expr:
         return Col(mapping.get(e.name, e.name))
     if isinstance(e, _AggCall):
         return _AggCall(e.fn, _rewrite(e.arg, mapping) if e.arg is not None else None, e.text)
+    if isinstance(e, _WindowCall):
+        return _WindowCall(
+            e.fn,
+            _rewrite(e.arg, mapping) if e.arg is not None else None,
+            [_rewrite(x, mapping) for x in e.partition],
+            [(_rewrite(x, mapping), asc) for x, asc in e.orders],
+            e.cumulative,
+            e.text,
+        )
     if isinstance(e, _InQuery):
         return _InQuery(_rewrite(e.child, mapping), e.query)
     if isinstance(e, BinaryOp):
@@ -811,6 +913,15 @@ def _bind_subqueries(e: Expr, views, session) -> Expr:
     if isinstance(e, _AggCall):
         return _AggCall(
             e.fn, _bind_subqueries(e.arg, views, session) if e.arg is not None else None, e.text
+        )
+    if isinstance(e, _WindowCall):
+        return _WindowCall(
+            e.fn,
+            _bind_subqueries(e.arg, views, session) if e.arg is not None else None,
+            [_bind_subqueries(x, views, session) for x in e.partition],
+            [(_bind_subqueries(x, views, session), asc) for x, asc in e.orders],
+            e.cumulative,
+            e.text,
         )
     if isinstance(e, BinaryOp):
         return BinaryOp(
@@ -949,6 +1060,8 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
                 raise SqlError(
                     f"Aggregate {x.fn.upper()}() is not allowed in WHERE; use HAVING"
                 )
+            if isinstance(x, _WindowCall):
+                raise SqlError("Window functions are not allowed in WHERE")
         df = df.filter(where)
 
     if q.items is None and any(c.startswith("__cross") for c in df.plan.output_columns):
@@ -977,13 +1090,19 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
             q, df, prepared, having_e, resolve_ref, renames, session
         )
     elif prepared is not None:
+        exprs = [e for _, e in prepared]
+        df, exprs = _plan_windows(df, exprs, session)
+        prepared = [(it, e2) for (it, _), e2 in zip(prepared, exprs)]
         computes: List[Tuple[str, Expr]] = []
         for i, (it, e) in enumerate(prepared):
             if isinstance(e, Col):
-                name = _resolve_select_name(it.expr.name, df, alias_cols)
+                src = it.expr.name if isinstance(it.expr, Col) else e.name
+                name = _resolve_select_name(src, df, alias_cols)
                 names.append(name)
                 if it.alias:
                     renames[name] = it.alias
+                elif name.startswith("__win"):  # window item: name by text
+                    renames[name] = it.text
             else:
                 e, unknown = _case_map(e, df.plan.output_columns)
                 if unknown:
@@ -1009,6 +1128,7 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
     # them, Spark-style)
     sort_specs: List[Tuple[str, bool]] = []
     extra_sort_cols: List[str] = []
+    sort_exprs: List[Tuple[str, Expr]] = []
     if q.order_by:
         pre_cols = set(df.plan.output_columns)
         final_by_src = {n: renames.get(n, n) for n in names}
@@ -1019,9 +1139,10 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
                 item_by_text.setdefault(it_.text, renames.get(nm_, nm_))
         for name, asc in q.order_by:
             if isinstance(name, int):  # ordinal: 1-based SELECT item position
-                if not names or not (1 <= name <= len(names)):
+                positional = names if names else df.plan.output_columns  # SELECT *
+                if not (1 <= name <= len(positional)):
                     raise SqlError(f"ORDER BY position {name} is out of range")
-                nm = names[name - 1]
+                nm = positional[name - 1]
                 sort_specs.append((renames.get(nm, nm), asc))
                 continue
             if not isinstance(name, str):
@@ -1034,11 +1155,20 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
                 else:
                     txt = getattr(name, "_sql_text", repr(name))
                     target = item_by_text.get(txt)
-                    if target is None:
+                    if target is not None:
+                        sort_specs.append((target, asc))
+                        continue
+                    if any(isinstance(x, _WindowCall) for x in _walk(resolved_k)):
                         raise SqlError(
-                            f"ORDER BY expression {txt!r} must appear in the SELECT list"
+                            "Window functions in ORDER BY must appear as (or "
+                            "alias) a SELECT item"
                         )
-                    sort_specs.append((target, asc))
+                    # general expression key: computed above the renamed
+                    # frame (its references must name output columns) and
+                    # projected away after the sort
+                    internal = f"__sort{len(sort_exprs)}"
+                    sort_exprs.append((internal, resolved_k))
+                    sort_specs.append((internal, asc))
                     continue
             else:
                 n = resolve_ref(name)
@@ -1065,11 +1195,25 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
             df = DataFrame(Rename(renames, df.plan), df.session)
         except ValueError as e:  # e.g. alias collides with another column
             raise SqlError(f"Invalid AS aliases: {e}")
+    if sort_exprs:
+        final_cols = set(df.plan.output_columns)
+        for i_, (n_, e_) in enumerate(sort_exprs):
+            e2, unknown = _case_map(e_, df.plan.output_columns)
+            if unknown:
+                raise SqlError(
+                    f"ORDER BY expression references unknown columns {unknown} "
+                    f"among {sorted(final_cols)}"
+                )
+            sort_exprs[i_] = (n_, e2)
+        df = DataFrame(Compute(sort_exprs, df.plan), df.session)
     if sort_specs:
         df = df.order_by(*[n for n, _ in sort_specs], ascending=[a for _, a in sort_specs])
-    if extra_sort_cols:
-        final = [renames.get(n, n) for n in names]
-        df = df.select(*final)
+    if extra_sort_cols or sort_exprs:
+        if names:
+            final = [renames.get(n, n) for n in names]
+            df = df.select(*final)
+        else:
+            df = df.select(*[c for c in df.plan.output_columns if not c.startswith("__sort")])
     if q.limit is not None:
         df = df.limit(q.limit)
     return df
@@ -1265,6 +1409,73 @@ def _equi_link(term: Expr, alias_cols, left_df, right_frame, right_aliases):
     return None
 
 
+def _plan_windows(df, item_exprs, session):
+    """Collect _WindowCall nodes from the item expressions, append ONE Window
+    node computing them over ``df``, and return (df, substituted exprs).
+    Window operands (argument, partition, order keys) must resolve to columns
+    of ``df`` — expressions are pre-reduced by the caller (aggregate calls
+    already replaced by their output columns)."""
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Window
+
+    cols_ = df.plan.output_columns
+    lowered = {c.lower(): c for c in cols_}
+
+    def operand(e, what):
+        if isinstance(e, Col):
+            got = e.name if e.name in cols_ else lowered.get(e.name.lower())
+            if got is not None:
+                return got
+        raise SqlError(
+            f"Window {what} must be a column or aggregate of the current frame; got {e!r}"
+        )
+
+    specs, mapping = [], {}
+    for e in item_exprs:
+        for node in _walk(e):
+            if isinstance(node, _WindowCall) and id(node) not in mapping:
+                out = f"__win{len(specs)}"
+                arg = operand(node.arg, "argument") if node.arg is not None else None
+                parts = tuple(operand(x, "PARTITION BY key") for x in node.partition)
+                orders = tuple((operand(x, "ORDER BY key"), asc) for x, asc in node.orders)
+                if node.fn in ("count", "sum", "min", "max", "avg") and orders and not node.cumulative:
+                    raise SqlError(
+                        f"{node.fn}() OVER (ORDER BY ...) needs an explicit "
+                        "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW frame"
+                    )
+                specs.append((out, node.fn, arg, parts, orders, node.cumulative))
+                mapping[id(node)] = Col(out)
+    if not specs:
+        return df, item_exprs
+    df = DataFrame(Window(specs, df.plan), session)
+    return df, [_substitute_windows(e, mapping) for e in item_exprs]
+
+
+def _substitute_windows(e: Expr, mapping) -> Expr:
+    if id(e) in mapping:
+        return mapping[id(e)]
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, _substitute_windows(e.left, mapping), _substitute_windows(e.right, mapping))
+    if isinstance(e, Not):
+        return Not(_substitute_windows(e.child, mapping))
+    if isinstance(e, IsNull):
+        return IsNull(_substitute_windows(e.child, mapping))
+    if isinstance(e, In):
+        return In(_substitute_windows(e.child, mapping), list(e.values))
+    from hyperspace_tpu.plan.expr import Case, Cast, Func
+
+    if isinstance(e, Case):
+        return Case(
+            [(_substitute_windows(c, mapping), _substitute_windows(v, mapping)) for c, v in e.branches],
+            _substitute_windows(e.otherwise, mapping) if e.otherwise is not None else None,
+        )
+    if isinstance(e, Cast):
+        return Cast(_substitute_windows(e.child, mapping), e.type_name)
+    if isinstance(e, Func):
+        return Func(e.name, [_substitute_windows(a, mapping) for a in e.args])
+    return e
+
+
 def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
     """Plan the aggregate branch: pre-aggregate computes for expression
     arguments, the Aggregate node, HAVING, and post-aggregate computes for
@@ -1327,6 +1538,15 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
     def replace_aggs(e: Expr, preferred: Optional[str] = None) -> Expr:
         if isinstance(e, _AggCall):
             return Col(register(e, preferred))
+        if isinstance(e, _WindowCall):
+            return _WindowCall(
+                e.fn,
+                replace_aggs(e.arg) if e.arg is not None else None,
+                [replace_aggs(x) for x in e.partition],
+                [(replace_aggs(x), asc) for x, asc in e.orders],
+                e.cumulative,
+                e.text,
+            )
         if isinstance(e, BinaryOp):
             return BinaryOp(e.op, replace_aggs(e.left), replace_aggs(e.right))
         if isinstance(e, Not):
@@ -1335,6 +1555,17 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
             return IsNull(replace_aggs(e.child))
         if isinstance(e, In):
             return In(replace_aggs(e.child), list(e.values))
+        from hyperspace_tpu.plan.expr import Case, Cast, Func
+
+        if isinstance(e, Cast):  # cast(sum(x) AS t) must find its aggregate
+            return Cast(replace_aggs(e.child), e.type_name)
+        if isinstance(e, Case):
+            return Case(
+                [(replace_aggs(c), replace_aggs(v)) for c, v in e.branches],
+                replace_aggs(e.otherwise) if e.otherwise is not None else None,
+            )
+        if isinstance(e, Func):
+            return Func(e.name, [replace_aggs(a) for a in e.args])
         return e
 
     # first pass: items matching a GROUP BY expression's text reuse its
@@ -1393,6 +1624,8 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
             )
         df = df.filter(having)
 
+    df, item_exprs = _plan_windows(df, item_exprs, session)
+
     names: List[str] = []
     post_computes: List[Tuple[str, Expr]] = []
     for i, ((it, _), e) in enumerate(zip(prepared, item_exprs)):
@@ -1408,7 +1641,7 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
             names.append(n)
             if it.alias and it.alias != n:
                 renames[n] = it.alias
-            elif n.startswith("__gk"):  # expression group key: name by text
+            elif n.startswith(("__gk", "__win")):  # internal name: use text
                 renames[n] = it.alias or it.text
         else:
             e, unknown = _case_map(e, df.plan.output_columns)

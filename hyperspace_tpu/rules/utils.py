@@ -355,6 +355,19 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         child_needed = set(plan.keys) | {c for _, _, c in plan.aggs if c is not None}
         (child,) = plan.children()
         return plan.with_children([prune_columns(child, child_needed)])
+    if isinstance(plan, L.Window):
+        produced = {s[0] for s in plan.specs}
+        operands = set()
+        for _out, _fn, arg, parts, orders, _cum in plan.specs:
+            if arg is not None:
+                operands.add(arg)
+            operands |= set(parts)
+            operands |= {c for c, _ in orders}
+        child_needed = (
+            None if needed is None else ({c for c in needed if c not in produced} | operands)
+        )
+        (child,) = plan.children()
+        return plan.with_children([prune_columns(child, child_needed)])
     if isinstance(plan, L.Sort):
         child_needed = None if needed is None else set(needed) | {c for c, _ in plan.keys}
         (child,) = plan.children()

@@ -438,6 +438,116 @@ class TestSqlSubqueries:
         assert np.all(got["amount"] > mx - 1)
 
 
+class TestWindowFunctions:
+    def test_rank_over_partition(self, session, views):
+        got = session.sql(
+            "SELECT user, rank() OVER (PARTITION BY region ORDER BY amount DESC) AS r FROM sales"
+        ).collect()
+        sdf, _ = views
+        pdf = sdf.to_pandas()
+        want = pdf.groupby("region")["amount"].rank(method="min", ascending=False).astype(int)
+        assert sorted(got["r"].tolist()) == sorted(want.tolist())
+
+    def test_row_number_and_dense_rank(self, session, views):
+        got = session.sql(
+            "SELECT row_number() OVER (PARTITION BY region ORDER BY amount) AS rn, "
+            "dense_rank() OVER (PARTITION BY region ORDER BY user) AS dr FROM sales"
+        ).collect()
+        assert got["rn"].min() == 1 and got["dr"].min() == 1
+        # row numbers are unique within each region
+        sdf, _ = views
+        n_regions = len(set(sdf.to_pandas()["region"]))
+        assert (got["rn"] == 1).sum() == n_regions
+
+    def test_agg_window_over_group_by(self, session, views):
+        """The TPC-DS q12 shape: sum(x)*100/sum(sum(x)) OVER (PARTITION ...)."""
+        got = session.sql(
+            "SELECT region, user, SUM(amount) AS rev, "
+            "SUM(amount) * 100 / SUM(SUM(amount)) OVER (PARTITION BY region) AS ratio "
+            "FROM sales GROUP BY region, user"
+        ).collect()
+        sdf, _ = views
+        w = sdf.to_pandas().groupby(["region", "user"], as_index=False)["amount"].sum()
+        w["ratio"] = w["amount"] * 100 / w.groupby("region")["amount"].transform("sum")
+        a = {(r, u): round(v, 6) for r, u, v in zip(got["region"], got["user"], got["ratio"])}
+        b = {(r, u): round(v, 6) for r, u, v in zip(w["region"], w["user"], w["ratio"])}
+        assert a == b
+        # per-partition ratios sum to 100
+        import pandas as pd
+
+        sums = pd.Series(got["ratio"]).groupby(pd.Series(got["region"])).sum()
+        assert np.allclose(sums, 100.0)
+
+    def test_cumulative_rows_frame(self, session, views):
+        got = session.sql(
+            "SELECT amount, SUM(amount) OVER (PARTITION BY region ORDER BY amount "
+            "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS cume FROM sales"
+        ).collect()
+        assert got["cume"].shape[0] == 600
+        assert np.all(got["cume"] >= got["amount"] - 1e-9)
+
+    def test_window_in_derived_table_filter(self, session, views):
+        """The q53 shape: window in a derived table, filtered outside."""
+        got = session.sql(
+            "SELECT * FROM (SELECT user, SUM(amount) s, "
+            "AVG(SUM(amount)) OVER (PARTITION BY region) a "
+            "FROM sales GROUP BY region, user) t WHERE s > a"
+        ).collect()
+        sdf, _ = views
+        w = sdf.to_pandas().groupby(["region", "user"], as_index=False)["amount"].sum()
+        w["a"] = w.groupby("region")["amount"].transform("mean")
+        assert got["s"].shape[0] == int((w["amount"] > w["a"]).sum()) > 0
+
+    def test_cumulative_min_interleaved_partitions(self, session, tmp_path):
+        """Running MIN with partitions whose order keys interleave: the
+        per-row running minimum can never exceed the current row's value."""
+        root = tmp_path / "cmin"
+        root.mkdir()
+        rng = np.random.default_rng(11)
+        pq.write_table(
+            pa.table({"g": np.array([f"g{v}" for v in rng.integers(0, 3, 60)]),
+                      "v": np.round(rng.uniform(0, 10, 60), 2)}),
+            root / "p.parquet",
+        )
+        session.read_parquet(str(root)).create_or_replace_temp_view("cmin")
+        got = session.sql(
+            "SELECT v, MIN(v) OVER (PARTITION BY g ORDER BY v "
+            "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS m FROM cmin"
+        ).collect()
+        assert np.all(got["m"] <= got["v"] + 1e-9)
+        # each partition's running min equals its global min at the top row
+        import pandas as pd
+
+        t = pq.read_table(root / "p.parquet").to_pandas()
+        assert np.isclose(pd.Series(got["m"]).min(), t["v"].min())
+
+    def test_over_words_stay_valid_identifiers(self, session, tmp_path):
+        """'partition', 'row', 'rows' are contextual words, not reserved."""
+        root = tmp_path / "ctx"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"partition": np.array([1, 2], dtype=np.int64),
+                      "row": np.array([10, 20], dtype=np.int64)}),
+            root / "p.parquet",
+        )
+        session.read_parquet(str(root)).create_or_replace_temp_view("ctx")
+        got = session.sql("SELECT partition, row AS rows FROM ctx ORDER BY partition").collect()
+        assert got["partition"].tolist() == [1, 2]
+        assert got["rows"].tolist() == [10, 20]
+
+    def test_window_rejected_in_where(self, session, views):
+        with pytest.raises(SqlError, match="not allowed in WHERE"):
+            session.sql("SELECT user FROM sales WHERE rank() OVER (ORDER BY amount) < 3")
+
+    def test_rank_requires_order_by(self, session, views):
+        with pytest.raises(SqlError, match="ORDER BY"):
+            session.sql("SELECT rank() OVER (PARTITION BY region) FROM sales")
+
+    def test_agg_window_with_order_needs_frame(self, session, views):
+        with pytest.raises(SqlError, match="ROWS BETWEEN"):
+            session.sql("SELECT SUM(amount) OVER (ORDER BY amount) FROM sales")
+
+
 class TestUnions:
     def test_union_all_keeps_duplicates(self, session, views):
         got = session.sql(
