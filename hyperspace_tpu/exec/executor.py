@@ -253,7 +253,10 @@ def _window_column(child: B.Batch, spec, caches=None) -> np.ndarray:
         if fn == "count":
             cum = sv.notna().groupby(sp).cumsum()
         elif fn == "sum":
-            cum = sv.groupby(sp).cumsum()
+            # running sum skips NULLs (cumsum would leave NaN holes)
+            cum = sv.fillna(0).groupby(sp).cumsum()
+            all_null = (~sv.notna()).groupby(sp).cummin()  # NULL until a value
+            cum[all_null.astype(bool)] = np.nan
         else:
             # expanding() emits rows grouped by partition: drop the group
             # level and sort back to sv's positional order before inverting

@@ -224,6 +224,24 @@ class _WindowCall(Expr):
         return f"{self.fn}({self.text}) over (...)"
 
 
+class _GroupingCall(Expr):
+    """Parse-time ``grouping(col)`` marker (ROLLUP indicator: 1 when the
+    column is rolled up in this output row, else 0)."""
+
+    def __init__(self, arg: Expr, text: str):
+        self.arg = arg
+        self.text = text
+
+    def children(self) -> Sequence[Expr]:
+        return (self.arg,)
+
+    def eval(self, batch):
+        raise SqlError("grouping() outside of a ROLLUP context")
+
+    def __repr__(self) -> str:
+        return f"grouping({self.text})"
+
+
 class _SubquerySelect(Expr):
     """Parse-time scalar-subquery marker (``( SELECT ... )``); plan_query
     plans the inner query and replaces this with a ScalarSubquery."""
@@ -322,6 +340,7 @@ class Query:
         self.from_elements: List[FromElement] = []
         self.where: Optional[Expr] = None
         self.group_by: List[str] = []
+        self.rollup = False
         self.having: Optional[Expr] = None
         self.order_by: List[Tuple[Any, bool]] = []  # (column name | Expr, asc)
         self.limit: Optional[int] = None
@@ -412,11 +431,17 @@ def _parse_select_core(p: _Parser) -> Query:
         q.where = _parse_or(p)
     if p.accept_kw("group"):
         p.expect_kw("by")
-        if p.peek() == ("kw", "rollup"):
-            raise SqlError("GROUP BY ROLLUP is not supported")
-        q.group_by = [_parse_group_item(p)]
-        while p.accept_op(","):
-            q.group_by.append(_parse_group_item(p))
+        if p.accept_kw("rollup"):
+            q.rollup = True
+            p.expect_op("(")
+            q.group_by = [_parse_group_item(p)]
+            while p.accept_op(","):
+                q.group_by.append(_parse_group_item(p))
+            p.expect_op(")")
+        else:
+            q.group_by = [_parse_group_item(p)]
+            while p.accept_op(","):
+                q.group_by.append(_parse_group_item(p))
     if p.accept_kw("having"):
         q.having = _parse_or(p)
     return q
@@ -669,6 +694,8 @@ def _parse_over(p: _Parser):
         p.expect_kw("and")
         _expect_word(p, "current")
         _expect_word(p, "row")
+        if not orders:
+            raise SqlError("A ROWS frame requires ORDER BY in the OVER clause")
         cumulative = True
     p.expect_op(")")
     return partition, orders, cumulative
@@ -754,6 +781,12 @@ def _parse_factor(p: _Parser) -> Expr:
             if not orders:
                 raise SqlError(f"{name}() requires ORDER BY in its OVER clause")
             return _WindowCall(name.lower(), None, partition, orders, cumulative, "")
+        if name.lower() == "grouping":
+            start = p.i
+            arg = _parse_sum(p)
+            text = p.text_since(start)
+            p.expect_op(")")
+            return _GroupingCall(arg, text)
         agg = _IDENT_AGGS.get(name.lower())
         if agg is not None:
             start = p.i
@@ -843,52 +876,73 @@ def _walk(e: Expr):
         yield from _walk(c)
 
 
+def _map_expr(e: Expr, fn) -> Expr:
+    """Top-down structural transform: ``fn(node)`` returning non-None
+    replaces the node (no further descent); otherwise the node is rebuilt
+    with transformed children. THE one rebuild-arm list — every marker
+    substitution goes through here so no node shape gets missed."""
+    out = fn(e)
+    if out is not None:
+        return out
+
+    def rec(x):
+        return _map_expr(x, fn)
+
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, rec(e.left), rec(e.right))
+    if isinstance(e, Not):
+        return Not(rec(e.child))
+    if isinstance(e, IsNull):
+        return IsNull(rec(e.child))
+    if isinstance(e, In):
+        return In(rec(e.child), list(e.values))
+    if isinstance(e, _AggCall):
+        return _AggCall(e.fn, rec(e.arg) if e.arg is not None else None, e.text)
+    if isinstance(e, _WindowCall):
+        return _WindowCall(
+            e.fn,
+            rec(e.arg) if e.arg is not None else None,
+            [rec(x) for x in e.partition],
+            [(rec(x), asc) for x, asc in e.orders],
+            e.cumulative,
+            e.text,
+        )
+    if isinstance(e, _GroupingCall):
+        return _GroupingCall(rec(e.arg), e.text)
+    if isinstance(e, _InQuery):
+        return _InQuery(rec(e.child), e.query)
+    from hyperspace_tpu.plan.expr import Case, Cast, Func, InSubquery, Like
+
+    if isinstance(e, Case):
+        return Case(
+            [(rec(c), rec(v)) for c, v in e.branches],
+            rec(e.otherwise) if e.otherwise is not None else None,
+        )
+    if isinstance(e, Cast):
+        return Cast(rec(e.child), e.type_name)
+    if isinstance(e, Func):
+        return Func(e.name, [rec(a) for a in e.args])
+    if isinstance(e, Like):
+        return Like(rec(e.child), e.pattern)
+    if isinstance(e, InSubquery):
+        return InSubquery(rec(e.child), e.plan, e.session)
+    return e
+
+
 def _contains_agg(e: Expr) -> bool:
     return any(isinstance(x, _AggCall) for x in _walk(e))
 
 
 def _rewrite(e: Expr, mapping: Dict[str, str]) -> Expr:
-    """Column-reference rewrite that also traverses the parse-time markers
-    (the shared expr.rewrite_columns does not know them)."""
-    if isinstance(e, Col):
-        return Col(mapping.get(e.name, e.name))
-    if isinstance(e, _AggCall):
-        return _AggCall(e.fn, _rewrite(e.arg, mapping) if e.arg is not None else None, e.text)
-    if isinstance(e, _WindowCall):
-        return _WindowCall(
-            e.fn,
-            _rewrite(e.arg, mapping) if e.arg is not None else None,
-            [_rewrite(x, mapping) for x in e.partition],
-            [(_rewrite(x, mapping), asc) for x, asc in e.orders],
-            e.cumulative,
-            e.text,
-        )
-    if isinstance(e, _InQuery):
-        return _InQuery(_rewrite(e.child, mapping), e.query)
-    if isinstance(e, BinaryOp):
-        return BinaryOp(e.op, _rewrite(e.left, mapping), _rewrite(e.right, mapping))
-    if isinstance(e, Not):
-        return Not(_rewrite(e.child, mapping))
-    if isinstance(e, IsNull):
-        return IsNull(_rewrite(e.child, mapping))
-    if isinstance(e, In):
-        return In(_rewrite(e.child, mapping), list(e.values))
-    from hyperspace_tpu.plan.expr import Case, Cast, Func, InSubquery, Like
+    """Column-reference rewrite across every node shape (incl. the
+    parse-time markers) via the one generic transformer."""
 
-    if isinstance(e, InSubquery):
-        return InSubquery(_rewrite(e.child, mapping), e.plan, e.session)
-    if isinstance(e, Case):
-        return Case(
-            [(_rewrite(c, mapping), _rewrite(v, mapping)) for c, v in e.branches],
-            _rewrite(e.otherwise, mapping) if e.otherwise is not None else None,
-        )
-    if isinstance(e, Like):
-        return Like(_rewrite(e.child, mapping), e.pattern)
-    if isinstance(e, Cast):
-        return Cast(_rewrite(e.child, mapping), e.type_name)
-    if isinstance(e, Func):
-        return Func(e.name, [_rewrite(a, mapping) for a in e.args])
-    return e
+    def leaf(x):
+        if isinstance(x, Col):
+            return Col(mapping.get(x.name, x.name))
+        return None
+
+    return _map_expr(e, leaf)
 
 
 def _resolve_expr_refs(e: Expr, resolve) -> Expr:
@@ -905,51 +959,15 @@ def _bind_subqueries(e: Expr, views, session) -> Expr:
     over the same view namespace (CTEs included)."""
     from hyperspace_tpu.plan.expr import InSubquery, ScalarSubquery
 
-    if isinstance(e, _SubquerySelect):
-        return ScalarSubquery(plan_query(e.query, views).plan, session)
-    if isinstance(e, _InQuery):
-        inner = plan_query(e.query, views)
-        return InSubquery(_bind_subqueries(e.child, views, session), inner.plan, session)
-    if isinstance(e, _AggCall):
-        return _AggCall(
-            e.fn, _bind_subqueries(e.arg, views, session) if e.arg is not None else None, e.text
-        )
-    if isinstance(e, _WindowCall):
-        return _WindowCall(
-            e.fn,
-            _bind_subqueries(e.arg, views, session) if e.arg is not None else None,
-            [_bind_subqueries(x, views, session) for x in e.partition],
-            [(_bind_subqueries(x, views, session), asc) for x, asc in e.orders],
-            e.cumulative,
-            e.text,
-        )
-    if isinstance(e, BinaryOp):
-        return BinaryOp(
-            e.op, _bind_subqueries(e.left, views, session), _bind_subqueries(e.right, views, session)
-        )
-    if isinstance(e, Not):
-        return Not(_bind_subqueries(e.child, views, session))
-    if isinstance(e, IsNull):
-        return IsNull(_bind_subqueries(e.child, views, session))
-    if isinstance(e, In):
-        return In(_bind_subqueries(e.child, views, session), list(e.values))
-    from hyperspace_tpu.plan.expr import Case, Cast, Func, Like
+    def leaf(x):
+        if isinstance(x, _SubquerySelect):
+            return ScalarSubquery(plan_query(x.query, views).plan, session)
+        if isinstance(x, _InQuery):
+            inner = plan_query(x.query, views)
+            return InSubquery(_bind_subqueries(x.child, views, session), inner.plan, session)
+        return None
 
-    if isinstance(e, Case):
-        return Case(
-            [
-                (_bind_subqueries(c, views, session), _bind_subqueries(v, views, session))
-                for c, v in e.branches
-            ],
-            _bind_subqueries(e.otherwise, views, session) if e.otherwise is not None else None,
-        )
-    if isinstance(e, Like):
-        return Like(_bind_subqueries(e.child, views, session), e.pattern)
-    if isinstance(e, Cast):
-        return Cast(_bind_subqueries(e.child, views, session), e.type_name)
-    if isinstance(e, Func):
-        return Func(e.name, [_bind_subqueries(a, views, session) for a in e.args])
-    return e
+    return _map_expr(e, leaf)
 
 
 def _case_map(e: Expr, available: List[str]) -> Tuple[Expr, List[str]]:
@@ -1072,6 +1090,8 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
         [(it, prep(it.expr)) for it in q.items] if q.items is not None else None
     )
     having_e = prep(q.having) if q.having is not None else None
+    if having_e is not None and any(isinstance(x, _WindowCall) for x in _walk(having_e)):
+        raise SqlError("Window functions are not allowed in HAVING")
 
     is_agg = bool(q.group_by) or (
         prepared is not None and any(_contains_agg(e) for _, e in prepared)
@@ -1086,9 +1106,14 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
     if is_agg:
         if prepared is None:
             raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
-        df, names, canonical_out = _plan_aggregate(
-            q, df, prepared, having_e, resolve_ref, renames, session
-        )
+        if q.rollup:
+            df, names, canonical_out = _plan_rollup(
+                q, df, prepared, having_e, resolve_ref, renames, session
+            )
+        else:
+            df, names, canonical_out = _plan_aggregate(
+                q, df, prepared, having_e, resolve_ref, renames, session
+            )
     elif prepared is not None:
         exprs = [e for _, e in prepared]
         df, exprs = _plan_windows(df, exprs, session)
@@ -1420,15 +1445,23 @@ def _plan_windows(df, item_exprs, session):
 
     cols_ = df.plan.output_columns
     lowered = {c.lower(): c for c in cols_}
+    pre: List[Tuple[str, Expr]] = []
 
     def operand(e, what):
         if isinstance(e, Col):
             got = e.name if e.name in cols_ else lowered.get(e.name.lower())
             if got is not None:
                 return got
-        raise SqlError(
-            f"Window {what} must be a column or aggregate of the current frame; got {e!r}"
-        )
+        # expression operand (e.g. grouping-indicator arithmetic, CASE over
+        # keys): computed below the Window node
+        e2, unknown = _case_map(e, cols_)
+        if unknown:
+            raise SqlError(
+                f"Window {what} references unknown columns {unknown} among {sorted(cols_)}"
+            )
+        name = f"__winop{len(pre)}"
+        pre.append((name, e2))
+        return name
 
     specs, mapping = [], {}
     for e in item_exprs:
@@ -1447,33 +1480,140 @@ def _plan_windows(df, item_exprs, session):
                 mapping[id(node)] = Col(out)
     if not specs:
         return df, item_exprs
+    if pre:
+        from hyperspace_tpu.plan.logical import Compute
+
+        df = DataFrame(Compute(pre, df.plan), session)
     df = DataFrame(Window(specs, df.plan), session)
     return df, [_substitute_windows(e, mapping) for e in item_exprs]
 
 
 def _substitute_windows(e: Expr, mapping) -> Expr:
-    if id(e) in mapping:
-        return mapping[id(e)]
-    if isinstance(e, BinaryOp):
-        return BinaryOp(e.op, _substitute_windows(e.left, mapping), _substitute_windows(e.right, mapping))
-    if isinstance(e, Not):
-        return Not(_substitute_windows(e.child, mapping))
-    if isinstance(e, IsNull):
-        return IsNull(_substitute_windows(e.child, mapping))
-    if isinstance(e, In):
-        return In(_substitute_windows(e.child, mapping), list(e.values))
-    from hyperspace_tpu.plan.expr import Case, Cast, Func
+    return _map_expr(e, lambda x: mapping.get(id(x)))
 
-    if isinstance(e, Case):
-        return Case(
-            [(_substitute_windows(c, mapping), _substitute_windows(v, mapping)) for c, v in e.branches],
-            _substitute_windows(e.otherwise, mapping) if e.otherwise is not None else None,
-        )
-    if isinstance(e, Cast):
-        return Cast(_substitute_windows(e.child, mapping), e.type_name)
-    if isinstance(e, Func):
-        return Func(e.name, [_substitute_windows(a, mapping) for a in e.args])
-    return e
+
+def _plan_rollup(q, df, prepared, having_e, resolve_ref, renames, session):
+    """GROUP BY ROLLUP(k1..kn): the union of n+1 grouping sets (every key
+    prefix), rolled-up keys NULL, with __grp{i} indicator columns feeding
+    grouping() (ref: Spark's Rollup/grouping semantics, used by TPC-DS
+    q5/q18/q22/q27/q36/q67/q70/q77/q80/q86). Windows and grouping()
+    arithmetic apply over the UNION (cross-set partitions), matching Spark.
+    Returns (df, projection names, canonical_out)."""
+    from hyperspace_tpu.plan.dataframe import DataFrame
+    from hyperspace_tpu.plan.logical import Aggregate, Compute, Union
+
+    group_keys: List[str] = []
+    for g in q.group_by:
+        if not isinstance(g, str):
+            raise SqlError("ROLLUP keys must be plain columns")
+        r = resolve_ref(g)
+        if r.lower() not in {k.lower() for k in group_keys}:
+            group_keys.append(r)
+    k = len(group_keys)
+    key_index = {g.lower(): i for i, g in enumerate(group_keys)}
+
+    pre_computes: List[Tuple[str, Expr]] = []
+    aggs: List[Tuple[str, str, Optional[str]]] = []
+    agg_out_by_key: Dict[Tuple[str, str], str] = {}
+    canonical_out: Dict[str, str] = {}
+
+    def register(ac: _AggCall) -> str:
+        key = (ac.fn, ac.text if ac.arg is not None else "*")
+        got = agg_out_by_key.get(key)
+        if got is not None:
+            return got
+        canonical = _canonical_agg_name(ac.fn, ac.arg, ac.text)
+        if ac.arg is None:
+            in_col = None
+        elif isinstance(ac.arg, Col):
+            in_col = ac.arg.name
+        else:
+            in_col = f"__aggin{len(pre_computes)}"
+            a2, unknown = _case_map(ac.arg, df.plan.output_columns)
+            if unknown:
+                raise SqlError(f"Unknown columns {unknown} in aggregate {ac.text!r}")
+            pre_computes.append((in_col, a2))
+        aggs.append((canonical, ac.fn, in_col))
+        agg_out_by_key[key] = canonical
+        canonical_out[canonical] = canonical
+        return canonical
+
+    # sibling-item aliases of bare aggregates (a window may ORDER BY them)
+    alias_to_expr = {
+        it.alias.lower(): e for (it, e) in prepared if it.alias and isinstance(e, _AggCall)
+    }
+
+    def subst(e: Expr) -> Expr:
+        def leaf(x):
+            if isinstance(x, _AggCall):
+                return Col(register(x))
+            if isinstance(x, _GroupingCall):
+                a = x.arg
+                gi = key_index.get(a.name.lower()) if isinstance(a, Col) else None
+                if gi is None:
+                    raise SqlError(f"grouping() argument must be a ROLLUP key; got {x.text!r}")
+                return Col(f"__grp{gi}")
+            if isinstance(x, Col):
+                ref = alias_to_expr.get(x.name.lower())
+                if ref is not None:
+                    return Col(register(ref))
+            return None
+
+        return _map_expr(e, leaf)
+
+    item_exprs = [subst(e) for _, e in prepared]
+    having2 = subst(having_e) if having_e is not None else None
+    if not aggs:
+        raise SqlError("GROUP BY ROLLUP requires at least one aggregate in SELECT")
+
+    base = df
+    if pre_computes:
+        base = DataFrame(Compute(pre_computes, base.plan), session)
+
+    # one frame per grouping set (longest prefix first), all with identical
+    # output schemas: keys (NULL when rolled up) + aggregates + indicators
+    out_order = group_keys + [out for out, _, _ in aggs] + [f"__grp{i}" for i in range(k)]
+    frames = []
+    for j in range(k, -1, -1):
+        f = DataFrame(Aggregate(group_keys[:j], aggs, base.plan), session)
+        fills: List[Tuple[str, Expr]] = [(gk, Lit(None)) for gk in group_keys[j:]]
+        fills += [(f"__grp{i}", Lit(0 if i < j else 1)) for i in range(k)]
+        f = DataFrame(Compute(fills, f.plan), session)
+        frames.append(f.select(*out_order).plan)
+    df = DataFrame(Union(frames), session)
+
+    if having2 is not None:
+        h2, unknown = _case_map(having2, df.plan.output_columns)
+        if unknown:
+            raise SqlError(f"HAVING references unknown columns {unknown}")
+        df = df.filter(h2)
+
+    df, item_exprs = _plan_windows(df, item_exprs, session)
+
+    names: List[str] = []
+    computes: List[Tuple[str, Expr]] = []
+    lowered = {c.lower(): c for c in df.plan.output_columns}
+    for i, ((it, _), e) in enumerate(zip(prepared, item_exprs)):
+        if isinstance(e, Col):
+            n = e.name if e.name in df.plan.output_columns else lowered.get(e.name.lower())
+            if n is None:
+                raise SqlError(f"Column {e.name!r} must appear in ROLLUP keys or an aggregate")
+            names.append(n)
+            if it.alias and it.alias != n:
+                renames[n] = it.alias
+            elif n.startswith(("__grp", "__win")):
+                renames[n] = it.alias or it.text
+        else:
+            e2, unknown = _case_map(e, df.plan.output_columns)
+            if unknown:
+                raise SqlError(f"Unknown columns {unknown} in expression {it.text!r}")
+            internal = f"__expr{i}"
+            computes.append((internal, e2))
+            names.append(internal)
+            renames[internal] = it.alias or it.text
+    if computes:
+        df = DataFrame(Compute(computes, df.plan), session)
+    return df, names, canonical_out
 
 
 def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
@@ -1536,37 +1676,13 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
         return out
 
     def replace_aggs(e: Expr, preferred: Optional[str] = None) -> Expr:
-        if isinstance(e, _AggCall):
+        if isinstance(e, _AggCall):  # bare call: may claim the item alias
             return Col(register(e, preferred))
-        if isinstance(e, _WindowCall):
-            return _WindowCall(
-                e.fn,
-                replace_aggs(e.arg) if e.arg is not None else None,
-                [replace_aggs(x) for x in e.partition],
-                [(replace_aggs(x), asc) for x, asc in e.orders],
-                e.cumulative,
-                e.text,
-            )
-        if isinstance(e, BinaryOp):
-            return BinaryOp(e.op, replace_aggs(e.left), replace_aggs(e.right))
-        if isinstance(e, Not):
-            return Not(replace_aggs(e.child))
-        if isinstance(e, IsNull):
-            return IsNull(replace_aggs(e.child))
-        if isinstance(e, In):
-            return In(replace_aggs(e.child), list(e.values))
-        from hyperspace_tpu.plan.expr import Case, Cast, Func
 
-        if isinstance(e, Cast):  # cast(sum(x) AS t) must find its aggregate
-            return Cast(replace_aggs(e.child), e.type_name)
-        if isinstance(e, Case):
-            return Case(
-                [(replace_aggs(c), replace_aggs(v)) for c, v in e.branches],
-                replace_aggs(e.otherwise) if e.otherwise is not None else None,
-            )
-        if isinstance(e, Func):
-            return Func(e.name, [replace_aggs(a) for a in e.args])
-        return e
+        def leaf(x):
+            return Col(register(x)) if isinstance(x, _AggCall) else None
+
+        return _map_expr(e, leaf)
 
     # first pass: items matching a GROUP BY expression's text reuse its
     # computed key; items that ARE bare aggregate calls claim their alias as

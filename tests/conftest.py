@@ -83,7 +83,13 @@ def sorted_rows(batch):
     """Row-set normal form: sorted tuples with NaN made comparable."""
 
     def norm(v):
-        return "NaN" if isinstance(v, float) and v != v else v
+        # one totally-ordered domain: NaN == NaN, NULLs sortable, every
+        # value stringified (a rollup NULL-filled column mixes types)
+        if v is None:
+            return "\x00NULL"
+        if isinstance(v, float) and v != v:
+            return "NaN"
+        return str(v)
 
     cols = sorted(batch.keys())
     if not cols:

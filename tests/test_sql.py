@@ -535,6 +535,48 @@ class TestWindowFunctions:
         assert got["partition"].tolist() == [1, 2]
         assert got["rows"].tolist() == [10, 20]
 
+    def test_rollup_with_grouping(self, session, views):
+        got = session.sql(
+            "SELECT region, SUM(amount) AS s, grouping(region) AS g "
+            "FROM sales GROUP BY ROLLUP(region) ORDER BY g DESC, region"
+        ).collect()
+        sdf, _ = views
+        pdf = sdf.to_pandas()
+        assert got["s"].shape[0] == pdf["region"].nunique() + 1
+        assert got["g"][0] == 1 and got["region"][0] is None
+        assert np.isclose(got["s"][0], pdf["amount"].sum())
+        assert np.allclose(np.sort(got["s"][1:]), np.sort(pdf.groupby("region")["amount"].sum()))
+
+    def test_cumulative_sum_skips_nulls(self, session, tmp_path):
+        root = tmp_path / "cnull"
+        root.mkdir()
+        pq.write_table(
+            pa.table({"g": np.array(["a", "a", "a"]),
+                      "o": np.array([1, 2, 3], dtype=np.int64),
+                      "v": np.array([1.0, np.nan, 2.0])}),
+            root / "p.parquet",
+        )
+        session.read_parquet(str(root)).create_or_replace_temp_view("cnull")
+        got = session.sql(
+            "SELECT SUM(v) OVER (PARTITION BY g ORDER BY o "
+            "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) AS c FROM cnull ORDER BY o"
+        ).collect()
+        assert got["c"].tolist() == [1.0, 1.0, 3.0]  # NULL skipped, not a hole
+
+    def test_rows_frame_requires_order_by(self, session, views):
+        with pytest.raises(SqlError, match="requires ORDER BY"):
+            session.sql(
+                "SELECT SUM(amount) OVER (PARTITION BY region "
+                "ROWS BETWEEN UNBOUNDED PRECEDING AND CURRENT ROW) FROM sales"
+            )
+
+    def test_window_rejected_in_having(self, session, views):
+        with pytest.raises(SqlError, match="not allowed in HAVING"):
+            session.sql(
+                "SELECT region, SUM(amount) s FROM sales GROUP BY region "
+                "HAVING rank() OVER (ORDER BY SUM(amount)) < 3"
+            )
+
     def test_window_rejected_in_where(self, session, views):
         with pytest.raises(SqlError, match="not allowed in WHERE"):
             session.sql("SELECT user FROM sales WHERE rank() OVER (ORDER BY amount) < 3")
