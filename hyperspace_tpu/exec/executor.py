@@ -188,12 +188,10 @@ def _key_codes(arr: np.ndarray, asc: bool) -> np.ndarray:
     return keyvals
 
 
-def _order_codes(child: B.Batch, keys) -> np.ndarray:
-    """One int64 composite code per row whose ordering equals the
-    lexicographic (column, ascending) ordering — equal tuples share a code."""
-    n = B.num_rows(child)
-    per_key = [_key_codes(child[name], asc) for name, asc in keys]
-    # composite: lexsort, then bump a counter at each tuple change
+def _composite_codes(per_key: List[np.ndarray]) -> np.ndarray:
+    """Collapse per-key int64 codes into one composite code per row (equal
+    tuples share a code, ordering lexicographic)."""
+    n = per_key[0].shape[0] if per_key else 0
     sort_order = np.lexsort(per_key[::-1])
     changed = np.zeros(n, dtype=bool)
     if n:
@@ -205,6 +203,12 @@ def _order_codes(child: B.Batch, keys) -> np.ndarray:
     out = np.empty(n, dtype=np.int64)
     out[sort_order] = composite
     return out
+
+
+def _order_codes(child: B.Batch, keys) -> np.ndarray:
+    """One int64 composite code per row whose ordering equals the
+    lexicographic (column, ascending) ordering — equal tuples share a code."""
+    return _composite_codes([_key_codes(child[name], asc) for name, asc in keys])
 
 
 def _window_column(child: B.Batch, spec, caches=None) -> np.ndarray:
@@ -430,6 +434,37 @@ class Executor:
 
         if isinstance(plan, (L.Union, L.BucketUnion)):
             return B.concat([self._exec(c, with_file_names) for c in plan.children()])
+
+        if isinstance(plan, L.SetOp):
+            left = self._exec(plan.left, with_file_names)
+            right = self._exec(plan.right, with_file_names)
+            lcols = plan.left.output_columns
+            rcols = plan.right.output_columns
+            n_l = B.num_rows(left)
+            # code rows over the CONCATENATION of both sides so equal values
+            # of different dtypes (int64 vs float64 from a CAST or nullable
+            # column) share a code; NULLs (NaN/NaT/None) compare equal via
+            # the shared _key_codes missing handling
+            per_key = []
+            for lc, rc in zip(lcols, rcols):
+                a, b = left[lc], right[rc]
+                try:
+                    both = np.concatenate([a, b])
+                except (TypeError, ValueError):
+                    both = np.concatenate([a.astype(object), b.astype(object)])
+                per_key.append(_key_codes(both, True))
+            comp = _composite_codes(per_key) if per_key else np.zeros(0, dtype=np.int64)
+            l_codes, r_codes = comp[:n_l], comp[n_l:]
+            rset = np.zeros(int(comp.max()) + 1 if comp.size else 1, dtype=bool)
+            rset[r_codes] = True
+            hit = rset[l_codes]
+            first = np.zeros(n_l, dtype=bool)
+            if n_l:
+                _, first_idx = np.unique(l_codes, return_index=True)
+                first[first_idx] = True  # distinct semantics
+            keep = first & (hit if plan.kind == "intersect" else ~hit)
+            take = np.nonzero(keep)[0]
+            return {c: left[c][take] for c in lcols}
 
         if isinstance(plan, L.Repartition):
             # Host path: in-memory data has no physical bucketing; pass through.

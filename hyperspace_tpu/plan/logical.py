@@ -220,6 +220,38 @@ class Union(LogicalPlan):
         return Union(list(children))
 
 
+class SetOp(LogicalPlan):
+    """INTERSECT / EXCEPT set operations (distinct semantics, NULLs compare
+    equal — SQL set-operation rules). Children align positionally; output
+    schema is the left child's."""
+
+    def __init__(self, kind: str, left: LogicalPlan, right: LogicalPlan):
+        if kind not in ("intersect", "except"):
+            raise ValueError(f"Unknown set operation {kind!r}")
+        if len(left.output_columns) != len(right.output_columns):
+            raise ValueError(
+                f"{kind.upper()} inputs have {len(left.output_columns)} vs "
+                f"{len(right.output_columns)} columns"
+            )
+        self.kind = kind
+        self.left = left
+        self.right = right
+
+    def children(self) -> Sequence[LogicalPlan]:
+        return (self.left, self.right)
+
+    @property
+    def output_columns(self) -> List[str]:
+        return self.left.output_columns
+
+    def with_children(self, children: Sequence[LogicalPlan]) -> "SetOp":
+        left, right = children
+        return SetOp(self.kind, left, right)
+
+    def describe(self) -> str:
+        return f"SetOp({self.kind})"
+
+
 # --- index-side nodes (appear only in rewritten plans) ----------------------
 
 

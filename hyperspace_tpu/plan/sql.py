@@ -76,6 +76,7 @@ _KEYWORDS = {
     "null", "between", "as", "asc", "desc", "date", "count", "sum", "min",
     "max", "avg", "with", "case", "when", "then", "else", "end", "like",
     "union", "all", "exists", "interval", "cast", "over", "rollup",
+    "intersect", "except",
 }
 
 #: OVER-clause words matched contextually (NOT reserved: a column named
@@ -344,7 +345,8 @@ class Query:
         self.having: Optional[Expr] = None
         self.order_by: List[Tuple[Any, bool]] = []  # (column name | Expr, asc)
         self.limit: Optional[int] = None
-        self.unions: List[Tuple[bool, "Query"]] = []  # (is UNION ALL, rhs)
+        # set-operation chain: ("union", all?, rhs) | ("intersect"/"except", False, rhs)
+        self.unions: List[Tuple[str, bool, "Query"]] = []
 
     # -- compatibility accessors (single-table queries) --------------------
     @property
@@ -380,10 +382,17 @@ def parse(text: str) -> Query:
 
 
 def _parse_query(p: _Parser) -> Query:
+    # UNION and EXCEPT associate left at equal precedence; INTERSECT binds
+    # tighter (handled inside the operand)
     q = _parse_union_operand(p)
-    while p.accept_kw("union"):
-        all_ = p.accept_kw("all") is not None
-        q.unions.append((all_, _parse_union_operand(p)))
+    while True:
+        if p.accept_kw("union"):
+            all_ = p.accept_kw("all") is not None
+            q.unions.append(("union", all_, _parse_union_operand(p)))
+        elif p.accept_kw("except"):
+            q.unions.append(("except", False, _parse_union_operand(p)))
+        else:
+            break
     if p.accept_kw("order"):
         p.expect_kw("by")
         q.order_by = [_parse_order_item(p)]
@@ -398,14 +407,22 @@ def _parse_query(p: _Parser) -> Query:
 
 
 def _parse_union_operand(p: _Parser) -> Query:
-    """A UNION operand: a bare SELECT core or a parenthesized (sub-)query."""
+    """A set-operation operand: a SELECT core (with INTERSECT chains, which
+    bind tighter than UNION/EXCEPT) or a parenthesized (sub-)query."""
+    q = _parse_intersect_operand(p)
+    while p.accept_kw("intersect"):
+        q.unions.append(("intersect", False, _parse_intersect_operand(p)))
+    return q
+
+
+def _parse_intersect_operand(p: _Parser) -> Query:
     if p.peek() == ("op", "(") and p.peek(1) == ("kw", "select"):
         p.i += 1
         q = _parse_query(p)
         p.expect_op(")")
         if q.order_by or q.limit is not None:
             # keep the inner ORDER BY/LIMIT scoped to the branch: wrap it as
-            # a derived table so outer union clauses attach to the wrapper
+            # a derived table so outer set-operation clauses attach outside
             outer = Query()
             outer.from_elements = [FromElement(TableRef(q, "__union_operand"), [])]
             return outer
@@ -431,17 +448,14 @@ def _parse_select_core(p: _Parser) -> Query:
         q.where = _parse_or(p)
     if p.accept_kw("group"):
         p.expect_kw("by")
-        if p.accept_kw("rollup"):
-            q.rollup = True
+        q.rollup = p.accept_kw("rollup") is not None
+        if q.rollup:
             p.expect_op("(")
-            q.group_by = [_parse_group_item(p)]
-            while p.accept_op(","):
-                q.group_by.append(_parse_group_item(p))
+        q.group_by = [_parse_group_item(p)]
+        while p.accept_op(","):
+            q.group_by.append(_parse_group_item(p))
+        if q.rollup:
             p.expect_op(")")
-        else:
-            q.group_by = [_parse_group_item(p)]
-            while p.accept_op(","):
-                q.group_by.append(_parse_group_item(p))
     if p.accept_kw("having"):
         q.having = _parse_or(p)
     return q
@@ -1021,25 +1035,30 @@ def _plan_union(q: Query, views) -> "DataFrame":  # noqa: F821
     head.unions, head.order_by, head.limit = [], [], None
     df = _plan_single(head, views)
     base_cols = df.plan.output_columns
-    for all_, rhs in q.unions:
-        # an operand may itself be a parenthesized query with nested unions
+    for kind, all_, rhs in q.unions:
+        # an operand may itself be a parenthesized query with nested chains
         f = plan_query(rhs, views)
         cols = f.plan.output_columns
         if len(cols) != len(base_cols):
             raise SqlError(
-                f"UNION inputs have {len(base_cols)} vs {len(cols)} output columns"
+                f"{kind.upper()} inputs have {len(base_cols)} vs {len(cols)} output columns"
             )
-        if cols != base_cols:
+        if cols != base_cols and kind == "union":
             mapping = {a: b for a, b in zip(cols, base_cols) if a != b}
             try:
                 f = DataFrame(Rename(mapping, f.plan), f.session)
             except ValueError as e:
                 raise SqlError(f"UNION column alignment failed: {e}")
-        df = DataFrame(Union([df.plan, f.plan]), df.session)
-        if not all_:
-            # left-associative: a bare UNION dedups the chain SO FAR only;
-            # a later UNION ALL keeps its duplicates
-            df = df.distinct()
+        if kind == "union":
+            df = DataFrame(Union([df.plan, f.plan]), df.session)
+            if not all_:
+                # left-associative: a bare UNION dedups the chain SO FAR
+                # only; a later UNION ALL keeps its duplicates
+                df = df.distinct()
+        else:  # intersect / except align positionally inside the SetOp
+            from hyperspace_tpu.plan.logical import SetOp
+
+            df = DataFrame(SetOp(kind, df.plan, f.plan), df.session)
     if q.order_by:
         keys, asc = [], []
         out = set(base_cols)

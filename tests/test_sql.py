@@ -634,6 +634,37 @@ class TestUnions:
         assert np.all(np.diff(got["amount"]) <= 0)
 
 
+class TestSetOps:
+    def test_intersect(self, session, views):
+        got = session.sql(
+            "SELECT region FROM sales WHERE amount > 50 "
+            "INTERSECT SELECT region FROM sales WHERE amount < 50"
+        ).collect()
+        # every region has rows on both sides of 50 in this fixture
+        assert sorted(got["region"]) == sorted({f"r{i}" for i in range(8)})
+
+    def test_except(self, session, views):
+        got = session.sql(
+            "SELECT region FROM sales EXCEPT SELECT region FROM sales WHERE region = 'r1'"
+        ).collect()
+        assert sorted(got["region"]) == sorted({f"r{i}" for i in range(8)} - {"r1"})
+
+    def test_intersect_binds_tighter_than_union(self, session, views):
+        # r1 UNION (r2 INTERSECT r3-side) = r1 only (r2 ∩ r3 rows is empty)
+        got = session.sql(
+            "SELECT region FROM sales WHERE region = 'r1' "
+            "UNION SELECT region FROM sales WHERE region = 'r2' "
+            "INTERSECT SELECT region FROM sales WHERE region = 'r3'"
+        ).collect()
+        assert sorted(set(got["region"])) == ["r1"]
+
+    def test_intersect_distinct_semantics(self, session, views):
+        got = session.sql(
+            "SELECT region FROM sales INTERSECT SELECT region FROM sales"
+        ).collect()
+        assert got["region"].shape[0] == 8  # duplicates collapse
+
+
 class TestNullSemantics:
     @pytest.fixture()
     def nully(self, session, tmp_path):
