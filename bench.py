@@ -43,7 +43,18 @@ def make_lineitem_like(root: str, num_rows: int, num_files: int = 8) -> None:
         pq.write_table(table, os.path.join(root, f"part-{i:05d}.parquet"))
 
 
+def _honor_cpu_request() -> None:
+    """The axon sitecustomize sets jax_platforms on the config object at
+    interpreter startup, silently overriding a JAX_PLATFORMS=cpu env request
+    (smoke runs without the chip); enforce the env on the config object."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
+    _honor_cpu_request()
     num_rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
     tmp = tempfile.mkdtemp(prefix="hs_bench_")
     try:
