@@ -342,6 +342,10 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
             values = [v.value for v in e.values]
             if not values:
                 raise DeviceUnsupported("empty IN list")
+            if any(v is None or (isinstance(v, float) and v != v) for v in values):
+                # NULL in the list makes non-matches unknown (host
+                # _in_semantics); keep that shape host-side
+                raise DeviceUnsupported("NULL literal in IN list")
             if is_string_col(child):
                 if not all(isinstance(v, str) for v in values):
                     raise DeviceUnsupported("mixed-type IN on string column")

@@ -385,8 +385,17 @@ class Executor:
                 v = expr.eval(child)
                 if v is EMPTY_SCALAR:  # NULL scalar subquery -> NULL column
                     v = np.full(n, np.nan)
-                elif isinstance(v, NullableBool):  # boolean NULL -> False
-                    v = v.value & ~v.unknown
+                elif isinstance(v, NullableBool):
+                    # a three-valued boolean projected as a SELECT item keeps
+                    # its NULLs (Spark yields NULL, not false — so IS NULL on
+                    # the alias stays correct)
+                    if np.any(v.unknown):
+                        vv = np.broadcast_to(v.value, (n,))
+                        uu = np.broadcast_to(v.unknown, (n,))
+                        v = vv.astype(object)
+                        v[uu] = None
+                    else:
+                        v = v.value
                 v = np.asarray(v)
                 if v.ndim == 0:
                     v = np.broadcast_to(v, (n,)).copy()

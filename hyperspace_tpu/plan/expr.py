@@ -412,10 +412,30 @@ class In(Expr):
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
         v = self.child.eval(batch)
         vals = [x.value for x in self.values]
-        return np.isin(v, vals)
+        return _in_semantics(v, vals)
 
     def __repr__(self) -> str:
         return f"({self.child!r} IN {[v.value for v in self.values]!r})"
+
+
+def _in_semantics(v, vals):
+    """SQL three-valued IN: TRUE on a non-NULL match; UNKNOWN when the child
+    is NULL or any list value is NULL and nothing matched; FALSE otherwise.
+    Shared by ``In`` (literal list) and ``InSubquery`` so host semantics match
+    the device predicate compiler's Kleene pairs (exec/device.py)."""
+    vals = np.asarray(vals) if not isinstance(vals, np.ndarray) else vals
+    if vals.dtype == object or vals.dtype.kind in ("f", "M"):
+        val_missing = _missing_mask(vals)
+        has_null_value = bool(val_missing.any())
+        non_null = vals[~val_missing]
+    else:
+        has_null_value = False
+        non_null = vals
+    res = np.isin(v, non_null)
+    unknown = (_missing_mask(v) | has_null_value) & ~res
+    if np.any(unknown):
+        return NullableBool(res & ~unknown, unknown)
+    return res
 
 
 #: sentinel returned by a scalar subquery with zero rows (SQL NULL)
@@ -499,8 +519,21 @@ def _kleene_or(l, r):
     return NullableBool(known_true, unknown)
 
 
+def _to_value_array(v):
+    """Collapse a three-valued boolean into a value array (NULL -> None) so
+    non-boolean consumers (CAST, scalar functions, CASE values) see the same
+    NULL-carrying column a projection would produce."""
+    if isinstance(v, NullableBool):
+        if np.any(v.unknown):
+            out = v.value.astype(object)
+            out[np.broadcast_to(v.unknown, v.value.shape)] = None
+            return out
+        return v.value
+    return v
+
+
 def _broadcast_rows(v, n: int) -> np.ndarray:
-    v = np.asarray(v)
+    v = np.asarray(_to_value_array(v))
     return np.broadcast_to(v, (n,)) if v.ndim == 0 else v
 
 
@@ -590,7 +623,7 @@ class Cast(Expr):
         return (self.child,)
 
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        v = np.asarray(self.child.eval(batch))
+        v = np.asarray(_to_value_array(self.child.eval(batch)))
         t = self.type_name
         missing = _missing_mask(v)
         has_missing = bool(np.any(missing))
@@ -645,16 +678,27 @@ class Func(Expr):
         vals = [_broadcast_rows(a.eval(batch), n) for a in self.args]
         f = self.name
         if f in ("substr", "substring"):
+            # SQL/Spark semantics: position 1-based, 0 treated like 1,
+            # negative positions count from the end, and length applies from
+            # the (possibly out-of-range) start position before clamping —
+            # substring('abcde', -8, 3) is '' (not 'abc')
             s, start = vals[0], vals[1]
             ln = vals[2] if len(vals) > 2 else None
             out = []
             for i, x in enumerate(s):
-                st = int(start[i]) - 1 if start.ndim else int(start) - 1
+                if x is None:
+                    out.append(None)
+                    continue
+                text = str(x)
+                pos = int(start[i]) if start.ndim else int(start)
+                st = (pos - 1) if pos > 0 else (len(text) + pos if pos < 0 else 0)
                 if ln is None:
-                    out.append(None if x is None else str(x)[st:])
+                    en = len(text)
                 else:
                     ll = int(ln[i]) if getattr(ln, "ndim", 0) else int(ln)
-                    out.append(None if x is None else str(x)[st : st + ll])
+                    en = st + ll
+                st_c = max(st, 0)
+                out.append(text[st_c : max(en, st_c)])
             return np.array(out, dtype=object)
         if f == "coalesce":
             out = vals[0].astype(object, copy=True) if vals[0].dtype == object else vals[0].copy()
@@ -684,7 +728,15 @@ class Func(Expr):
                     d = int(a1.value)
                 elif getattr(vals[1], "size", 0):
                     d = int(np.asarray(vals[1]).ravel()[0])
-            return np.round(vals[0], d)
+            # SQL ROUND is HALF_UP (away from zero): round(2.5) = 3, while
+            # np.round is banker's half-to-even (np.round(2.5) = 2)
+            src = np.asarray(vals[0])
+            v = src.astype(np.float64)
+            scale = 10.0 ** d
+            out = np.sign(v) * np.floor(np.abs(v) * scale + 0.5) / scale
+            if src.dtype.kind in ("i", "u"):  # int in -> int out (Spark)
+                return out.astype(src.dtype)
+            return out
         if f == "floor":
             return np.floor(vals[0])
         if f in ("ceil", "ceiling"):
@@ -791,7 +843,7 @@ class InSubquery(SubqueryExpr):
         return InSubquery(self.child, plan, self.session)
 
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        return np.isin(self.child.eval(batch), self._values())
+        return _in_semantics(self.child.eval(batch), np.asarray(self._values()))
 
     def __repr__(self) -> str:
         return f"({self.child!r} IN subquery[{self.plan_summary()}])"
