@@ -53,8 +53,55 @@ def _honor_cpu_request() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def _backend_watchdog(timeout_s: float = 75.0, retries: int = 3, emit=None) -> None:
+    """``jax.devices()`` hangs indefinitely when the TPU tunnel is down (a
+    flaky tunnel once burned a whole capture window); probe the backend in a
+    subprocess with a hard timeout so an unreachable chip fails FAST with a
+    diagnostic instead of hanging. Retries cover transient tunnel blips.
+    No-op under JAX_PLATFORMS=cpu (nothing to tunnel). ``emit(reason)``
+    customizes the failure line (benchmarks/run.py emits its own schema)."""
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return
+    import subprocess
+
+    last = "unknown"
+    for attempt in range(1, retries + 1):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+                timeout=timeout_s,
+                capture_output=True,
+                text=True,
+            )
+            if r.returncode == 0 and r.stdout.strip().isdigit():
+                return
+            last = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["empty output"]
+            last = last[0]
+        except subprocess.TimeoutExpired:
+            last = f"jax.devices() hung >{timeout_s:.0f}s (TPU tunnel down?)"
+        if attempt < retries:
+            time.sleep(10 * attempt)
+    reason = f"backend unreachable after {retries} probes: {last}"
+    if emit is not None:
+        emit(reason)
+    else:
+        print(
+            json.dumps(
+                {
+                    "metric": "covering_index_build_rows_per_sec_per_chip",
+                    "value": 0,
+                    "unit": "rows/s/chip",
+                    "vs_baseline": 0,
+                    "error": reason,
+                }
+            )
+        )
+    sys.exit(1)
+
+
 def main() -> None:
     _honor_cpu_request()
+    _backend_watchdog()
     num_rows = int(os.environ.get("BENCH_ROWS", 4_000_000))
     tmp = tempfile.mkdtemp(prefix="hs_bench_")
     try:

@@ -49,18 +49,27 @@ def _session(root, num_buckets=64):
     return sess, hst.Hyperspace(sess), hst
 
 
-def _time_query(q, reps: int) -> float:
+def _time_query(q, reps: int):
+    """(median, IQR) seconds over ``reps`` timed runs after one warm run.
+    IQR (p75-p25) is reported alongside the median so run-to-run ambient
+    variance on shared machines is visible in every published number."""
     q.collect()  # warm
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
         q.collect()
         times.append(time.perf_counter() - t0)
-    return statistics.median(times)
+    med = statistics.median(times)
+    if len(times) >= 4:
+        qs = statistics.quantiles(times, n=4)
+        iqr = qs[2] - qs[0]
+    else:
+        iqr = max(times) - min(times)
+    return med, iqr
 
 
 def _ab(sess, q, reps: int):
-    """(indexed_time, plain_time) for one query in the same process."""
+    """((indexed_median, iqr), (plain_median, iqr)) in the same process."""
     sess.enable_hyperspace()
     ti = _time_query(q, reps)
     sess.disable_hyperspace()
@@ -69,13 +78,20 @@ def _ab(sess, q, reps: int):
     return ti, tp
 
 
-def _emit(config: int, metric: str, value: float, unit: str, speedup: float, extra=None):
+def _emit(config: int, metric: str, ti, tp, extra=None):
+    """One JSON line per config: indexed median (ms) ± IQR, plain median,
+    speedup, and the 1-minute loadavg for cross-run comparability."""
+    (med_i, iqr_i), (med_p, iqr_p) = ti, tp
     row = {
         "config": config,
         "metric": metric,
-        "value": round(value, 4),
-        "unit": unit,
-        "speedup_vs_noindex": round(speedup, 3),
+        "value": round(med_i * 1000, 4),
+        "unit": "ms",
+        "speedup_vs_noindex": round(med_p / med_i, 3),
+        "iqr_ms": round(iqr_i * 1000, 4),
+        "noindex_ms": round(med_p * 1000, 4),
+        "noindex_iqr_ms": round(iqr_p * 1000, 4),
+        "loadavg_1m": round(os.getloadavg()[0], 2),
     }
     if extra:
         row.update(extra)
@@ -90,7 +106,7 @@ def config1(root, args):
     hs.create_index(df, hst.CoveringIndexConfig("sample_idx", ["dept"], ["value", "name"]))
     q = df.filter(hst.col("dept") == 7).select("value", "name")
     ti, tp = _ab(sess, q, args.reps)
-    _emit(1, "sample_filter_query_latency", ti * 1000, "ms", tp / ti)
+    _emit(1, "sample_filter_query_latency", ti, tp)
 
 
 def config2(root, args):
@@ -110,7 +126,7 @@ def config2(root, args):
     q = df.filter(hst.col("l_shipdate") == day).select("l_orderkey", "l_extendedprice")
     ti, tp = _ab(sess, q, args.reps)
     n = int(datagen.LINEITEM_ROWS_SF1 * args.sf)
-    _emit(2, "tpch_shipdate_filter_latency", ti * 1000, "ms", tp / ti,
+    _emit(2, "tpch_shipdate_filter_latency", ti, tp,
           {"sf": args.sf, "build_rows_per_s": round(n / build_s, 1)})
 
 
@@ -129,7 +145,7 @@ def config3(root, args):
         "l_extendedprice", "o_totalprice"
     )
     ti, tp = _ab(sess, q, args.reps)
-    _emit(3, "tpch_indexed_join_latency", ti * 1000, "ms", tp / ti, {"sf": args.sf})
+    _emit(3, "tpch_indexed_join_latency", ti, tp, {"sf": args.sf})
 
 
 def config4(root, args):
@@ -168,7 +184,7 @@ def config4(root, args):
         "l_extendedprice", "o_totalprice"
     )
     ti, tp = _ab(sess, q, args.reps)
-    _emit(4, "hybrid_scan_join_latency", ti * 1000, "ms", tp / ti, {"sf": args.sf, "appended_rows": n_app})
+    _emit(4, "hybrid_scan_join_latency", ti, tp, {"sf": args.sf, "appended_rows": n_app})
 
 
 def config5(root, args):
@@ -210,7 +226,7 @@ def config5(root, args):
     probe = int(np.asarray(batch(1)["k"])[0])
     q = df2.filter(hst.col("k") == probe).select("price")
     ti, tp = _ab(sess, q, args.reps)
-    _emit(5, "delta_incremental_plus_skipping_latency", ti * 1000, "ms", tp / ti,
+    _emit(5, "delta_incremental_plus_skipping_latency", ti, tp,
           {"sf": args.sf, "incremental_refresh_s": round(refresh_s, 3)})
 
 
@@ -222,9 +238,18 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("which", nargs="?", default="all", choices=[*CONFIGS, "all"])
     ap.add_argument("--sf", type=float, default=float(os.environ.get("BENCH_SF", 0.1)))
-    ap.add_argument("--reps", type=int, default=int(os.environ.get("BENCH_REPS", 3)))
+    ap.add_argument("--reps", type=int, default=int(os.environ.get("BENCH_REPS", 10)))
     ap.add_argument("--keep", action="store_true", help="keep generated data dir")
     args = ap.parse_args()
+
+    # fail fast on an unreachable TPU tunnel instead of hanging in
+    # jax.devices() (same watchdog as bench.py, suite-schema error line)
+    import bench
+
+    bench._honor_cpu_request()
+    bench._backend_watchdog(
+        emit=lambda reason: print(json.dumps({"config": None, "error": reason}), flush=True)
+    )
 
     root = tempfile.mkdtemp(prefix="hs_bench_suite_")
     try:

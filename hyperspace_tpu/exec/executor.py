@@ -389,13 +389,9 @@ class Executor:
                     # a three-valued boolean projected as a SELECT item keeps
                     # its NULLs (Spark yields NULL, not false — so IS NULL on
                     # the alias stays correct)
-                    if np.any(v.unknown):
-                        vv = np.broadcast_to(v.value, (n,))
-                        uu = np.broadcast_to(v.unknown, (n,))
-                        v = vv.astype(object)
-                        v[uu] = None
-                    else:
-                        v = v.value
+                    from hyperspace_tpu.plan.expr import _to_value_array
+
+                    v = _to_value_array(v)
                 v = np.asarray(v)
                 if v.ndim == 0:
                     v = np.broadcast_to(v, (n,)).copy()
