@@ -275,6 +275,21 @@ class _InQuery(Expr):
         return f"({self.child!r} IN <subquery>)"
 
 
+class _ExistsQuery(Expr):
+    """Parse-time ``EXISTS ( SELECT ... )`` marker; binding decorrelates the
+    inner query into an ExistsSubquery semi-join mark (NOT EXISTS rides the
+    ordinary Not wrapper — EXISTS is two-valued, never unknown)."""
+
+    def __init__(self, query: "Query"):
+        self.query = query
+
+    def eval(self, batch):
+        raise SqlError("Unplanned EXISTS subquery")
+
+    def __repr__(self) -> str:
+        return "EXISTS(<subquery>)"
+
+
 class SelectItem:
     def __init__(self, expr: Expr, alias: Optional[str], text: str):
         self.expr = expr
@@ -783,7 +798,13 @@ def _parse_factor(p: _Parser) -> Expr:
             return Lit(np.timedelta64(int(num[1]), "D"))
         raise SqlError(f"INTERVAL unit {unit!r} is not supported (days only)")
     if t == ("kw", "exists"):
-        raise SqlError("EXISTS subqueries are not supported")
+        p.i += 1
+        p.expect_op("(")
+        if p.peek() != ("kw", "select"):
+            raise SqlError("EXISTS expects a (SELECT ...) subquery")
+        sub = _ExistsQuery(_parse_query(p))
+        p.expect_op(")")
+        return sub
     if t[0] == "ident" and "." not in t[1] and p.peek(1) == ("op", "("):
         name = p.next()[1]
         p.expect_op("(")
@@ -1101,9 +1122,13 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
                 raise SqlError("Window functions are not allowed in WHERE")
         df = df.filter(where)
 
-    if q.items is None and any(c.startswith("__cross") for c in df.plan.output_columns):
-        # SELECT * must not expose the internal cross-join key columns
-        df = df.select(*[c for c in df.plan.output_columns if not c.startswith("__cross")])
+    if q.items is None and any(
+        c.startswith(("__cross", "__jk")) for c in df.plan.output_columns
+    ):
+        # SELECT * must not expose internal cross-join / computed join-key columns
+        df = df.select(
+            *[c for c in df.plan.output_columns if not c.startswith(("__cross", "__jk"))]
+        )
 
     prepared = (
         [(it, prep(it.expr)) for it in q.items] if q.items is not None else None
@@ -1317,8 +1342,10 @@ def _plan_from(q: Query, views):
 
     conjuncts: Optional[List[Expr]] = None
     used: Set[int] = set()
+    jk_counter = 0
     if len(built) > 1:
-        conjuncts = split_conjunctive(q.where) if q.where is not None else []
+        where_n = _factor_or_common(q.where) if q.where is not None else None
+        conjuncts = split_conjunctive(where_n) if where_n is not None else []
         pending = built[1:]
         while pending:
             progress = False
@@ -1332,9 +1359,25 @@ def _plan_from(q: Query, views):
                         links.append((ci, pair))
                 if not links:
                     continue
+                from hyperspace_tpu.plan.dataframe import DataFrame
+                from hyperspace_tpu.plan.logical import Compute
+
                 condition: Optional[Expr] = None
                 for ci, (ln, rn) in links:
                     used.add(ci)
+                    # an expression key is computed as a hidden join-key
+                    # column on its frame (Spark projects the expression
+                    # below the SortMergeJoin the same way)
+                    if not isinstance(ln, str):
+                        name = f"__jk{jk_counter}"
+                        jk_counter += 1
+                        df = DataFrame(Compute([(name, ln)], df.plan), session)
+                        ln = name
+                    if not isinstance(rn, str):
+                        name = f"__jk{jk_counter}"
+                        jk_counter += 1
+                        frame = DataFrame(Compute([(name, rn)], frame.plan), session)
+                        rn = name
                     term = col(ln) == col(rn)
                     condition = term if condition is None else (condition & term)
                 _, rename = join_output_names(df.plan.output_columns, frame.plan.output_columns)
@@ -1412,16 +1455,89 @@ def _cross_join(df, frame, session):
     return out, rename
 
 
+def _split_disjunctive(e: Expr) -> List[Expr]:
+    if isinstance(e, BinaryOp) and e.op == "OR":
+        return _split_disjunctive(e.left) + _split_disjunctive(e.right)
+    return [e]
+
+
+def _and_all(terms: List[Expr]) -> Optional[Expr]:
+    out: Optional[Expr] = None
+    for t in terms:
+        out = t if out is None else (out & t)
+    return out
+
+
+def _or_all(terms: List[Expr]) -> Optional[Expr]:
+    out: Optional[Expr] = None
+    for t in terms:
+        out = t if out is None else (out | t)
+    return out
+
+
+def _contains_marker(e: Expr) -> bool:
+    """True when the tree holds a parse-time marker (subquery, aggregate,
+    window, grouping) that only ``prep()`` can bind later. Markers repr
+    non-structurally (every subquery is ``<subquery>``) and carry no child
+    references, so factoring and join-key extraction must leave them alone."""
+    return any(
+        isinstance(
+            x, (_SubquerySelect, _InQuery, _ExistsQuery, _AggCall, _WindowCall, _GroupingCall)
+        )
+        for x in _walk(e)
+    )
+
+
+def _factor_or_common(e: Expr) -> Expr:
+    """Pull conjuncts common to every OR branch above the OR:
+    ``(c AND r1) OR (c AND r2) -> c AND (r1 OR r2)`` (Kleene-distributive, so
+    three-valued semantics are preserved). TPC-DS q13/q48-style predicates
+    repeat the equi-join conjuncts inside each OR block; factoring exposes
+    them to the comma-FROM join linker, leaving the residual OR as a plain
+    filter. Structural equality is by repr — conjuncts holding parse-time
+    markers are never factored (their reprs are non-structural)."""
+    from hyperspace_tpu.plan.expr import split_conjunctive
+
+    if isinstance(e, BinaryOp) and e.op == "AND":
+        return _factor_or_common(e.left) & _factor_or_common(e.right)
+    if not (isinstance(e, BinaryOp) and e.op == "OR"):
+        return e
+    branches = [_factor_or_common(b) for b in _split_disjunctive(e)]
+    conj_lists = [split_conjunctive(b) for b in branches]
+    first = {repr(t): t for t in conj_lists[0] if not _contains_marker(t)}
+    common_keys = set(first)
+    for cl in conj_lists[1:]:
+        common_keys &= {repr(t) for t in cl}
+    if not common_keys:
+        return _or_all(branches)
+    common = [t for k, t in first.items() if k in common_keys]
+    residuals: List[Optional[Expr]] = []
+    for cl in conj_lists:
+        taken: Set[str] = set()
+        rest: List[Expr] = []
+        for t in cl:
+            k = repr(t)
+            if k in common_keys and k not in taken:
+                taken.add(k)  # remove one instance per common conjunct
+                continue
+            rest.append(t)
+        residuals.append(_and_all(rest))
+    if any(r is None for r in residuals):
+        # a branch reduced to exactly the common part: the OR is implied
+        return _and_all(common)
+    return _and_all(common) & _or_all([r for r in residuals if r is not None])
+
+
 def _equi_link(term: Expr, alias_cols, left_df, right_frame, right_aliases):
-    """If ``term`` is ``Col = Col`` with one side resolving into the joined
-    composite and the other into the candidate right frame (any of its
-    aliases), return the (left actual name, right name) pair; else None."""
-    if not (
-        isinstance(term, BinaryOp)
-        and term.op == "="
-        and isinstance(term.left, Col)
-        and isinstance(term.right, Col)
-    ):
+    """If ``term`` is ``expr = expr`` with one side's references resolving
+    entirely into the joined composite and the other's entirely into the
+    candidate right frame (any of its aliases), return the
+    (left key, right key) pair — each a column name (str) for bare columns,
+    or the side's Expr rewritten to actual frame columns (the caller computes
+    it as a join-key column, Spark-style projection under the join); else
+    None. Covers TPC-DS q2 (``d_week_seq1 = d_week_seq2 - 53``) and q8
+    (``substr(s_zip,1,2) = substr(ca_zip,1,2)``)."""
+    if not (isinstance(term, BinaryOp) and term.op == "="):
         return None
     left_lower = {c.lower(): c for c in left_df.plan.output_columns}
     right_lower = {c.lower(): c for c in right_frame.plan.output_columns}
@@ -1445,7 +1561,30 @@ def _equi_link(term: Expr, alias_cols, left_df, right_frame, right_aliases):
             return ("right", right_lower[ln])
         return None  # absent or ambiguous
 
-    a, b = classify(term.left.name), classify(term.right.name)
+    def classify_side(e: Expr):
+        """(side, key) where key is a str column or a rewritten Expr; None
+        when refs are absent, mixed-side, constant, or the side holds a
+        parse-time marker (subquery/aggregate/window — bound later by prep,
+        so the whole term must stay a WHERE filter, not become a join key)."""
+        if isinstance(e, Col):
+            got = classify(e.name)
+            return got
+        if _contains_marker(e):
+            return None
+        refs = sorted(e.references())
+        if not refs:
+            return None
+        got = [classify(r) for r in refs]
+        if any(g is None for g in got):
+            return None
+        sides = {g[0] for g in got}
+        if len(sides) != 1:
+            return None
+        side = sides.pop()
+        mapping = {r: g[1] for r, g in zip(refs, got)}
+        return (side, _rewrite(e, mapping))
+
+    a, b = classify_side(term.left), classify_side(term.right)
     if a is not None and b is not None and {a[0], b[0]} == {"left", "right"}:
         left = a if a[0] == "left" else b
         right = a if a[0] == "right" else b

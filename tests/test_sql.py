@@ -860,3 +860,110 @@ class TestMultiJoin:
         assert "x" in got and "x#r" in got
         assert sorted(got["x"].tolist()) == [100, 200, 300]
         assert sorted(got["x#r"].tolist()) == [1000, 2000, 3000]
+
+
+class TestExpressionJoinKeys:
+    """Comma-FROM links through expression predicates: one side (or both)
+    of an equality may be an expression over exactly one frame's columns —
+    computed as a hidden join-key column, equi-joined, never exposed
+    (TPC-DS q2 `d_week_seq1 = d_week_seq2 - 53`, q8 substr = substr)."""
+
+    @pytest.fixture()
+    def ab_views(self, session, tmp_path):
+        a = pa.table({"k": np.array([1, 2, 3, 4], dtype=np.int64),
+                      "av": np.array([10.0, 20.0, 30.0, 40.0])})
+        b = pa.table({"k2": np.array([2, 3, 4, 5], dtype=np.int64),
+                      "bz": np.array(["x1", "y2", "x3", "y4"], dtype=object)})
+        for name, t in (("a", a), ("b", b)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+
+    def test_arithmetic_join_predicate(self, session, ab_views):
+        got = session.sql("SELECT k, k2 FROM a, b WHERE k = k2 - 1").collect()
+        # oracle: k in {1,2,3,4}, k2-1 in {1,2,3,4} -> pairs (1,2),(2,3),(3,4),(4,5)
+        pairs = sorted(zip(got["k"].tolist(), got["k2"].tolist()))
+        assert pairs == [(1, 2), (2, 3), (3, 4), (4, 5)]
+
+    def test_expression_both_sides(self, session, ab_views):
+        got = session.sql(
+            "SELECT av, bz FROM a, b WHERE substr(CAST(k AS string), 1, 1) = substr(bz, 2, 1)"
+        ).collect()
+        # oracle: k-digit vs second char of bz: '1'='1'(x1), '2'='2'(y2), '3'='3'(x3), '4'='4'(y4)
+        pairs = sorted(zip(got["av"].tolist(), got["bz"].tolist()))
+        assert pairs == [(10.0, "x1"), (20.0, "y2"), (30.0, "x3"), (40.0, "y4")]
+
+    def test_select_star_hides_join_key_columns(self, session, ab_views):
+        got = session.sql("SELECT * FROM a, b WHERE k = k2 - 1").collect()
+        assert not any(c.startswith("__jk") for c in got)
+        assert set(got) == {"k", "av", "k2", "bz"}
+
+    def test_same_side_expression_is_filter_not_link(self, session, ab_views):
+        with pytest.raises(SqlError, match="Cannot join"):
+            session.sql("SELECT k FROM a, b WHERE k = k + 0").collect()
+
+
+class TestDisjunctiveJoinPredicates:
+    """OR-of-AND-blocks sharing the equi-join conjunct in every branch
+    (TPC-DS q13/q48): the common conjunct factors out and links the frames;
+    the residual OR filters the joined rows."""
+
+    @pytest.fixture()
+    def sd_views(self, session, tmp_path):
+        s = pa.table({"sk": np.array([1, 1, 2, 2, 3, 3], dtype=np.int64),
+                      "price": np.array([5.0, 55.0, 5.0, 55.0, 5.0, 55.0])})
+        d = pa.table({"dk": np.array([1, 2, 3], dtype=np.int64),
+                      "grp": np.array(["lo", "hi", "lo"], dtype=object)})
+        for name, t in (("s", s), ("d", d)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+
+    def test_or_of_and_blocks(self, session, sd_views):
+        got = session.sql(
+            "SELECT sk, price, grp FROM s, d WHERE "
+            "(sk = dk AND grp = 'lo' AND price < 10) OR (sk = dk AND grp = 'hi' AND price > 50)"
+        ).collect()
+        import pandas as pd
+
+        sdf = pd.DataFrame({"sk": [1, 1, 2, 2, 3, 3], "price": [5.0, 55.0, 5.0, 55.0, 5.0, 55.0]})
+        ddf = pd.DataFrame({"dk": [1, 2, 3], "grp": ["lo", "hi", "lo"]})
+        m = sdf.merge(ddf, left_on="sk", right_on="dk")
+        m = m[((m.grp == "lo") & (m.price < 10)) | ((m.grp == "hi") & (m.price > 50))]
+        assert sorted(zip(got["sk"].tolist(), got["price"].tolist())) == sorted(
+            zip(m.sk.tolist(), m.price.tolist())
+        )
+
+    def test_branch_equal_to_common_collapses(self, session, sd_views):
+        # (sk = dk AND price < 10) OR (sk = dk)  ==  sk = dk
+        got = session.sql(
+            "SELECT sk FROM s, d WHERE (sk = dk AND price < 10) OR (sk = dk)"
+        ).collect()
+        assert len(got["sk"]) == 6
+
+    def test_or_branches_with_distinct_subqueries_not_factored(self, session, sd_views, tmp_path):
+        # two IN-subqueries repr identically ('<subquery>'); factoring must
+        # not treat them as a common conjunct (one would silently replace
+        # the other)
+        c = pa.table({"x": np.array([1], dtype=np.int64)})
+        e = pa.table({"y": np.array([3], dtype=np.int64)})
+        for name, t in (("c", c), ("e", e)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+        got = session.sql(
+            "SELECT sk FROM s, d WHERE "
+            "(sk = dk AND sk IN (SELECT x FROM c)) OR (sk = dk AND sk IN (SELECT y FROM e))"
+        ).collect()
+        assert sorted(set(got["sk"].tolist())) == [1, 3]
+
+    def test_subquery_in_expression_term_stays_filter(self, session, sd_views):
+        # a term whose side contains an unbound scalar-subquery marker must
+        # not become a computed join key (the marker binds only in prep)
+        got = session.sql(
+            "SELECT sk FROM s, d WHERE sk = dk AND price = price * 1 + (SELECT 0.0 * max(dk) FROM d)"
+        ).collect()
+        assert len(got["sk"]) == 6

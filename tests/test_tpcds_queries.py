@@ -42,23 +42,19 @@ pytestmark = pytest.mark.skipif(
 # Queries the dialect cannot express, with the blocking feature. The parser
 # raises SqlError for each; if one starts parsing+planning, the test below
 # flags it for promotion into the expressible set. Window functions,
-# GROUP BY ROLLUP/grouping(), and INTERSECT/EXCEPT joined the dialect
-# during round 2, leaving EXISTS, correlated subqueries, non-equi /
-# expression join predicates, and disjunctive join predicates as the
-# remaining blockers.
+# GROUP BY ROLLUP/grouping(), and INTERSECT/EXCEPT joined the dialect during
+# round 2; expression join keys (q2/q8) and OR-factored disjunctive join
+# predicates (q13/q48) joined during round 3, leaving EXISTS and correlated
+# subqueries as the remaining blockers.
 INEXPRESSIBLE = {
     "q1": "correlated subquery (ctr1.ctr_store_sk referenced from inner query)",
-    "q2": "non-equijoin (week_seq = week_seq - 53 arithmetic join predicate)",
     "q6": "correlated subquery (i.i_category referenced from inner query)",
-    "q8": "expression join predicate (substr(ca_zip,1,2) = substr(...))",
     "q10": "EXISTS subqueries",
-    "q13": "disjunctive join predicates (OR of AND blocks over join keys)",
     "q16": "EXISTS subqueries",
     "q30": "correlated subquery (ctr1.ctr_state referenced from inner query)",
     "q32": "correlated subquery (cs_item_sk = i_item_sk inner reference)",
     "q35": "EXISTS subqueries",
     "q41": "correlated subquery (i1.i_manufact referenced from inner query)",
-    "q48": "disjunctive join predicates (OR of AND blocks over join keys)",
     "q69": "EXISTS subqueries",
     "q81": "correlated subquery (ctr1.ctr_state referenced from inner query)",
     "q92": "correlated subquery (ws_item_sk = i_item_sk inner reference)",
