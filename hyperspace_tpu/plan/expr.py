@@ -849,6 +849,172 @@ class InSubquery(SubqueryExpr):
         return f"({self.child!r} IN subquery[{self.plan_summary()}])"
 
 
+class CorrelatedScalarSubquery(SubqueryExpr):
+    """Decorrelated correlated scalar subquery (the reference gets these from
+    Spark's RewriteCorrelatedScalarSubquery; TPC-DS q1/q6/q30/q32/q41/q81/q92).
+
+    The inner plan is the subquery grouped by its correlation keys
+    (``key_cols``) with the scalar item as ``value_col``; eval maps each
+    outer row's correlation-key tuple to the group's value. A missing group
+    (or a NULL outer key — equality with NULL never matches) yields
+    ``default``: SQL NULL normally, 0 for a bare COUNT (the classic
+    count-bug: COUNT over zero rows is 0, not NULL)."""
+
+    def __init__(self, outer_keys, plan, key_cols, value_col: str, default, session):
+        super().__init__(plan, session)
+        self.outer_keys = list(outer_keys)
+        self.key_cols = list(key_cols)
+        self.value_col = value_col
+        self.default = default  # None => SQL NULL
+
+    def children(self) -> Sequence[Expr]:
+        return tuple(self.outer_keys)
+
+    def with_plan(self, plan) -> "CorrelatedScalarSubquery":
+        return CorrelatedScalarSubquery(
+            self.outer_keys, plan, self.key_cols, self.value_col, self.default, self.session
+        )
+
+    def _exec_inner(self):
+        from hyperspace_tpu.exec.executor import Executor
+
+        cache = getattr(_subquery_scope, "cache", None)
+        if cache is not None and id(self) in cache:
+            return cache[id(self)]
+        cols = [*self.key_cols, self.value_col]
+        got = Executor(self.session).execute(self.plan, required_columns=cols)
+        if cache is not None:
+            cache[id(self)] = got
+        return got
+
+    def eval(self, batch: Dict[str, np.ndarray]):
+        import pandas as pd
+
+        inner = self._exec_inner()
+        n = _batch_rows(batch)
+        knames = [f"__k{i}" for i in range(len(self.key_cols))]
+        okeys = [_broadcast_rows(k.eval(batch), n) for k in self.outer_keys]
+        left = pd.DataFrame({kn: k for kn, k in zip(knames, okeys)})
+        left["__row"] = np.arange(n)
+        right = pd.DataFrame({kn: np.asarray(inner[kc]) for kn, kc in zip(knames, self.key_cols)})
+        right["__v"] = np.asarray(inner[self.value_col])
+        # NULL correlation keys never match (pandas merge would match NaN=NaN)
+        omiss = np.zeros(n, dtype=bool)
+        for k in okeys:
+            omiss |= _missing_mask(k)
+        imiss = np.zeros(len(right), dtype=bool)
+        for kc in self.key_cols:
+            imiss |= _missing_mask(np.asarray(inner[kc]))
+        if imiss.any():
+            right = right[~imiss]
+        merged = left.merge(right, on=knames, how="left", indicator=True)
+        if len(merged) != n:
+            raise ValueError(
+                "correlated scalar subquery returned more than one row per correlation key"
+            )
+        merged = merged.sort_values("__row", kind="stable")
+        vals = merged["__v"].to_numpy()
+        missing = (merged["_merge"].to_numpy() == "left_only") | omiss
+        if missing.any():
+            fill = np.nan if self.default is None else self.default
+            if vals.dtype == object:
+                vals = vals.copy()
+                vals[missing] = None if self.default is None else self.default
+            else:
+                vals = vals.astype(np.float64, copy=True)
+                vals[missing] = fill
+        return vals
+
+    def __repr__(self) -> str:
+        return f"correlated-scalar-subquery[keys={self.key_cols}; {self.plan_summary()}]"
+
+
+class ExistsSubquery(SubqueryExpr):
+    """Decorrelated EXISTS mark (semi-join membership; the reference gets
+    these from Spark's RewritePredicateSubquery as left-semi/anti joins;
+    TPC-DS q10/q16/q35/q69/q94).
+
+    ``outer_keys[i] = inner key_cols[i]`` are the equi-correlation pairs.
+    ``residual`` (optional) is a predicate over the matched pair, referencing
+    inner columns by their projected names and outer values through the
+    ``residual_outer`` placeholder columns (q16/q94's
+    ``cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk``). EXISTS is two-valued —
+    TRUE/FALSE, never unknown — so NOT EXISTS is the plain Not wrapper."""
+
+    def __init__(self, outer_keys, plan, key_cols, residual, residual_outer, session):
+        super().__init__(plan, session)
+        self.outer_keys = list(outer_keys)
+        self.key_cols = list(key_cols)
+        self.residual = residual
+        self.residual_outer = list(residual_outer)  # [(placeholder, outer Expr)]
+
+    def children(self) -> Sequence[Expr]:
+        return tuple(self.outer_keys) + tuple(e for _, e in self.residual_outer)
+
+    def with_plan(self, plan) -> "ExistsSubquery":
+        return ExistsSubquery(
+            self.outer_keys, plan, self.key_cols, self.residual, self.residual_outer, self.session
+        )
+
+    def _exec_inner(self):
+        from hyperspace_tpu.exec.executor import Executor
+
+        cache = getattr(_subquery_scope, "cache", None)
+        if cache is not None and id(self) in cache:
+            return cache[id(self)]
+        got = Executor(self.session).execute(
+            self.plan, required_columns=list(self.plan.output_columns)
+        )
+        if cache is not None:
+            cache[id(self)] = got
+        return got
+
+    def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
+        import pandas as pd
+
+        inner = self._exec_inner()
+        n = _batch_rows(batch)
+        if not self.key_cols:
+            # uncorrelated EXISTS: a constant row-existence mark
+            any_row = any(getattr(c, "shape", (0,))[0] for c in inner.values())
+            return np.full(n, bool(any_row))
+        knames = [f"__k{i}" for i in range(len(self.key_cols))]
+        okeys = [_broadcast_rows(k.eval(batch), n) for k in self.outer_keys]
+        omiss = np.zeros(n, dtype=bool)
+        for k in okeys:
+            omiss |= _missing_mask(k)
+        left = pd.DataFrame({kn: k for kn, k in zip(knames, okeys)})
+        for ph, e in self.residual_outer:
+            left[ph] = _broadcast_rows(e.eval(batch), n)
+        left["__row"] = np.arange(n)
+        rcols = {kn: np.asarray(inner[kc]) for kn, kc in zip(knames, self.key_cols)}
+        for c in inner:
+            if c not in self.key_cols and not c.startswith("__input"):
+                rcols[c] = np.asarray(inner[c])
+        right = pd.DataFrame(rcols)
+        imiss = np.zeros(len(right), dtype=bool)
+        for kn in knames:
+            imiss |= _missing_mask(rcols[kn])
+        if imiss.any():
+            right = right[~imiss]
+        merged = left.merge(right, on=knames, how="inner")
+        mask = np.zeros(n, dtype=bool)
+        if len(merged):
+            if self.residual is not None:
+                mbatch = {c: merged[c].to_numpy() for c in merged.columns}
+                keep = as_bool_mask(self.residual.eval(mbatch))
+                rows = merged["__row"].to_numpy()[keep]
+            else:
+                rows = merged["__row"].to_numpy()
+            mask[np.unique(rows)] = True
+        mask &= ~omiss  # a NULL correlation key can never match
+        return mask
+
+    def __repr__(self) -> str:
+        res = f", residual={self.residual!r}" if self.residual is not None else ""
+        return f"exists-subquery[keys={self.key_cols}{res}; {self.plan_summary()}]"
+
+
 def _wrap(x: Any) -> Expr:
     return x if isinstance(x, Expr) else Lit(x)
 
@@ -921,6 +1087,18 @@ def rewrite_columns(e: Expr, mapping: Dict[str, str]) -> Expr:
         return In(rewrite_columns(e.child, mapping), list(e.values))
     if isinstance(e, InSubquery):
         return InSubquery(rewrite_columns(e.child, mapping), e.plan, e.session)
+    if isinstance(e, CorrelatedScalarSubquery):
+        return CorrelatedScalarSubquery(
+            [rewrite_columns(k, mapping) for k in e.outer_keys],
+            e.plan, e.key_cols, e.value_col, e.default, e.session,
+        )
+    if isinstance(e, ExistsSubquery):
+        return ExistsSubquery(
+            [rewrite_columns(k, mapping) for k in e.outer_keys],
+            e.plan, e.key_cols, e.residual,
+            [(ph, rewrite_columns(x, mapping)) for ph, x in e.residual_outer],
+            e.session,
+        )
     if isinstance(e, Case):
         return Case(
             [(rewrite_columns(c, mapping), rewrite_columns(v, mapping)) for c, v in e.branches],

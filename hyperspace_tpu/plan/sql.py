@@ -961,6 +961,21 @@ def _map_expr(e: Expr, fn) -> Expr:
         return Like(rec(e.child), e.pattern)
     if isinstance(e, InSubquery):
         return InSubquery(rec(e.child), e.plan, e.session)
+    from hyperspace_tpu.plan.expr import CorrelatedScalarSubquery, ExistsSubquery
+
+    if isinstance(e, CorrelatedScalarSubquery):
+        return CorrelatedScalarSubquery(
+            [rec(k) for k in e.outer_keys], e.plan, e.key_cols, e.value_col, e.default, e.session
+        )
+    if isinstance(e, ExistsSubquery):
+        return ExistsSubquery(
+            [rec(k) for k in e.outer_keys],
+            e.plan,
+            e.key_cols,
+            e.residual,
+            [(ph, rec(x)) for ph, x in e.residual_outer],
+            e.session,
+        )
     return e
 
 
@@ -989,17 +1004,36 @@ def _resolve_expr_refs(e: Expr, resolve) -> Expr:
     return _rewrite(e, mapping) if mapping else e
 
 
-def _bind_subqueries(e: Expr, views, session) -> Expr:
-    """Replace parse-time subquery markers with planned Scalar/In subqueries
-    over the same view namespace (CTEs included)."""
+def _bind_subqueries(e: Expr, views, session, outer_resolve=None) -> Expr:
+    """Replace parse-time subquery markers with planned subquery expressions
+    over the same view namespace (CTEs included). Correlated scalar and
+    EXISTS subqueries decorrelate (plan/decorrelate.py); ``outer_resolve``
+    maps their outer references to actual outer-frame columns."""
+    from hyperspace_tpu.plan.decorrelate import (
+        decorrelate_exists,
+        decorrelate_scalar,
+        is_correlated,
+    )
     from hyperspace_tpu.plan.expr import InSubquery, ScalarSubquery
+
+    identity = outer_resolve if outer_resolve is not None else (lambda name: name)
 
     def leaf(x):
         if isinstance(x, _SubquerySelect):
+            if is_correlated(x.query, views):
+                return decorrelate_scalar(x.query, views, session, identity)
             return ScalarSubquery(plan_query(x.query, views).plan, session)
+        if isinstance(x, _ExistsQuery):
+            return decorrelate_exists(x.query, views, session, identity)
         if isinstance(x, _InQuery):
+            if is_correlated(x.query, views):
+                raise SqlError(
+                    "Correlated IN subqueries are not supported; rewrite as EXISTS"
+                )
             inner = plan_query(x.query, views)
-            return InSubquery(_bind_subqueries(x.child, views, session), inner.plan, session)
+            return InSubquery(
+                _bind_subqueries(x.child, views, session, outer_resolve), inner.plan, session
+            )
         return None
 
     return _map_expr(e, leaf)
@@ -1109,7 +1143,7 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
     resolve_ref = _make_ref_resolver(df, alias_cols)
 
     def prep(e: Expr) -> Expr:
-        return _bind_subqueries(_resolve_expr_refs(e, resolve_ref), views, session)
+        return _bind_subqueries(_resolve_expr_refs(e, resolve_ref), views, session, resolve_ref)
 
     if where_rem is not None:
         where = prep(where_rem)

@@ -1,0 +1,297 @@
+"""Correlated-subquery decorrelation (plan/decorrelate.py) against pandas
+oracles: EXISTS / NOT EXISTS semi/anti marks (with residual non-equi
+correlated predicates), correlated scalar subqueries via GROUP BY rewrite
+(including the COUNT-over-empty-group bug), NULL correlation keys, and
+composition with index rewriting. The reference gets all of this from Spark
+Catalyst (RewritePredicateSubquery / RewriteCorrelatedScalarSubquery)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan.sql import SqlError
+
+
+@pytest.fixture()
+def orders_returns(session, tmp_path):
+    """orders(ok, cust, amt, wh) and returns(rok, rcust, ramt); cust 0..9,
+    some orders have NULL wh, customer 9 never returns anything."""
+    rng = np.random.default_rng(7)
+    n = 200
+    wh = rng.integers(0, 4, n).astype(np.float64)
+    wh[rng.random(n) < 0.15] = np.nan
+    orders = pa.table(
+        {
+            "ok": np.arange(n, dtype=np.int64),
+            "cust": rng.integers(0, 10, n).astype(np.int64),
+            "amt": np.round(rng.uniform(0, 100, n), 2),
+            "wh": wh,
+        }
+    )
+    m = 80
+    rcust = rng.integers(0, 9, m).astype(np.int64)  # customer 9 absent
+    returns = pa.table(
+        {
+            "rok": rng.integers(0, n, m).astype(np.int64),
+            "rcust": rcust,
+            "ramt": np.round(rng.uniform(0, 50, m), 2),
+        }
+    )
+    for name, t in (("orders", orders), ("returns", returns)):
+        root = tmp_path / name
+        root.mkdir()
+        pq.write_table(t, root / "p.parquet")
+        session.read_parquet(str(root)).create_or_replace_temp_view(name)
+    return orders.to_pandas(), returns.to_pandas()
+
+
+class TestExists:
+    def test_exists_equi(self, session, orders_returns):
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE EXISTS("
+            "SELECT * FROM returns r WHERE o.cust = r.rcust)"
+        ).collect()
+        expect = od[od.cust.isin(rd.rcust.unique())].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_not_exists_anti(self, session, orders_returns):
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE NOT EXISTS("
+            "SELECT * FROM returns r WHERE o.cust = r.rcust)"
+        ).collect()
+        expect = od[~od.cust.isin(rd.rcust.unique())].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+        assert len(got["ok"]) > 0  # customer 9 rows exist
+
+    def test_exists_with_inner_predicate(self, session, orders_returns):
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE EXISTS("
+            "SELECT * FROM returns r WHERE o.cust = r.rcust AND r.ramt > 40)"
+        ).collect()
+        expect = od[od.cust.isin(rd[rd.ramt > 40].rcust.unique())].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_exists_residual_nonequi(self, session, orders_returns):
+        # q16/q94 shape: same key, different attribute value elsewhere in the
+        # group — self-join EXISTS with <> residual
+        od, _ = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o1 WHERE EXISTS("
+            "SELECT * FROM orders o2 WHERE o1.cust = o2.cust AND o1.wh <> o2.wh)"
+        ).collect()
+        m = od.merge(od, on="cust", suffixes=("", "_r"))
+        keep = m[(m.wh != m.wh_r) & m.wh.notna() & m.wh_r.notna()]
+        expect = keep.ok.unique()
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_or_of_two_exists(self, session, orders_returns):
+        # q10/q35 shape: disjunction of independent EXISTS marks
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE "
+            "EXISTS(SELECT * FROM returns r WHERE o.cust = r.rcust AND r.ramt > 45) OR "
+            "EXISTS(SELECT * FROM returns r WHERE o.ok = r.rok AND r.ramt < 5)"
+        ).collect()
+        s1 = od.cust.isin(rd[rd.ramt > 45].rcust.unique())
+        s2 = od.ok.isin(rd[rd.ramt < 5].rok.unique())
+        expect = od[s1 | s2].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_exists_multi_key(self, session, orders_returns):
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE EXISTS("
+            "SELECT * FROM returns r WHERE o.cust = r.rcust AND o.ok = r.rok)"
+        ).collect()
+        keys = set(zip(rd.rcust, rd.rok))
+        expect = od[[(c, k) in keys for c, k in zip(od.cust, od.ok)]].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_null_correlation_key_never_matches(self, session, orders_returns):
+        od, _ = orders_returns
+        # wh has NULLs; EXISTS keyed on wh must exclude NULL-wh outer rows
+        got = session.sql(
+            "SELECT ok FROM orders o1 WHERE EXISTS("
+            "SELECT * FROM orders o2 WHERE o1.wh = o2.wh)"
+        ).collect()
+        expect = od[od.wh.notna()].ok  # every non-NULL wh matches itself
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_uncorrelated_exists(self, session, orders_returns):
+        got = session.sql(
+            "SELECT ok FROM orders WHERE EXISTS(SELECT * FROM returns WHERE ramt > 1000)"
+        ).collect()
+        assert len(got["ok"]) == 0
+        got2 = session.sql(
+            "SELECT ok FROM orders WHERE EXISTS(SELECT * FROM returns WHERE ramt >= 0)"
+        ).collect()
+        assert len(got2["ok"]) == 200
+
+
+class TestCorrelatedScalar:
+    def test_avg_per_group(self, session, orders_returns):
+        od, _ = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o1 WHERE amt > "
+            "(SELECT avg(amt) * 1.2 FROM orders o2 WHERE o1.cust = o2.cust)"
+        ).collect()
+        thr = od.groupby("cust").amt.mean() * 1.2
+        expect = od[od.amt > od.cust.map(thr)].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_missing_group_yields_null_comparison_false(self, session, orders_returns):
+        od, rd = orders_returns
+        # customer 9 has no returns: threshold is NULL -> comparison unknown
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE amt > "
+            "(SELECT avg(ramt) FROM returns r WHERE o.cust = r.rcust)"
+        ).collect()
+        thr = rd.groupby("rcust").ramt.mean()
+        mapped = od.cust.map(thr)
+        expect = od[(od.amt > mapped) & mapped.notna()].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+        assert not od[od.cust == 9].ok.isin(got["ok"]).any()
+
+    def test_count_bug_empty_group_is_zero(self, session, orders_returns):
+        od, rd = orders_returns
+        # COUNT over an empty group is 0, not NULL: customer-9 orders DO
+        # satisfy "= 0" (Spark/SQL semantics; the classic count-bug)
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE "
+            "(SELECT count(*) FROM returns r WHERE o.cust = r.rcust) = 0"
+        ).collect()
+        counts = rd.groupby("rcust").size()
+        expect = od[od.cust.map(counts).fillna(0) == 0].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+        assert od[od.cust == 9].ok.isin(got["ok"]).all()
+
+    def test_scalar_in_arithmetic(self, session, orders_returns):
+        od, _ = orders_returns
+        # q32/q92 shape: literal * (SELECT avg ...) comparison
+        got = session.sql(
+            "SELECT ok FROM orders o1 WHERE amt > 1.3 * "
+            "(SELECT avg(amt) FROM orders o2 WHERE o2.cust = o1.cust)"
+        ).collect()
+        thr = od.groupby("cust").amt.mean() * 1.3
+        expect = od[od.amt > od.cust.map(thr)].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_correlated_conjunct_inside_or_factored(self, session, orders_returns):
+        od, _ = orders_returns
+        # q41 shape: correlation equality repeated in both OR branches
+        got = session.sql(
+            "SELECT ok FROM orders o1 WHERE (SELECT count(*) FROM orders o2 WHERE "
+            "(o2.cust = o1.cust AND o2.amt > 90) OR (o2.cust = o1.cust AND o2.amt < 5)"
+            ") > 0"
+        ).collect()
+        cnt = od[(od.amt > 90) | (od.amt < 5)].groupby("cust").size()
+        expect = od[od.cust.map(cnt).fillna(0) > 0].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_correlated_without_aggregate_rejected(self, session, orders_returns):
+        with pytest.raises(SqlError, match="must aggregate"):
+            session.sql(
+                "SELECT ok FROM orders o WHERE amt > "
+                "(SELECT ramt FROM returns r WHERE o.cust = r.rcust)"
+            ).collect()
+
+    def test_correlated_in_rejected_with_hint(self, session, orders_returns):
+        with pytest.raises(SqlError, match="rewrite as EXISTS"):
+            session.sql(
+                "SELECT ok FROM orders o WHERE ok IN "
+                "(SELECT rok FROM returns r WHERE o.cust = r.rcust)"
+            ).collect()
+
+
+class TestDecorrelationWithIndexes:
+    def test_index_rewrite_inside_exists(self, session, tmp_path):
+        """ApplyHyperspace recurses into the decorrelated inner plan: an
+        index on the inner table's correlation column is used, and results
+        stay identical with hyperspace on vs off."""
+        hs = hst.Hyperspace(session)
+        rng = np.random.default_rng(3)
+        n = 4000
+        f = pa.table(
+            {
+                "k": rng.integers(0, 400, n).astype(np.int64),
+                "p": np.round(rng.uniform(0, 10, n), 2),
+            }
+        )
+        g = pa.table(
+            {
+                "gk": rng.integers(0, 400, 500).astype(np.int64),
+                "gv": np.round(rng.uniform(0, 10, 500), 2),
+            }
+        )
+        for name, t in (("f", f), ("g", g)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+        hs.create_index(
+            session._temp_views["g"], hst.CoveringIndexConfig("g_gv", ["gv"], ["gk"])
+        )
+        session.enable_hyperspace()
+        q = session.sql(
+            "SELECT k FROM f WHERE EXISTS(SELECT * FROM g WHERE f.k = g.gk AND g.gv > 5)"
+        )
+        plan = q.optimized_plan()
+        from hyperspace_tpu.rules.apply import used_index_names
+
+        assert "g_gv" in used_index_names(plan.plan if hasattr(plan, "plan") else plan)
+        on = q.collect()
+        session.disable_hyperspace()
+        try:
+            off = q.collect()
+        finally:
+            session.enable_hyperspace()
+        assert sorted(on["k"].tolist()) == sorted(off["k"].tolist())
+        assert len(on["k"]) > 0
+
+
+class TestReviewRegressions:
+    def test_compound_count_expression_defaults_to_its_zero_row_value(
+        self, session, orders_returns
+    ):
+        od, rd = orders_returns
+        # count(*) * 2 over an empty group is 0, not NULL: customer-9 orders
+        # satisfy "< 1"
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE "
+            "(SELECT count(*) * 2 FROM returns r WHERE o.cust = r.rcust) < 1"
+        ).collect()
+        counts = rd.groupby("rcust").size() * 2
+        expect = od[od.cust.map(counts).fillna(0) < 1].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+        assert od[od.cust == 9].ok.isin(got["ok"]).all()
+
+    def test_avg_wrapped_in_expression_still_null_on_empty(self, session, orders_returns):
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE amt > "
+            "(SELECT avg(ramt) + 0 FROM returns r WHERE o.cust = r.rcust)"
+        ).collect()
+        thr = rd.groupby("rcust").ramt.mean()
+        mapped = od.cust.map(thr)
+        expect = od[(od.amt > mapped) & mapped.notna()].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+    def test_correlated_scalar_in_having(self, session, orders_returns):
+        od, rd = orders_returns
+        # the HAVING pipeline rewrites bound trees: the new subquery nodes
+        # must survive the generic transformer without losing outer keys
+        got = session.sql(
+            "SELECT cust, sum(amt) AS total FROM orders o GROUP BY cust "
+            "HAVING sum(amt) > (SELECT sum(ramt) FROM returns r WHERE r.rcust = o.cust)"
+        ).collect()
+        t = od.groupby("cust", as_index=False).amt.sum()
+        rt = rd.groupby("rcust").ramt.sum()
+        mapped = t.cust.map(rt)
+        expect = t[(t.amt > mapped) & mapped.notna()]
+        assert sorted(got["cust"].tolist()) == sorted(expect.cust.tolist())

@@ -7,10 +7,7 @@ the framework's SQL dialect, plans them onto the IR, checks
 
   - hyperspace-on results equal hyperspace-off results (checkAnswer), and
   - the normalized optimized-plan text against approved files
-    (tests/approved_plans/tpcds_sql/, regen with HS_GENERATE_GOLDEN=1),
-
-and pins the queries the dialect cannot express, each with its reason —
-so a query silently starting (or stopping) to work fails the suite.
+    (tests/approved_plans/tpcds_sql/, regen with HS_GENERATE_GOLDEN=1).
 
 Tables use the complete 24-table schema (tests/tpcds_schema.py). Query texts
 are read from the reference checkout; the whole module skips when it is not
@@ -28,7 +25,6 @@ import pyarrow.parquet as pq
 import pytest
 
 import hyperspace_tpu as hst
-from hyperspace_tpu.plan.sql import SqlError
 from tpcds_schema import TPCDS_SCHEMAS
 
 QUERIES_DIR = "/root/reference/src/test/resources/tpcds/queries"
@@ -39,27 +35,13 @@ pytestmark = pytest.mark.skipif(
     not os.path.isdir(QUERIES_DIR), reason="reference TPC-DS query texts not available"
 )
 
-# Queries the dialect cannot express, with the blocking feature. The parser
-# raises SqlError for each; if one starts parsing+planning, the test below
-# flags it for promotion into the expressible set. Window functions,
-# GROUP BY ROLLUP/grouping(), and INTERSECT/EXCEPT joined the dialect during
-# round 2; expression join keys (q2/q8) and OR-factored disjunctive join
-# predicates (q13/q48) joined during round 3, leaving EXISTS and correlated
-# subqueries as the remaining blockers.
-INEXPRESSIBLE = {
-    "q1": "correlated subquery (ctr1.ctr_store_sk referenced from inner query)",
-    "q6": "correlated subquery (i.i_category referenced from inner query)",
-    "q10": "EXISTS subqueries",
-    "q16": "EXISTS subqueries",
-    "q30": "correlated subquery (ctr1.ctr_state referenced from inner query)",
-    "q32": "correlated subquery (cs_item_sk = i_item_sk inner reference)",
-    "q35": "EXISTS subqueries",
-    "q41": "correlated subquery (i1.i_manufact referenced from inner query)",
-    "q69": "EXISTS subqueries",
-    "q81": "correlated subquery (ctr1.ctr_state referenced from inner query)",
-    "q92": "correlated subquery (ws_item_sk = i_item_sk inner reference)",
-    "q94": "EXISTS subqueries",
-}
+# Round 2 grew window functions, GROUP BY ROLLUP/grouping(), and
+# INTERSECT/EXCEPT; round 3 added expression join keys (q2/q8), OR-factored
+# disjunctive join predicates (q13/q48), EXISTS decorrelation
+# (q10/q16/q35/q69/q94), and correlated-scalar decorrelation
+# (q1/q6/q30/q32/q41/q81/q92) — ALL 103 of the reference's query texts now
+# plan, execute, and hold approved plans (the reference's own gold standard:
+# goldstandard/PlanStabilitySuite.scala with 103 approved-plans entries).
 
 
 def _all_query_names():
@@ -70,8 +52,7 @@ def _all_query_names():
     )
 
 
-EXPRESSIBLE = [q for q in _all_query_names()] if os.path.isdir(QUERIES_DIR) else []
-EXPRESSIBLE = [q for q in EXPRESSIBLE if q not in INEXPRESSIBLE]
+EXPRESSIBLE = _all_query_names() if os.path.isdir(QUERIES_DIR) else []
 
 
 def _query_text(qname):
@@ -188,13 +169,14 @@ def test_query_plans_and_answers(tpcds, qname):
     assert _rows(on) == _rows(off), f"{qname}: results differ with hyperspace on vs off"
 
 
-@pytest.mark.parametrize("qname", sorted(INEXPRESSIBLE, key=lambda s: (int(re.search(r"\d+", s).group()), s)))
-def test_inexpressible_queries_still_raise(tpcds, qname):
-    """Each inexpressible query must still fail with SqlError (so the
-    blocking feature is accurately documented); if one starts working, move
-    it to the expressible set."""
-    sess, _ = tpcds
-    # correlated subqueries surface as resolver ValueErrors from the inner
-    # plan; everything else as SqlError
-    with pytest.raises((SqlError, ValueError)):
-        sess.sql(_query_text(qname)).collect()
+def test_full_gold_standard_parity():
+    """The ratchet: every one of the reference's 103 query texts is
+    expressible and has an approved plan."""
+    if os.path.isdir(QUERIES_DIR):
+        assert len(EXPRESSIBLE) == 103
+        missing = [
+            q
+            for q in EXPRESSIBLE
+            if not os.path.exists(os.path.join(APPROVED_DIR, f"{q}.txt"))
+        ]
+        assert not missing, f"queries without approved plans: {missing}"
