@@ -57,6 +57,72 @@ def _stage_for_exchange(values, dest, n_dev: int, capacity: int, fill=0, valid=N
     return staged, mask[:-1].reshape(n_dev, capacity), counts
 
 
+def _to_planes(v):
+    """Split an array into bit-exact int32 planes (1 plane for <=32-bit
+    dtypes, hi/lo planes for 64-bit) so a whole exchange can ride ONE
+    all_to_all regardless of column dtypes."""
+    from jax import lax
+
+    dt = v.dtype
+    if dt == jnp.bool_:
+        return [v.astype(jnp.int32)]
+    if dt.itemsize <= 4:
+        if dt in (jnp.uint32, jnp.float32):
+            return [lax.bitcast_convert_type(v, jnp.int32)]
+        if dt.kind == "f":  # float16/bfloat16: bit-pattern, not value cast
+            width = jnp.uint16 if dt.itemsize == 2 else jnp.uint8
+            return [lax.bitcast_convert_type(v, width).astype(jnp.int32)]
+        return [v.astype(jnp.int32)]  # int32/int16/int8: value-preserving
+    u = lax.bitcast_convert_type(v, jnp.uint64)
+    hi = lax.bitcast_convert_type((u >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32)
+    lo = lax.bitcast_convert_type((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32), jnp.int32)
+    return [hi, lo]
+
+
+def _from_planes(planes, dt):
+    """Inverse of ``_to_planes``."""
+    from jax import lax
+
+    dt = jnp.dtype(dt)
+    if dt == jnp.bool_:
+        return planes[0].astype(jnp.bool_)
+    if dt.itemsize <= 4:
+        if dt in (jnp.uint32, jnp.float32):
+            return lax.bitcast_convert_type(planes[0], dt)
+        if dt.kind == "f":
+            width = jnp.uint16 if dt.itemsize == 2 else jnp.uint8
+            return lax.bitcast_convert_type(planes[0].astype(width), dt)
+        return planes[0].astype(dt)
+    hi = lax.bitcast_convert_type(planes[0], jnp.uint32).astype(jnp.uint64)
+    lo = lax.bitcast_convert_type(planes[1], jnp.uint32).astype(jnp.uint64)
+    return lax.bitcast_convert_type((hi << jnp.uint64(32)) | lo, dt)
+
+
+def _exchange_packed(staged, mask, axis):
+    """The one-collective exchange: every staged (n_dev, capacity) buffer and
+    the slot mask are split into bit-exact int32 planes, stacked into a single
+    (n_dev, capacity, planes) tensor, exchanged with ONE tiled ``all_to_all``
+    over ``axis``, and unpacked back to the original dtypes. One collective
+    launch per exchange phase — the compiled-HLO property ``dryrun_multichip``
+    and tests/test_hlo_collectives.py assert (SURVEY.md §2.9: build = one
+    all-to-all; hierarchical = one per phase)."""
+    dts = [v.dtype for v in staged]
+    planes = []
+    for v in staged:
+        planes.extend(_to_planes(v))
+    planes.extend(_to_planes(mask))
+    packed = jnp.stack(planes, axis=-1)
+    out = jax.lax.all_to_all(packed, axis, split_axis=0, concat_axis=0, tiled=True)
+    out = out.reshape(-1, out.shape[-1])
+    res, i = [], 0
+    for dt in dts:
+        k = 2 if jnp.dtype(dt).itemsize > 4 and dt != jnp.bool_ else 1
+        res.append(_from_planes([out[:, i + j] for j in range(k)], dt))
+        i += k
+    out_mask = out[:, i].astype(jnp.bool_)
+    return res, out_mask
+
+
 def rebucket(
     mesh: Mesh,
     arrays: Dict[str, "jax.Array"],
@@ -99,11 +165,7 @@ def rebucket(
         sent = jnp.minimum(counts, capacity)
         overflow = jnp.sum(counts - sent)
 
-        out = [
-            jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
-            for s in staged
-        ]
-        out_mask = jax.lax.all_to_all(mask, axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
+        out, out_mask = _exchange_packed(staged, mask, axis)
         return (*out, out_mask, overflow[None])
 
     results = exchange(*values, bucket_ids)
@@ -224,13 +286,7 @@ def _build_exchange_program(mesh: Mesh, kinds: Tuple[str, ...], num_buckets: int
             )
             sent = jnp.minimum(counts, capacity)
             overflow = jnp.sum(counts - sent)
-            outs = [
-                jax.lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
-                for s in staged
-            ]
-            out_mask = jax.lax.all_to_all(
-                mask, axis, split_axis=0, concat_axis=0, tiled=True
-            ).reshape(-1)
+            outs, out_mask = _exchange_packed(staged, mask, axis)
             *out_keys, out_ridx, out_buckets = outs
             order = lex_argsort(
                 [(~out_mask).astype(jnp.int32), out_buckets, *out_keys, out_ridx]
@@ -317,13 +373,7 @@ def rebucket_hierarchical(
         staged, mask, counts = _stage_for_exchange([*vals, buckets], dest_local, L, capacity_ici)
         sent = jnp.minimum(counts, capacity_ici)
         overflow = jnp.sum(counts - sent)
-        mid = [
-            jax.lax.all_to_all(s, ici_axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
-            for s in staged
-        ]
-        mid_mask = jax.lax.all_to_all(
-            mask, ici_axis, split_axis=0, concat_axis=0, tiled=True
-        ).reshape(-1)
+        mid, mid_mask = _exchange_packed(staged, mask, ici_axis)
 
         # -- phase 2 (DCN): route to the owner slice; local position is kept
         *mid_vals, mid_buckets = mid
@@ -333,13 +383,7 @@ def rebucket_hierarchical(
         )
         sent2 = jnp.minimum(counts2, capacity_dcn)
         overflow = overflow + jnp.sum(counts2 - sent2)
-        out = [
-            jax.lax.all_to_all(s, dcn_axis, split_axis=0, concat_axis=0, tiled=True).reshape(-1)
-            for s in staged2
-        ]
-        out_mask = jax.lax.all_to_all(
-            mask2, dcn_axis, split_axis=0, concat_axis=0, tiled=True
-        ).reshape(-1)
+        out, out_mask = _exchange_packed(staged2, mask2, dcn_axis)
         *out_vals, out_buckets = out
         return (*out_vals, out_buckets, out_mask, overflow[None])
 
