@@ -143,6 +143,29 @@ def _wire_symbols(lib: ctypes.CDLL) -> None:
             ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+        lib.hsn_snappy_decompress.restype = ctypes.c_int32
+        lib.hsn_snappy_decompress.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int64,
+            ctypes.c_void_p,
+            ctypes.c_int64,
+        ]
+        lib.hsn_snappy_uncompressed_length.restype = ctypes.c_int64
+        lib.hsn_snappy_uncompressed_length.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+
+
+def snappy_decompress(blob: bytes) -> bytes:
+    """Raw-snappy decompression via the native library; raises
+    NativeUnsupported when the library is unavailable (callers fall back to
+    the pure-Python decoder in utils/avro.py)."""
+    lib = _load()
+    n = lib.hsn_snappy_uncompressed_length(blob, len(blob))
+    if n < 0:
+        raise ValueError("snappy: bad length header")
+    out = ctypes.create_string_buffer(n)
+    if lib.hsn_snappy_decompress(blob, len(blob), out, n) != 0:
+        raise ValueError("snappy: malformed input")
+    return out.raw
 
 
 # parquet physical types

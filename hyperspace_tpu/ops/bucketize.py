@@ -57,26 +57,32 @@ def _stage_for_exchange(values, dest, n_dev: int, capacity: int, fill=0, valid=N
     return staged, mask[:-1].reshape(n_dev, capacity), counts
 
 
+_UNSIGNED_BY_WIDTH = {1: "uint8", 2: "uint16", 4: "uint32"}
+
+
 def _to_planes(v):
     """Split an array into bit-exact int32 planes (1 plane for <=32-bit
     dtypes, hi/lo planes for 64-bit) so a whole exchange can ride ONE
-    all_to_all regardless of column dtypes."""
+    all_to_all regardless of column dtypes. Sub-32-bit values travel as
+    their BIT PATTERNS (bitcast to the same-width unsigned, zero-extended) —
+    never value casts, so bfloat16/float16/float8 survive exactly."""
     from jax import lax
 
     dt = v.dtype
     if dt == jnp.bool_:
         return [v.astype(jnp.int32)]
-    if dt.itemsize <= 4:
-        if dt in (jnp.uint32, jnp.float32):
-            return [lax.bitcast_convert_type(v, jnp.int32)]
-        if dt.kind == "f":  # float16/bfloat16: bit-pattern, not value cast
-            width = jnp.uint16 if dt.itemsize == 2 else jnp.uint8
-            return [lax.bitcast_convert_type(v, width).astype(jnp.int32)]
-        return [v.astype(jnp.int32)]  # int32/int16/int8: value-preserving
-    u = lax.bitcast_convert_type(v, jnp.uint64)
-    hi = lax.bitcast_convert_type((u >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32)
-    lo = lax.bitcast_convert_type((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32), jnp.int32)
-    return [hi, lo]
+    if dt.itemsize == 8:
+        u = lax.bitcast_convert_type(v, jnp.uint64)
+        hi = lax.bitcast_convert_type((u >> jnp.uint64(32)).astype(jnp.uint32), jnp.int32)
+        lo = lax.bitcast_convert_type((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32), jnp.int32)
+        return [hi, lo]
+    if dt == jnp.int32:
+        return [v]
+    width = jnp.dtype(_UNSIGNED_BY_WIDTH[dt.itemsize])
+    u = v if dt == width else lax.bitcast_convert_type(v, width)
+    if dt.itemsize == 4:
+        return [lax.bitcast_convert_type(u, jnp.int32)]
+    return [u.astype(jnp.int32)]  # zero-extend the bit pattern
 
 
 def _from_planes(planes, dt):
@@ -86,16 +92,18 @@ def _from_planes(planes, dt):
     dt = jnp.dtype(dt)
     if dt == jnp.bool_:
         return planes[0].astype(jnp.bool_)
-    if dt.itemsize <= 4:
-        if dt in (jnp.uint32, jnp.float32):
-            return lax.bitcast_convert_type(planes[0], dt)
-        if dt.kind == "f":
-            width = jnp.uint16 if dt.itemsize == 2 else jnp.uint8
-            return lax.bitcast_convert_type(planes[0].astype(width), dt)
-        return planes[0].astype(dt)
-    hi = lax.bitcast_convert_type(planes[0], jnp.uint32).astype(jnp.uint64)
-    lo = lax.bitcast_convert_type(planes[1], jnp.uint32).astype(jnp.uint64)
-    return lax.bitcast_convert_type((hi << jnp.uint64(32)) | lo, dt)
+    if dt.itemsize == 8:
+        hi = lax.bitcast_convert_type(planes[0], jnp.uint32).astype(jnp.uint64)
+        lo = lax.bitcast_convert_type(planes[1], jnp.uint32).astype(jnp.uint64)
+        return lax.bitcast_convert_type((hi << jnp.uint64(32)) | lo, dt)
+    if dt == jnp.int32:
+        return planes[0]
+    width = jnp.dtype(_UNSIGNED_BY_WIDTH[dt.itemsize])
+    if dt.itemsize == 4:
+        u = lax.bitcast_convert_type(planes[0], width)
+    else:
+        u = planes[0].astype(width)  # truncate back to the original bits
+    return u if dt == width else lax.bitcast_convert_type(u, dt)
 
 
 def _exchange_packed(staged, mask, axis):

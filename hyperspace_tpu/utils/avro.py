@@ -9,7 +9,8 @@ emits (null codec).
 
 Format: magic "Obj\\x01", file-metadata map (avro.schema JSON + avro.codec),
 16-byte sync marker, then blocks of <count><byte-size><payload><sync>.
-Codecs: null and deflate.
+Codecs: null, deflate, and snappy (raw block + big-endian CRC32 framing;
+decompression via the native library's decoder, pure-Python fallback).
 """
 
 from __future__ import annotations
@@ -22,6 +23,38 @@ import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 MAGIC = b"Obj\x01"
+
+
+def _snappy_decompress(blob: bytes) -> bytes:
+    """Raw-snappy decompression: native (libhs_native) when available, else
+    pyarrow's bundled snappy (an unconditional dependency of this package) —
+    the uncompressed size comes from the raw-format varint preamble."""
+    try:
+        from hyperspace_tpu.native import NativeUnsupported
+        from hyperspace_tpu.native import snappy_decompress as native_snappy
+
+        try:
+            return native_snappy(blob)
+        except NativeUnsupported:
+            pass
+    except ImportError:
+        pass
+    import pyarrow as pa
+
+    n, shift, i = 0, 0, 0
+    while True:
+        if i >= len(blob) or i >= 5:
+            raise ValueError("snappy: bad length header")
+        b = blob[i]
+        i += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    try:
+        return pa.decompress(blob, decompressed_size=n, codec="snappy", asbytes=True)
+    except (pa.lib.ArrowException, OSError) as e:  # ArrowIOError == OSError
+        raise ValueError(f"snappy: malformed block ({e})")
 
 
 # --------------------------------------------------------------------------
@@ -326,6 +359,13 @@ def read_container(path: str) -> Tuple[Dict[str, Any], List[Any]]:
         payload = buf.read(size)
         if codec == "deflate":
             payload = zlib.decompress(payload, -15)
+        elif codec == "snappy":
+            # a raw snappy block followed by the 4-byte big-endian CRC32 of
+            # the uncompressed data (Avro spec's snappy codec framing)
+            crc = int.from_bytes(payload[-4:], "big")
+            payload = _snappy_decompress(payload[:-4])
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise ValueError(f"Avro snappy block CRC mismatch in {path!r}")
         elif codec != "null":
             raise ValueError(f"Unsupported avro codec {codec!r}")
         block = io.BytesIO(payload)

@@ -4,14 +4,15 @@
 // stance: "a C++ Parquet column-chunk decode path into device-feedable
 // buffers"; the reference is 100% JVM and delegates scans to Spark executors,
 // SURVEY.md §0). Decodes flat Parquet columns — PLAIN or RLE_DICTIONARY
-// encoded, UNCOMPRESSED — from an mmap'd file straight into caller-allocated
-// buffers (numpy arrays on the Python side) with zero copies in between, so
-// index scans feed jax.device_put without pyarrow/JVM row pivoting.
+// encoded, UNCOMPRESSED or SNAPPY — from an mmap'd file straight into
+// caller-allocated buffers (numpy arrays on the Python side) with zero copies
+// for uncompressed pages, so index scans feed jax.device_put without
+// pyarrow/JVM row pivoting.
 //
-// Scope is deliberately the framework's own index-file dialect (the bucketed
-// index writer emits uncompressed PLAIN/dictionary pages precisely so this
-// decoder stays simple and fast); anything outside it returns an error and the
-// Python caller falls back to pyarrow.
+// The framework's own index files are written uncompressed (zero-copy fast
+// path); SNAPPY keeps externally-written lake files (Spark's default codec)
+// on the native path too. Anything outside this dialect returns an error and
+// the Python caller falls back to pyarrow.
 //
 // Build: make -C native  (g++ -O3 -shared -fPIC)
 
@@ -208,6 +209,7 @@ struct PageHeader {
   // dictionary
   int32_t dict_num_values = 0;
   int32_t dict_encoding = -1;
+  bool v2_is_compressed = true;  // DataPageHeaderV2.is_compressed (default true)
 };
 
 // Parses the header and advances *pos past it.
@@ -258,6 +260,7 @@ static PageHeader parse_page_header(const uint8_t* base, size_t file_len, size_t
             case 4: h.encoding = static_cast<int32_t>(r.zigzag()); break;
             case 5: h.def_bytes = static_cast<int32_t>(r.zigzag()); break;
             case 6: h.rep_bytes = static_cast<int32_t>(r.zigzag()); break;
+            case 7: h.v2_is_compressed = f2.bool_value; break;
             default: r.skip(f2.type);
           }
         }
@@ -362,6 +365,106 @@ static bool build_leaves(Handle* h) {
   return true;
 }
 
+// ---------------------------------------------------------------------------
+// snappy decompression (raw format; the one codec Spark writes by default, so
+// externally-written lake files stay on this native path instead of falling
+// back to pyarrow. Format: google/snappy format_description.txt)
+// ---------------------------------------------------------------------------
+
+static bool snappy_varint(const uint8_t* src, size_t n, size_t* val, size_t* used) {
+  size_t v = 0;
+  int shift = 0;
+  size_t i = 0;
+  while (i < n && i < 5) {
+    uint8_t b = src[i++];
+    v |= static_cast<size_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *val = v;
+      *used = i;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+// Decompresses `src[0..n)` into `dst[0..dst_len)`; throws on malformed input
+// or any length mismatch.
+static void snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t dst_len) {
+  size_t ulen = 0, hdr = 0;
+  if (!snappy_varint(src, n, &ulen, &hdr)) throw ThriftError("snappy: bad length header");
+  if (ulen != dst_len) throw ThriftError("snappy: uncompressed length mismatch");
+  size_t ip = hdr, op = 0;
+  while (ip < n) {
+    const uint8_t tag = src[ip++];
+    uint32_t len;
+    size_t offset = 0;
+    switch (tag & 3) {
+      case 0: {  // literal; length-1 in high 6 bits (60-63 = extra LE bytes)
+        len = (tag >> 2) + 1;
+        if (len > 60) {
+          const uint32_t extra = len - 60;
+          if (ip + extra > n) throw ThriftError("snappy: truncated literal length");
+          len = 0;
+          for (uint32_t k = 0; k < extra; k++) len |= static_cast<uint32_t>(src[ip + k]) << (8 * k);
+          len += 1;
+          ip += extra;
+        }
+        if (ip + len > n || op + len > dst_len) throw ThriftError("snappy: literal overrun");
+        std::memcpy(dst + op, src + ip, len);
+        ip += len;
+        op += len;
+        continue;
+      }
+      case 1:  // copy, 1-byte offset
+        if (ip >= n) throw ThriftError("snappy: truncated copy");
+        len = 4 + ((tag >> 2) & 0x7);
+        offset = (static_cast<size_t>(tag >> 5) << 8) | src[ip++];
+        break;
+      case 2:  // copy, 2-byte offset
+        if (ip + 2 > n) throw ThriftError("snappy: truncated copy");
+        len = (tag >> 2) + 1;
+        offset = src[ip] | (static_cast<size_t>(src[ip + 1]) << 8);
+        ip += 2;
+        break;
+      default:  // copy, 4-byte offset
+        if (ip + 4 > n) throw ThriftError("snappy: truncated copy");
+        len = (tag >> 2) + 1;
+        offset = src[ip] | (static_cast<size_t>(src[ip + 1]) << 8) |
+                 (static_cast<size_t>(src[ip + 2]) << 16) |
+                 (static_cast<size_t>(src[ip + 3]) << 24);
+        ip += 4;
+        break;
+    }
+    if (offset == 0 || offset > op || op + len > dst_len)
+      throw ThriftError("snappy: bad copy");
+    if (offset >= len) {
+      std::memcpy(dst + op, dst + op - offset, len);
+      op += len;
+    } else {
+      // overlapping copy replicates a period-`offset` pattern; chunked
+      // memcpy with the largest safe multiple of the period (doubles each
+      // round) instead of a byte-wise loop
+      uint8_t* d = dst + op;
+      size_t done = 0;
+      while (done < len) {
+        const size_t D = offset * ((done + offset) / offset);
+        const size_t chunk = std::min(static_cast<size_t>(len) - done, D);
+        std::memcpy(d + done, d + done - D, chunk);
+        done += chunk;
+      }
+      op += len;
+    }
+  }
+  if (op != dst_len) throw ThriftError("snappy: short output");
+}
+
+enum Codec : int32_t { C_UNCOMPRESSED = 0, C_SNAPPY = 1 };
+
+static bool codec_supported(int32_t codec) {
+  return codec == C_UNCOMPRESSED || codec == C_SNAPPY;
+}
+
 // Per-chunk decode state shared by fixed-width and byte-array paths.
 struct ChunkCursor {
   const Handle* h;
@@ -372,6 +475,9 @@ struct ChunkCursor {
   const uint8_t* dict = nullptr;
   int64_t dict_count = 0;
   bool optional;
+  // decompressed page bodies (snappy chunks); dict buffer outlives data pages
+  std::vector<uint8_t> page_scratch;
+  std::vector<uint8_t> dict_scratch;
 
   ChunkCursor(const Handle* h_, const ColumnMeta* cm_, bool opt) : h(h_), cm(cm_), optional(opt) {
     int64_t start = cm->data_page_offset;
@@ -401,21 +507,37 @@ static bool next_data_page(ChunkCursor& c, PageData& out) {
     if (pos + static_cast<size_t>(ph.compressed_size) > c.h->len)
       throw ThriftError("page body extends past EOF");
     c.pos = pos + static_cast<size_t>(ph.compressed_size);
-    if (ph.compressed_size != ph.uncompressed_size)
+    const int32_t codec = c.cm->codec;
+    if (codec == C_UNCOMPRESSED && ph.compressed_size != ph.uncompressed_size)
       throw ThriftError("compressed pages unsupported (codec mismatch)");
 
     if (ph.type == P_DICTIONARY_PAGE) {
       if (ph.dict_encoding != E_PLAIN && ph.dict_encoding != E_PLAIN_DICTIONARY)
         throw ThriftError("non-PLAIN dictionary page");
-      c.dict = body;
+      if (codec == C_SNAPPY) {
+        c.dict_scratch.resize(ph.uncompressed_size);
+        snappy_decompress(body, ph.compressed_size, c.dict_scratch.data(),
+                          ph.uncompressed_size);
+        c.dict = c.dict_scratch.data();
+      } else {
+        c.dict = body;
+      }
       c.dict_count = ph.dict_num_values;
       continue;
     }
     if (ph.type == P_INDEX_PAGE) continue;
 
     if (ph.type == P_DATA_PAGE) {
+      // v1: the whole body (levels + values) is compressed as one block
       const uint8_t* p = body;
       const uint8_t* bend = body + ph.compressed_size;
+      if (codec == C_SNAPPY) {
+        c.page_scratch.resize(ph.uncompressed_size);
+        snappy_decompress(body, ph.compressed_size, c.page_scratch.data(),
+                          ph.uncompressed_size);
+        p = c.page_scratch.data();
+        bend = p + ph.uncompressed_size;
+      }
       out.defs.clear();
       if (c.optional) {
         if (ph.def_encoding != E_RLE) throw ThriftError("non-RLE definition levels");
@@ -439,11 +561,29 @@ static bool next_data_page(ChunkCursor& c, PageData& out) {
       const uint8_t* bend = body + ph.compressed_size;
       if (ph.rep_bytes > 0) throw ThriftError("repetition levels unsupported");
       out.defs.clear();
+      if (ph.def_bytes < 0 || ph.rep_bytes < 0 ||
+          static_cast<int64_t>(ph.def_bytes) + ph.rep_bytes > ph.compressed_size ||
+          static_cast<int64_t>(ph.def_bytes) + ph.rep_bytes > ph.uncompressed_size)
+        throw ThriftError("v2 page level sizes exceed page body");
       if (c.optional) {
         out.defs.resize(ph.num_values);
         decode_rle_hybrid(p, p + ph.def_bytes, 1, ph.num_values, out.defs.data());
       }
       p += ph.def_bytes;
+      if (codec == C_SNAPPY && ph.v2_is_compressed) {
+        // v2 keeps rep/def levels uncompressed; only the values section is
+        // a snappy block
+        const size_t vals_unc = static_cast<size_t>(ph.uncompressed_size) -
+                                static_cast<size_t>(ph.def_bytes) -
+                                static_cast<size_t>(ph.rep_bytes);
+        c.page_scratch.resize(vals_unc);
+        snappy_decompress(p, static_cast<size_t>(bend - p), c.page_scratch.data(), vals_unc);
+        out.values = c.page_scratch.data();
+        out.values_len = vals_unc;
+        out.num_values = ph.num_values;
+        out.encoding = ph.encoding;
+        return true;
+      }
       out.values = p;
       out.values_len = static_cast<size_t>(bend - p);
       out.num_values = ph.num_values;
@@ -558,7 +698,8 @@ int64_t hsn_read_fixed(void* hp, int32_t col, void* out, uint8_t* validity) {
     for (const auto& rg : h->meta.row_groups) {
       if (col >= (int32_t)rg.columns.size()) throw ThriftError("row group missing column");
       const ColumnMeta& cm = rg.columns[col];
-      if (cm.codec != 0) throw ThriftError("compressed chunks unsupported");
+      if (!codec_supported(cm.codec))
+        throw ThriftError("unsupported codec " + std::to_string(cm.codec));
       ChunkCursor cur(h, &cm, optional);
       PageData pd;
       std::vector<int32_t> idx;
@@ -607,6 +748,23 @@ int64_t hsn_read_fixed(void* hp, int32_t col, void* out, uint8_t* validity) {
               }
               if (validity) validity[row + k] = pd.defs[k] != 0;
             }
+          }
+          row += n;
+        } else if (pd.encoding == E_RLE && se.type == T_BOOLEAN) {
+          // RLE boolean values (data page v2 writes booleans this way):
+          // 4-byte LE length prefix, then RLE/bit-packed hybrid at width 1
+          if (pd.values_len < 4) throw ThriftError("truncated RLE boolean page");
+          uint32_t rlen;
+          std::memcpy(&rlen, pd.values, 4);
+          if (pd.values_len < 4 + static_cast<size_t>(rlen))
+            throw ThriftError("truncated RLE boolean page body");
+          idx.assign(present, 0);
+          decode_rle_hybrid(pd.values + 4, pd.values + 4 + rlen, 1, present, idx.data());
+          int64_t vi = 0;
+          for (int64_t k = 0; k < n; k++) {
+            bool v = pd.defs.empty() || pd.defs[k] != 0;
+            dst[row + k] = v ? static_cast<uint8_t>(idx[vi++]) : 0;
+            if (validity) validity[row + k] = v;
           }
           row += n;
         } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
@@ -665,7 +823,8 @@ int64_t hsn_read_binary(void* hp, int32_t col, int64_t* offsets, uint8_t* data,
     for (const auto& rg : h->meta.row_groups) {
       if (col >= (int32_t)rg.columns.size()) throw ThriftError("row group missing column");
       const ColumnMeta& cm = rg.columns[col];
-      if (cm.codec != 0) throw ThriftError("compressed chunks unsupported");
+      if (!codec_supported(cm.codec))
+        throw ThriftError("unsupported codec " + std::to_string(cm.codec));
       ChunkCursor cur(h, &cm, optional);
       PageData pd;
       std::vector<int32_t> idx;
@@ -793,6 +952,27 @@ int64_t hsn_expand_pairs(const int32_t* lo, const int32_t* hi, int64_t n,
     }
   }
   return off;
+}
+
+// Standalone raw-snappy decompression (used by the Python Avro codec for
+// snappy-compressed blocks; Avro frames carry the uncompressed size via the
+// snappy preamble). Returns 0 on success, -1 on malformed input.
+int32_t hsn_snappy_decompress(const uint8_t* src, int64_t src_len, uint8_t* dst,
+                              int64_t dst_len) {
+  try {
+    hsn::snappy_decompress(src, static_cast<size_t>(src_len), dst,
+                           static_cast<size_t>(dst_len));
+    return 0;
+  } catch (const std::exception&) {
+    return -1;
+  }
+}
+
+// Uncompressed length from a raw-snappy preamble; -1 on malformed input.
+int64_t hsn_snappy_uncompressed_length(const uint8_t* src, int64_t src_len) {
+  size_t val = 0, used = 0;
+  if (!hsn::snappy_varint(src, static_cast<size_t>(src_len), &val, &used)) return -1;
+  return static_cast<int64_t>(val);
 }
 
 }  // extern "C"

@@ -143,12 +143,17 @@ class TestPlanePacking:
             (np.uint32, [0, 1, 2**32 - 1]),
             (np.float32, [-1.5, 0.0, np.nan, 3.4e38]),
             (np.float16, [-1.5, 0.25, np.nan, 65504.0]),
+            ("bfloat16", [-1.5, 0.25, float("nan"), 3.0e38]),
             (np.int16, [-(2**15), -1, 0, 2**15 - 1]),
             (np.int8, [-128, -1, 0, 127]),
             (np.bool_, [True, False, True]),
         ],
     )
     def test_roundtrip_bit_exact(self, dtype, vals):
+        if dtype == "bfloat16":
+            import ml_dtypes
+
+            dtype = ml_dtypes.bfloat16
         v = jnp.asarray(np.array(vals, dtype=dtype))
         planes = bz._to_planes(v)
         back = bz._from_planes(planes, dtype)

@@ -103,11 +103,63 @@ def test_nulls(tmp_path):
     assert np.array_equal(np.isnan(got["k"]), np.isnan(exp_k))
 
 
-def test_compressed_falls_back(tmp_path, sample_table):
-    """Snappy files are outside the native dialect; read_parquet_batch must
-    still return correct data through the pyarrow fallback."""
+@pytest.fixture()
+def no_pyarrow_fallback(monkeypatch):
+    """Make the pyarrow fallback in read_parquet_batch fail loudly, so a test
+    passing under this fixture proves the NATIVE path decoded the file."""
+    from hyperspace_tpu.exec import io as hs_io
+
+    class _Boom:
+        def dataset(self, *a, **k):
+            raise AssertionError("pyarrow fallback used; expected native decode")
+
+        def __getattr__(self, name):
+            raise AssertionError("pyarrow fallback used; expected native decode")
+
+    monkeypatch.setattr(hs_io, "pads", _Boom())
+
+
+def test_snappy_plain_decodes_natively(tmp_path, sample_table, no_pyarrow_fallback):
+    """Snappy is Spark's default output codec: externally-written lake files
+    stay on the native path (round-3 VERDICT item; ref: Spark/parquet-mr
+    write SNAPPY by default)."""
     p = str(tmp_path / "snappy.parquet")
-    pq.write_table(sample_table, p, compression="SNAPPY")
+    pq.write_table(sample_table, p, compression="SNAPPY", use_dictionary=False)
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_snappy_dictionary_decodes_natively(tmp_path, sample_table, no_pyarrow_fallback):
+    p = str(tmp_path / "snappy_dict.parquet")
+    pq.write_table(sample_table, p, compression="SNAPPY", use_dictionary=True)
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_snappy_nulls(tmp_path, no_pyarrow_fallback):
+    t = pa.table(
+        {
+            "k": pa.array([1, None, 3, None, 5], type=pa.int64()),
+            "s": pa.array(["a", None, "c", None, "e"]),
+        }
+    )
+    p = str(tmp_path / "snappy_nulls.parquet")
+    pq.write_table(t, p, compression="SNAPPY")
+    got = read_parquet_batch([p], ["k", "s"])
+    exp = pq.read_table(p)
+    exp_k = exp["k"].to_numpy(zero_copy_only=False)
+    assert np.array_equal(np.isnan(got["k"]), np.isnan(exp_k))
+    assert got["s"][1] is None and got["s"][2] == "c"
+
+
+def test_snappy_data_page_v2(tmp_path, sample_table, no_pyarrow_fallback):
+    p = str(tmp_path / "snappy_v2.parquet")
+    pq.write_table(sample_table, p, compression="SNAPPY", data_page_version="2.0")
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_unsupported_codec_falls_back(tmp_path, sample_table):
+    """Codecs outside the native dialect (gzip) still fall back to pyarrow."""
+    p = str(tmp_path / "gzip.parquet")
+    pq.write_table(sample_table, p, compression="GZIP")
     with pytest.raises(native.NativeUnsupported):
         native.read_columns(p, ["i64"])
     _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
