@@ -84,3 +84,38 @@ def gen_sample(root: str, n: int = 100_000, num_files: int = 4, seed: int = 2) -
         )
         pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
     return d
+
+
+CUSTOMER_ROWS_SF1 = 150_000
+
+
+def gen_customer(root: str, sf: float, num_files: int = 4, seed: int = 3) -> str:
+    """TPC-H-like customer with string-heavy payload columns (name, address,
+    market segment) for the string-payload join benchmark (round-3 VERDICT
+    item: size the host-side string-gather cost of device materialization)."""
+    d = os.path.join(root, "customer")
+    os.makedirs(d, exist_ok=True)
+    # key-domain floor must match gen_orders' o_custkey domain
+    # (150_000 * max(sf, 0.01)) or small-sf joins silently lose most matches
+    n = max(1, int(CUSTOMER_ROWS_SF1 * max(sf, 0.01)))
+    per = max(1, n // num_files)
+    rng = np.random.default_rng(seed)
+    segments = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"])
+    for i in range(num_files):
+        rows = per if i < num_files - 1 else n - per * (num_files - 1)
+        if rows <= 0:
+            continue
+        keys = np.arange(i * per, i * per + rows, dtype=np.int64)
+        t = pa.table(
+            {
+                "c_custkey": keys,
+                "c_name": np.array([f"Customer#{k:09d}" for k in keys]),
+                "c_address": np.array(
+                    [f"{rng.integers(1, 9999)} Market St Apt {k % 97}" for k in keys]
+                ),
+                "c_mktsegment": segments[rng.integers(0, 5, rows)],
+                "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, rows), 2),
+            }
+        )
+        pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+    return d

@@ -230,8 +230,43 @@ def config5(root, args):
           {"sf": args.sf, "incremental_refresh_s": round(refresh_s, 3)})
 
 
+def config6(root, args):
+    """String-payload-heavy indexed join (round-3 VERDICT item 7): orders
+    joined to customer carrying c_name/c_address/c_mktsegment as included
+    columns. Device materialization gathers numeric columns on device but
+    string columns host-side by downloaded index arrays (exec/device.py);
+    this config measures that cost so the decision to (not) dictionary-code
+    device string gathers is recorded with a number."""
+    o_d = datagen.gen_orders(root, args.sf)
+    c_d = datagen.gen_customer(root, args.sf)
+    sess, hs, hst = _session(root)
+    o = sess.read_parquet(o_d)
+    c = sess.read_parquet(c_d)
+    hs.create_index(
+        o, hst.CoveringIndexConfig("o_ck6", ["o_custkey"], ["o_totalprice"])
+    )
+    hs.create_index(
+        c,
+        hst.CoveringIndexConfig(
+            "c_ck6", ["c_custkey"], ["c_name", "c_address", "c_mktsegment", "c_acctbal"]
+        ),
+    )
+    q = o.join(c, on=hst.col("o_custkey") == hst.col("c_custkey")).select(
+        "o_totalprice", "c_name", "c_address", "c_mktsegment"
+    )
+    ti, tp = _ab(sess, q, args.reps)
+    # numeric-only variant of the same join sizes the string-gather delta
+    qn = o.join(c, on=hst.col("o_custkey") == hst.col("c_custkey")).select(
+        "o_totalprice", "c_acctbal"
+    )
+    tin, _ = _ab(sess, qn, args.reps)
+    _emit(6, "string_payload_join_latency", ti, tp,
+          {"sf": args.sf, "numeric_only_ms": round(tin[0] * 1000, 4),
+           "string_gather_overhead_x": round(ti[0] / max(tin[0], 1e-9), 3)})
+
+
 CONFIGS = {"config1": config1, "config2": config2, "config3": config3,
-           "config4": config4, "config5": config5}
+           "config4": config4, "config5": config5, "config6": config6}
 
 
 def main():

@@ -162,6 +162,11 @@ def snappy_decompress(blob: bytes) -> bytes:
     n = lib.hsn_snappy_uncompressed_length(blob, len(blob))
     if n < 0:
         raise ValueError("snappy: bad length header")
+    # the varint comes from untrusted input: a corrupt header must not drive
+    # a multi-GB allocation (snappy can expand at most ~255x per the format's
+    # max copy/literal ratios; 1 GiB also caps any legitimate Avro block)
+    if n > max(len(blob) * 256, 1 << 30):
+        raise ValueError(f"snappy: implausible uncompressed length {n}")
     out = ctypes.create_string_buffer(n)
     if lib.hsn_snappy_decompress(blob, len(blob), out, n) != 0:
         raise ValueError("snappy: malformed input")

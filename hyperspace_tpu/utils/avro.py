@@ -51,6 +51,8 @@ def _snappy_decompress(blob: bytes) -> bytes:
         if not b & 0x80:
             break
         shift += 7
+    if n > max(len(blob) * 256, 1 << 30):  # untrusted varint: cap allocation
+        raise ValueError(f"snappy: implausible uncompressed length {n}")
     try:
         return pa.decompress(blob, decompressed_size=n, codec="snappy", asbytes=True)
     except (pa.lib.ArrowException, OSError) as e:  # ArrowIOError == OSError

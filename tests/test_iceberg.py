@@ -96,6 +96,33 @@ class TestAvroCodec:
         assert rschema == schema
         assert rrecords == records
 
+    @staticmethod
+    def _write_snappy_container(path, schema, block_count, framed):
+        """Hand-frame a snappy-codec Avro container around one pre-framed
+        block (raw snappy + big-endian CRC32)."""
+        import io as _io
+        import json as _json
+
+        body = _io.BytesIO()
+        body.write(avro.MAGIC)
+        body.write(b"\x04")  # metadata map block count 2 (zigzag)
+        for k, v in {
+            "avro.schema": _json.dumps(schema).encode(),
+            "avro.codec": b"snappy",
+        }.items():
+            kb = k.encode()
+            avro._write_long(body, len(kb)); body.write(kb)
+            avro._write_long(body, len(v)); body.write(v)
+        body.write(b"\x00")
+        sync = b"S" * 16
+        body.write(sync)
+        avro._write_long(body, block_count)
+        avro._write_long(body, len(framed))
+        body.write(framed)
+        body.write(sync)
+        with open(path, "wb") as f:
+            f.write(body.getvalue())
+
     def test_snappy_codec_blocks(self, tmp_path):
         """Snappy-codec Avro containers (raw snappy block + big-endian CRC32
         framing per the Avro spec) decode — both through the native snappy
@@ -111,26 +138,6 @@ class TestAvroCodec:
             "fields": [{"name": "s", "type": "string"}, {"name": "l", "type": "long"}],
         }
         records = [{"s": f"row_{i % 7}", "l": i * 1000} for i in range(500)]
-        # hand-frame a snappy container using pyarrow's real snappy compressor
-        plain_path = str(tmp_path / "plain.avro")
-        avro.write_container(plain_path, schema, records)
-        # re-encode the plain file's single block as snappy
-        import json as _json
-
-        body = _io.BytesIO()
-        body.write(avro.MAGIC)
-        meta = {
-            "avro.schema": _json.dumps(schema).encode(),
-            "avro.codec": b"snappy",
-        }
-        body.write(b"\x04")  # map block count 2 (zigzag of 2 = 4)
-        for k, v in meta.items():
-            kb = k.encode()
-            avro._write_long(body, len(kb)); body.write(kb)
-            avro._write_long(body, len(v)); body.write(v)
-        body.write(b"\x00")
-        sync = b"S" * 16
-        body.write(sync)
         payload = _io.BytesIO()
         names = {}
         for r in records:
@@ -138,13 +145,8 @@ class TestAvroCodec:
         plain = payload.getvalue()
         comp = pa.compress(plain, codec="snappy", asbytes=True)
         framed = comp + (zlib.crc32(plain) & 0xFFFFFFFF).to_bytes(4, "big")
-        avro._write_long(body, len(records))
-        avro._write_long(body, len(framed))
-        body.write(framed)
-        body.write(sync)
         path = str(tmp_path / "snappy.avro")
-        with open(path, "wb") as f:
-            f.write(body.getvalue())
+        self._write_snappy_container(path, schema, len(records), framed)
 
         rschema, rrecords = avro.read_container(path)
         assert rschema == schema
@@ -166,41 +168,18 @@ class TestAvroCodec:
             native_mod.snappy_decompress = real
 
     def test_snappy_crc_mismatch_raises(self, tmp_path):
-        import zlib
+        import io as _io
+        import zlib  # noqa: F401
 
         import pyarrow as pa
 
         schema = {"type": "record", "name": "t", "fields": [{"name": "l", "type": "long"}]}
-        # valid container then corrupt the CRC
-        import io as _io2
-        _b = _io2.BytesIO()
+        _b = _io.BytesIO()
         avro._write_long(_b, 42)
         plain = _b.getvalue()
         comp = pa.compress(plain, codec="snappy", asbytes=True)
-        bad = comp + b"\x00\x00\x00\x00"
-        import io as _io
-        import json as _json
-
-        body = _io.BytesIO()
-        body.write(avro.MAGIC)
-        body.write(b"\x04")
-        for k, v in {
-            "avro.schema": _json.dumps(schema).encode(),
-            "avro.codec": b"snappy",
-        }.items():
-            kb = k.encode()
-            avro._write_long(body, len(kb)); body.write(kb)
-            avro._write_long(body, len(v)); body.write(v)
-        body.write(b"\x00")
-        sync = b"S" * 16
-        body.write(sync)
-        avro._write_long(body, 1)
-        avro._write_long(body, len(bad))
-        body.write(bad)
-        body.write(sync)
         path = str(tmp_path / "bad.avro")
-        with open(path, "wb") as f:
-            f.write(body.getvalue())
+        self._write_snappy_container(path, schema, 1, comp + b"\x00\x00\x00\x00")
         with pytest.raises(ValueError, match="CRC"):
             avro.read_container(path)
 
