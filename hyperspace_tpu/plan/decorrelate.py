@@ -30,6 +30,7 @@ from typing import Dict, List, Optional, Tuple
 from hyperspace_tpu.plan.expr import (
     BinaryOp,
     Col,
+    CorrelatedInSubquery,
     CorrelatedScalarSubquery,
     ExistsSubquery,
     Expr,
@@ -215,6 +216,55 @@ def decorrelate_exists(iq, views, session, outer_resolve) -> ExistsSubquery:
     return ExistsSubquery(
         outer_keys, inner_df.plan, key_cols, residual_expr, residual_outer, session
     )
+
+
+def decorrelate_in(child: Expr, iq, views, session, outer_resolve) -> CorrelatedInSubquery:
+    """Rewrite ``x IN (SELECT v FROM ... WHERE outer.k = inner.k ...)`` to a
+    CorrelatedInSubquery (group membership with three-valued semantics; the
+    reference inherits Spark's null-aware semi/anti join for this)."""
+    from hyperspace_tpu.plan.sql import SelectItem, SqlError, _resolve_expr_refs, plan_query
+
+    if iq.unions or iq.group_by or iq.having is not None or iq.items is None:
+        raise SqlError(
+            "Correlated IN subqueries with set operations, GROUP BY, or "
+            "SELECT * are not supported"
+        )
+    if len(iq.items) != 1:
+        raise SqlError("An IN subquery must select exactly one column")
+    if iq.limit is not None:
+        # unlike EXISTS (any row at all), LIMIT changes the membership set;
+        # dropping it silently would change results
+        raise SqlError("Correlated IN subqueries with LIMIT are not supported")
+    from hyperspace_tpu.plan.sql import _contains_agg
+
+    if _contains_agg(iq.items[0].expr):
+        raise SqlError("Aggregates in correlated IN subqueries are not supported")
+    try:
+        scope, inner_preds, correlated = _split_correlation(iq, views)
+        pairs, residual_terms = _equi_pairs_and_residual(correlated, scope)
+    except _Unsupported as e:
+        raise SqlError(f"Unsupported correlated IN subquery: {e}")
+    if residual_terms or not pairs:
+        raise SqlError(
+            "Correlated IN subqueries support only equality correlation "
+            "(outer.col = inner.col)"
+        )
+    key_cols = [f"__k{i}" for i in range(len(pairs))]
+    dq = copy.copy(iq)
+    dq.ctes = []
+    dq.items = [
+        SelectItem(Col(inner_name), kc, inner_name)
+        for kc, (_, inner_name) in zip(key_cols, pairs)
+    ] + [SelectItem(iq.items[0].expr, "__inval", iq.items[0].text)]
+    dq.distinct = True  # membership: one row per distinct (keys, value) tuple
+    w: Optional[Expr] = None
+    for t in inner_preds:
+        w = t if w is None else (w & t)
+    dq.where = w
+    dq.order_by, dq.limit = [], None
+    inner_df = plan_query(dq, views)
+    outer_keys = [_resolve_expr_refs(oe, outer_resolve) for oe, _ in pairs]
+    return CorrelatedInSubquery(child, outer_keys, inner_df.plan, key_cols, "__inval", session)
 
 
 def _empty_group_default(expr: Expr):

@@ -929,6 +929,31 @@ class CorrelatedScalarSubquery(SubqueryExpr):
         return f"correlated-scalar-subquery[keys={self.key_cols}; {self.plan_summary()}]"
 
 
+def _correlation_frames(outer_keys, key_cols, inner, batch):
+    """Shared scaffolding for the correlated subquery marks: broadcast and
+    evaluate the outer correlation keys, build the outer (left) frame with a
+    ``__row`` id, the inner (right) frame keyed by ``key_cols``, and the
+    NULL-key masks (a NULL correlation key never matches on either side).
+    Returns (n, left_df, right_df, outer_null_mask); right rows with NULL
+    keys are already dropped."""
+    import pandas as pd
+
+    n = _batch_rows(batch)
+    okeys = [_broadcast_rows(k.eval(batch), n) for k in outer_keys]
+    omiss = np.zeros(n, dtype=bool)
+    for k in okeys:
+        omiss |= _missing_mask(k)
+    left = pd.DataFrame({kc: k for kc, k in zip(key_cols, okeys)})
+    left["__row"] = np.arange(n)
+    right = pd.DataFrame({kc: np.asarray(inner[kc]) for kc in key_cols})
+    imiss = np.zeros(len(right), dtype=bool)
+    for kc in key_cols:
+        imiss |= _missing_mask(np.asarray(inner[kc]))
+    if imiss.any():
+        right = right[~imiss]
+    return n, left, right, omiss, imiss
+
+
 class ExistsSubquery(SubqueryExpr):
     """Decorrelated EXISTS mark (semi-join membership; the reference gets
     these from Spark's RewritePredicateSubquery as left-semi/anti joins;
@@ -970,34 +995,21 @@ class ExistsSubquery(SubqueryExpr):
         return got
 
     def eval(self, batch: Dict[str, np.ndarray]) -> np.ndarray:
-        import pandas as pd
-
         inner = self._exec_inner()
-        n = _batch_rows(batch)
         if not self.key_cols:
             # uncorrelated EXISTS: a constant row-existence mark
             any_row = any(getattr(c, "shape", (0,))[0] for c in inner.values())
-            return np.full(n, bool(any_row))
-        knames = [f"__k{i}" for i in range(len(self.key_cols))]
-        okeys = [_broadcast_rows(k.eval(batch), n) for k in self.outer_keys]
-        omiss = np.zeros(n, dtype=bool)
-        for k in okeys:
-            omiss |= _missing_mask(k)
-        left = pd.DataFrame({kn: k for kn, k in zip(knames, okeys)})
+            return np.full(_batch_rows(batch), bool(any_row))
+        n, left, right, omiss, imiss = _correlation_frames(
+            self.outer_keys, self.key_cols, inner, batch
+        )
         for ph, e in self.residual_outer:
             left[ph] = _broadcast_rows(e.eval(batch), n)
-        left["__row"] = np.arange(n)
-        rcols = {kn: np.asarray(inner[kc]) for kn, kc in zip(knames, self.key_cols)}
-        for c in inner:
+        for c in inner:  # residual inner columns ride along
             if c not in self.key_cols and not c.startswith("__input"):
-                rcols[c] = np.asarray(inner[c])
-        right = pd.DataFrame(rcols)
-        imiss = np.zeros(len(right), dtype=bool)
-        for kn in knames:
-            imiss |= _missing_mask(rcols[kn])
-        if imiss.any():
-            right = right[~imiss]
-        merged = left.merge(right, on=knames, how="inner")
+                col_ = np.asarray(inner[c])
+                right[c] = col_[~imiss] if imiss.any() else col_
+        merged = left.merge(right, on=self.key_cols, how="inner")
         mask = np.zeros(n, dtype=bool)
         if len(merged):
             if self.residual is not None:
@@ -1013,6 +1025,86 @@ class ExistsSubquery(SubqueryExpr):
     def __repr__(self) -> str:
         res = f", residual={self.residual!r}" if self.residual is not None else ""
         return f"exists-subquery[keys={self.key_cols}{res}; {self.plan_summary()}]"
+
+
+class CorrelatedInSubquery(SubqueryExpr):
+    """Decorrelated correlated IN: ``x IN (SELECT v FROM ... WHERE
+    outer.k = inner.k AND ...)`` with full three-valued SQL semantics per
+    outer row over its correlation group S = {v of matching inner rows}:
+    TRUE on a non-NULL match; UNKNOWN when nothing matched but S contains
+    NULL, or x is NULL and S is non-empty; FALSE otherwise (including empty
+    S, even for NULL x). NOT IN composes through Kleene Not (the reference
+    gets this from Spark's null-aware anti join)."""
+
+    def __init__(self, child: Expr, outer_keys, plan, key_cols, value_col: str, session):
+        super().__init__(plan, session)
+        self.child = child
+        self.outer_keys = list(outer_keys)
+        self.key_cols = list(key_cols)
+        self.value_col = value_col
+
+    def children(self) -> Sequence[Expr]:
+        return (self.child, *self.outer_keys)
+
+    def with_plan(self, plan) -> "CorrelatedInSubquery":
+        return CorrelatedInSubquery(
+            self.child, self.outer_keys, plan, self.key_cols, self.value_col, self.session
+        )
+
+    def _exec_inner(self):
+        from hyperspace_tpu.exec.executor import Executor
+
+        cache = getattr(_subquery_scope, "cache", None)
+        if cache is not None and id(self) in cache:
+            return cache[id(self)]
+        cols = [*self.key_cols, self.value_col]
+        got = Executor(self.session).execute(self.plan, required_columns=cols)
+        if cache is not None:
+            cache[id(self)] = got
+        return got
+
+    def eval(self, batch: Dict[str, np.ndarray]):
+        inner = self._exec_inner()
+        n, left, right, omiss, imiss = _correlation_frames(
+            self.outer_keys, self.key_cols, inner, batch
+        )
+        x = _broadcast_rows(self.child.eval(batch), n)
+        x_null = _missing_mask(x)
+        left["__x"] = x
+        vals = np.asarray(inner[self.value_col])
+        vnull_all = _missing_mask(vals)
+        if imiss.any():
+            vals, vnull_all = vals[~imiss], vnull_all[~imiss]
+        right["__v"] = vals
+        right["__vnull"] = vnull_all
+        value = np.zeros(n, dtype=bool)
+        unknown = np.zeros(n, dtype=bool)
+        if len(right):
+            merged = left.merge(right, on=self.key_cols)
+            if len(merged):
+                mx = merged["__x"].to_numpy()
+                mv = merged["__v"].to_numpy()
+                vnull = merged["__vnull"].to_numpy(dtype=bool)
+                both = ~(_missing_mask(mx) | vnull)
+                pair_match = np.zeros(len(merged), dtype=bool)
+                pair_match[both] = mx[both] == mv[both]
+                rows = merged["__row"].to_numpy()
+                np.logical_or.at(value, rows, pair_match)
+                has_null_in_group = np.zeros(n, dtype=bool)
+                np.logical_or.at(has_null_in_group, rows, vnull)
+                nonempty = np.zeros(n, dtype=bool)
+                nonempty[np.unique(rows)] = True
+                unknown = ~value & (has_null_in_group | (x_null & nonempty))
+        # NULL outer correlation key: the correlation equality is never true,
+        # so S is empty -> definite FALSE
+        value &= ~omiss
+        unknown &= ~omiss
+        if unknown.any():
+            return NullableBool(value, unknown)
+        return value
+
+    def __repr__(self) -> str:
+        return f"({self.child!r} IN correlated-subquery[keys={self.key_cols}; {self.plan_summary()}])"
 
 
 def _wrap(x: Any) -> Expr:
@@ -1098,6 +1190,12 @@ def rewrite_columns(e: Expr, mapping: Dict[str, str]) -> Expr:
             e.plan, e.key_cols, e.residual,
             [(ph, rewrite_columns(x, mapping)) for ph, x in e.residual_outer],
             e.session,
+        )
+    if isinstance(e, CorrelatedInSubquery):
+        return CorrelatedInSubquery(
+            rewrite_columns(e.child, mapping),
+            [rewrite_columns(k, mapping) for k in e.outer_keys],
+            e.plan, e.key_cols, e.value_col, e.session,
         )
     if isinstance(e, Case):
         return Case(

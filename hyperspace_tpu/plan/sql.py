@@ -961,7 +961,11 @@ def _map_expr(e: Expr, fn) -> Expr:
         return Like(rec(e.child), e.pattern)
     if isinstance(e, InSubquery):
         return InSubquery(rec(e.child), e.plan, e.session)
-    from hyperspace_tpu.plan.expr import CorrelatedScalarSubquery, ExistsSubquery
+    from hyperspace_tpu.plan.expr import (
+        CorrelatedInSubquery,
+        CorrelatedScalarSubquery,
+        ExistsSubquery,
+    )
 
     if isinstance(e, CorrelatedScalarSubquery):
         return CorrelatedScalarSubquery(
@@ -975,6 +979,10 @@ def _map_expr(e: Expr, fn) -> Expr:
             e.residual,
             [(ph, rec(x)) for ph, x in e.residual_outer],
             e.session,
+        )
+    if isinstance(e, CorrelatedInSubquery):
+        return CorrelatedInSubquery(
+            rec(e.child), [rec(k) for k in e.outer_keys], e.plan, e.key_cols, e.value_col, e.session
         )
     return e
 
@@ -1011,6 +1019,7 @@ def _bind_subqueries(e: Expr, views, session, outer_resolve=None) -> Expr:
     maps their outer references to actual outer-frame columns."""
     from hyperspace_tpu.plan.decorrelate import (
         decorrelate_exists,
+        decorrelate_in,
         decorrelate_scalar,
         is_correlated,
     )
@@ -1026,14 +1035,11 @@ def _bind_subqueries(e: Expr, views, session, outer_resolve=None) -> Expr:
         if isinstance(x, _ExistsQuery):
             return decorrelate_exists(x.query, views, session, identity)
         if isinstance(x, _InQuery):
+            child = _bind_subqueries(x.child, views, session, outer_resolve)
             if is_correlated(x.query, views):
-                raise SqlError(
-                    "Correlated IN subqueries are not supported; rewrite as EXISTS"
-                )
+                return decorrelate_in(child, x.query, views, session, identity)
             inner = plan_query(x.query, views)
-            return InSubquery(
-                _bind_subqueries(x.child, views, session, outer_resolve), inner.plan, session
-            )
+            return InSubquery(child, inner.plan, session)
         return None
 
     return _map_expr(e, leaf)

@@ -201,12 +201,76 @@ class TestCorrelatedScalar:
                 "(SELECT ramt FROM returns r WHERE o.cust = r.rcust)"
             ).collect()
 
-    def test_correlated_in_rejected_with_hint(self, session, orders_returns):
-        with pytest.raises(SqlError, match="rewrite as EXISTS"):
-            session.sql(
-                "SELECT ok FROM orders o WHERE ok IN "
-                "(SELECT rok FROM returns r WHERE o.cust = r.rcust)"
-            ).collect()
+    def test_correlated_in(self, session, orders_returns):
+        od, rd = orders_returns
+        got = session.sql(
+            "SELECT ok FROM orders o WHERE ok IN "
+            "(SELECT rok FROM returns r WHERE o.cust = r.rcust)"
+        ).collect()
+        keys = set(zip(rd.rcust, rd.rok))
+        expect = od[[(c, k) in keys for c, k in zip(od.cust, od.ok)]].ok
+        assert sorted(got["ok"].tolist()) == sorted(expect.tolist())
+
+
+class TestCorrelatedIn:
+    """Three-valued correlated IN / NOT IN (Spark: null-aware semi/anti
+    join) against a hand-computed oracle over a fixture with NULLs on both
+    sides."""
+
+    @pytest.fixture()
+    def tn(self, session, tmp_path):
+        t = pa.table(
+            {
+                "k": np.array([1, 1, 2, 2, 3], dtype=np.int64),
+                "x": np.array([10.0, np.nan, 10.0, 99.0, 5.0]),
+            }
+        )
+        u = pa.table(
+            {
+                "uk": np.array([1, 1, 2, 2], dtype=np.int64),
+                "uv": np.array([10.0, 20.0, np.nan, 7.0]),
+            }
+        )
+        for name, tab in (("t", t), ("u", u)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(tab, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+        return t.to_pandas(), u.to_pandas()
+
+    def test_in_three_valued(self, session, tn):
+        # row (1,10): S={10,20} -> TRUE
+        # row (1,NULL): S nonempty -> UNKNOWN -> excluded
+        # row (2,10): S={NULL,7}, no match but NULL in S -> UNKNOWN -> excluded
+        # row (2,99): same -> UNKNOWN -> excluded
+        # row (3,5): S empty -> FALSE -> excluded
+        got = session.sql(
+            "SELECT k, x FROM t WHERE x IN (SELECT uv FROM u WHERE t.k = u.uk)"
+        ).collect()
+        assert got["k"].tolist() == [1] and got["x"].tolist() == [10.0]
+
+    def test_not_in_three_valued(self, session, tn):
+        # NOT IN keeps only rows where IN is definitely FALSE:
+        # row (3,5): S empty -> IN=FALSE -> NOT IN=TRUE (the only survivor);
+        # unknowns (NULL x with nonempty S, NULL in S) stay excluded
+        got = session.sql(
+            "SELECT k, x FROM t WHERE NOT x IN (SELECT uv FROM u WHERE t.k = u.uk)"
+        ).collect()
+        assert got["k"].tolist() == [3] and got["x"].tolist() == [5.0]
+
+    def test_null_outer_key_is_definite_false(self, session, tmp_path):
+        t = pa.table({"k": np.array([1.0, np.nan]), "x": np.array([10.0, 10.0])})
+        u = pa.table({"uk": np.array([1.0, 2.0]), "uv": np.array([10.0, 10.0])})
+        for name, tab in (("t2", t), ("u2", u)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(tab, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+        # NULL correlation key -> empty S -> IN is FALSE -> NOT IN keeps it
+        got = session.sql(
+            "SELECT x FROM t2 WHERE NOT x IN (SELECT uv FROM u2 WHERE t2.k = u2.uk)"
+        ).collect()
+        assert len(got["x"]) == 1
 
 
 class TestDecorrelationWithIndexes:
@@ -295,3 +359,17 @@ class TestReviewRegressions:
         mapped = t.cust.map(rt)
         expect = t[(t.amt > mapped) & mapped.notna()]
         assert sorted(got["cust"].tolist()) == sorted(expect.cust.tolist())
+
+    def test_limit_in_correlated_in_rejected(self, session, orders_returns):
+        with pytest.raises(SqlError, match="LIMIT"):
+            session.sql(
+                "SELECT ok FROM orders o WHERE ok IN "
+                "(SELECT rok FROM returns r WHERE o.cust = r.rcust LIMIT 1)"
+            ).collect()
+
+    def test_aggregate_in_correlated_in_rejected(self, session, orders_returns):
+        with pytest.raises(SqlError, match="[Aa]ggregate"):
+            session.sql(
+                "SELECT ok FROM orders o WHERE ok IN "
+                "(SELECT max(rok) FROM returns r WHERE o.cust = r.rcust)"
+            ).collect()
