@@ -662,6 +662,12 @@ class Func(Expr):
     SUPPORTED = (
         "substr", "substring", "coalesce", "nullif", "abs", "round", "floor",
         "ceil", "ceiling", "upper", "lower", "trim", "length", "concat",
+        # date parts and arithmetic (Spark SQL functions lake queries lean on)
+        "year", "month", "day", "dayofmonth", "quarter", "date_add", "date_sub",
+        "datediff", "last_day", "trunc",
+        # conditional / string utilities
+        "if", "replace", "lpad", "rpad", "instr", "ltrim", "rtrim",
+        "greatest", "least", "sign", "sqrt", "exp", "ln", "log", "power", "pow", "mod",
     )
 
     def __init__(self, name: str, args: Sequence[Expr]):
@@ -669,6 +675,10 @@ class Func(Expr):
         if self.name not in self.SUPPORTED:
             raise ValueError(f"Unsupported function {name!r}")
         self.args = list(args)
+        if self.name == "trunc" and (len(self.args) < 2 or not isinstance(self.args[1], Lit)):
+            # validated at construction so the SQL front-end surfaces a clean
+            # SqlError instead of an eval-time failure
+            raise ValueError("trunc(date, unit) requires a literal unit string")
 
     def children(self) -> Sequence[Expr]:
         return tuple(self.args)
@@ -763,6 +773,138 @@ class Func(Expr):
             if missing.any():
                 out[missing] = None
             return out
+        if f in ("year", "month", "day", "dayofmonth", "quarter"):
+            d = np.asarray(vals[0]).astype("datetime64[D]")
+            nat = np.isnat(d)
+            y = d.astype("datetime64[Y]").astype(np.int64) + 1970
+            if f == "year":
+                out = y.astype(np.float64)
+            else:
+                mo = (d.astype("datetime64[M]").astype(np.int64) % 12) + 1
+                if f == "month":
+                    out = mo.astype(np.float64)
+                elif f == "quarter":
+                    out = ((mo - 1) // 3 + 1).astype(np.float64)
+                else:  # day / dayofmonth
+                    out = (d - d.astype("datetime64[M]").astype("datetime64[D]")).astype(
+                        np.int64
+                    ).astype(np.float64) + 1
+            if nat.any():
+                out[nat] = np.nan
+            return out
+        if f in ("date_add", "date_sub"):
+            d = np.asarray(vals[0]).astype("datetime64[D]")
+            nd = np.asarray(vals[1])
+            delta = np.where(np.isnan(nd.astype(np.float64)), 0, nd).astype(np.int64)
+            sign = 1 if f == "date_add" else -1
+            out = d + (sign * delta).astype("timedelta64[D]")
+            bad = np.isnat(d) | _missing_mask(nd)
+            if bad.any():
+                out[bad] = np.datetime64("NaT")
+            return out
+        if f == "datediff":
+            a = np.asarray(vals[0]).astype("datetime64[D]")
+            b = np.asarray(vals[1]).astype("datetime64[D]")
+            out = (a - b).astype(np.int64).astype(np.float64)
+            bad = np.isnat(a) | np.isnat(b)
+            if bad.any():
+                out[bad] = np.nan
+            return out
+        if f == "last_day":
+            d = np.asarray(vals[0]).astype("datetime64[D]")
+            m = d.astype("datetime64[M]")
+            out = (m + np.timedelta64(1, "M")).astype("datetime64[D]") - np.timedelta64(1, "D")
+            nat = np.isnat(d)
+            if nat.any():
+                out[nat] = np.datetime64("NaT")
+            return out
+        if f == "trunc":
+            if len(self.args) < 2 or not isinstance(self.args[1], Lit):
+                raise ValueError("trunc(date, unit) requires a literal unit string")
+            d = np.asarray(vals[0]).astype("datetime64[D]")
+            unit = str(self.args[1].value).lower()
+            if unit in ("year", "yyyy", "yy"):
+                out = d.astype("datetime64[Y]").astype("datetime64[D]")
+            elif unit in ("month", "mon", "mm"):
+                out = d.astype("datetime64[M]").astype("datetime64[D]")
+            else:
+                raise ValueError(f"trunc: unsupported unit {unit!r}")
+            nat = np.isnat(d)
+            if nat.any():
+                out[nat] = np.datetime64("NaT")
+            return out
+        if f == "if":
+            # vals[0] already holds the evaluated condition (NULL -> None
+            # via _to_value_array); NULL conditions take the else arm
+            c0 = vals[0]
+            if c0.dtype == object:
+                cond = np.array([v is not None and bool(v) for v in c0], dtype=bool)
+            elif c0.dtype.kind == "f":
+                cond = ~np.isnan(c0) & (c0 != 0)
+            else:
+                cond = c0.astype(bool)
+            return np.where(cond, vals[1], vals[2])
+        if f == "replace":
+            # all arguments are per-row (columns or broadcast literals)
+            repl = vals[2] if len(vals) > 2 else np.full(n, "", dtype=object)
+            return np.array(
+                [
+                    None if (x is None or sr is None or rp is None)
+                    else str(x).replace(str(sr), str(rp))
+                    for x, sr, rp in zip(vals[0], vals[1], repl)
+                ],
+                dtype=object,
+            )
+        if f in ("lpad", "rpad"):
+            pads = vals[2] if len(vals) > 2 else np.full(n, " ", dtype=object)
+            widths = vals[1]
+            out = []
+            for x, w, p in zip(vals[0], widths, pads):
+                if x is None or p is None or (isinstance(w, float) and w != w):
+                    out.append(None)
+                    continue
+                s, width, pad = str(x), int(w), str(p)
+                if len(s) >= width:
+                    out.append(s[:width])
+                else:
+                    fill = (pad * width)[: width - len(s)] if pad else ""
+                    out.append(fill + s if f == "lpad" else s + fill)
+            return np.array(out, dtype=object)
+        if f == "instr":
+            return np.array(
+                [
+                    np.nan if (x is None or sr is None) else float(str(x).find(str(sr)) + 1)
+                    for x, sr in zip(vals[0], vals[1])
+                ],
+                dtype=np.float64,
+            )
+        if f in ("ltrim", "rtrim"):
+            strip = (lambda s: s.lstrip()) if f == "ltrim" else (lambda s: s.rstrip())
+            return np.array(
+                [None if x is None else strip(str(x)) for x in vals[0]], dtype=object
+            )
+        if f in ("greatest", "least"):
+            pick = np.fmax if f == "greatest" else np.fmin
+            out = np.asarray(vals[0], dtype=np.float64)
+            for v in vals[1:]:
+                out = pick(out, np.asarray(v, dtype=np.float64))
+            return out
+        if f == "sign":
+            return np.sign(np.asarray(vals[0], dtype=np.float64))
+        if f == "sqrt":
+            return np.sqrt(np.asarray(vals[0], dtype=np.float64))
+        if f == "exp":
+            return np.exp(np.asarray(vals[0], dtype=np.float64))
+        if f in ("ln", "log"):
+            if f == "log" and len(vals) > 1:  # log(base, expr), Spark-style
+                return np.log(np.asarray(vals[1], dtype=np.float64)) / np.log(
+                    np.asarray(vals[0], dtype=np.float64)
+                )
+            return np.log(np.asarray(vals[0], dtype=np.float64))
+        if f in ("power", "pow"):
+            return np.power(np.asarray(vals[0], dtype=np.float64), vals[1])
+        if f == "mod":
+            return np.mod(vals[0], vals[1])
         raise ValueError(f"Unsupported function {self.name!r}")
 
     def __repr__(self) -> str:
@@ -934,8 +1076,10 @@ def _correlation_frames(outer_keys, key_cols, inner, batch):
     evaluate the outer correlation keys, build the outer (left) frame with a
     ``__row`` id, the inner (right) frame keyed by ``key_cols``, and the
     NULL-key masks (a NULL correlation key never matches on either side).
-    Returns (n, left_df, right_df, outer_null_mask); right rows with NULL
-    keys are already dropped."""
+    Returns (n, left_df, right_df, outer_null_mask, inner_null_mask); right
+    rows with NULL keys are already dropped, and ``inner_null_mask`` (over
+    the UNFILTERED inner rows) lets callers align extra inner columns with
+    the filtered right frame."""
     import pandas as pd
 
     n = _batch_rows(batch)

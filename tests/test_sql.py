@@ -967,3 +967,95 @@ class TestDisjunctiveJoinPredicates:
             "SELECT sk FROM s, d WHERE sk = dk AND price = price * 1 + (SELECT 0.0 * max(dk) FROM d)"
         ).collect()
         assert len(got["sk"]) == 6
+
+
+class TestScalarFunctionBreadth:
+    """Round-3 scalar-function additions (Spark SQL functions lake queries
+    lean on): date parts/arithmetic, conditional/string/math utilities —
+    NULL-in-NULL-out under the framework's missing-value convention."""
+
+    @pytest.fixture()
+    def fx(self, session, tmp_path):
+        t = pa.table(
+            {
+                "d": pa.array(
+                    np.array(
+                        ["2020-02-29", "1999-12-31", "2021-07-15", "NaT"],
+                        dtype="datetime64[D]",
+                    )
+                ),
+                "d2": pa.array(
+                    np.array(
+                        ["2020-01-01", "2000-01-01", "2021-07-01", "2021-07-01"],
+                        dtype="datetime64[D]",
+                    )
+                ),
+                "x": np.array([4.0, -9.0, 2.25, np.nan]),
+                "n": np.array([3, 10, 0, 5], dtype=np.int64),
+                "s": pa.array(["abc", "hello world", "", None]),
+            }
+        )
+        root = tmp_path / "fx"
+        root.mkdir()
+        pq.write_table(t, root / "p.parquet")
+        session.read_parquet(str(root)).create_or_replace_temp_view("fx")
+        return t
+
+    def q(self, session, expr):
+        return session.sql(f"SELECT {expr} AS r FROM fx").collect()["r"]
+
+    def test_date_parts(self, session, fx):
+        assert self.q(session, "year(d)").tolist()[:3] == [2020.0, 1999.0, 2021.0]
+        assert self.q(session, "month(d)").tolist()[:3] == [2.0, 12.0, 7.0]
+        assert self.q(session, "day(d)").tolist()[:3] == [29.0, 31.0, 15.0]
+        assert self.q(session, "quarter(d)").tolist()[:3] == [1.0, 4.0, 3.0]
+        assert np.isnan(self.q(session, "year(d)")[3])
+
+    def test_date_arithmetic(self, session, fx):
+        got = self.q(session, "date_add(d2, n)")
+        assert str(got[0])[:10] == "2020-01-04"
+        got2 = self.q(session, "date_sub(d2, n)")
+        assert str(got2[1])[:10] == "1999-12-22"
+        dd = self.q(session, "datediff(d, d2)")
+        assert dd.tolist()[:3] == [59.0, -1.0, 14.0]
+        assert np.isnan(dd[3])
+        ld = self.q(session, "last_day(d)")
+        assert str(ld[0])[:10] == "2020-02-29" and str(ld[1])[:10] == "1999-12-31"
+        tr = self.q(session, "trunc(d, 'month')")
+        assert str(tr[2])[:10] == "2021-07-01"
+        try_ = self.q(session, "trunc(d, 'year')")
+        assert str(try_[2])[:10] == "2021-01-01"
+
+    def test_if_and_strings(self, session, fx):
+        got = self.q(session, "if(x > 0, 1, 0)")
+        assert got.tolist() == [1.0, 0.0, 1.0, 0.0]  # NULL cond -> false arm
+        rep = self.q(session, "replace(s, 'l', 'L')")
+        assert rep[1] == "heLLo worLd" and rep[3] is None
+        lp = self.q(session, "lpad(s, 5, '*')")
+        assert lp[0] == "**abc" and lp[1] == "hello"
+        rp = self.q(session, "rpad(s, 5, '*')")
+        assert rp[0] == "abc**" and rp[2] == "*****"
+        ins = self.q(session, "instr(s, 'world')")
+        assert ins.tolist()[:3] == [0.0, 7.0, 0.0] and np.isnan(ins[3])
+        lt = self.q(session, "ltrim(concat(' ', s))")
+        assert lt[0] == "abc"
+
+    def test_math(self, session, fx):
+        assert self.q(session, "sqrt(x)").tolist()[0] == 2.0
+        assert self.q(session, "sign(x)").tolist()[:3] == [1.0, -1.0, 1.0]
+        assert self.q(session, "greatest(x, n)").tolist()[:3] == [4.0, 10.0, 2.25]
+        assert self.q(session, "least(x, n)").tolist()[:3] == [3.0, -9.0, 0.0]
+        assert self.q(session, "power(n, 2)").tolist()[:3] == [9.0, 100.0, 0.0]
+        assert self.q(session, "mod(n, 3)").tolist()[:3] == [0, 1, 0]
+        assert abs(self.q(session, "exp(ln(n))")[1] - 10.0) < 1e-9
+
+    def test_review_regressions(self, session, fx):
+        # log(base, x) is base-log, not ln(base)
+        got = self.q(session, "log(2, n)")
+        assert abs(got[1] - np.log2(10)) < 1e-9
+        # per-row function arguments (column as search string)
+        got2 = self.q(session, "replace(s, s)")  # replace self -> empty
+        assert got2[0] == "" and got2[3] is None
+        # trunc without a literal unit: clean error, not IndexError
+        with pytest.raises(SqlError, match="trunc"):
+            session.sql("SELECT trunc(d) AS r FROM fx").collect()
