@@ -719,6 +719,8 @@ def join_sides_compatible(plan: L.Join) -> Optional[Tuple[L.LogicalPlan, L.Logic
     equal bucket counts — index scans or hybrid-scan BucketUnions — return
     (left_side, right_side, lkeys, rkeys); else None (ref: JoinIndexRanker's
     equal-bucket preference, HS/index/covering/JoinIndexRanker.scala:52-92)."""
+    if plan.residual is not None:
+        return None  # non-equi ON residuals run on the host join path
     pairs = extract_equi_join_keys(plan.condition)
     if not pairs:
         return None

@@ -717,10 +717,50 @@ class Executor:
         ldf = pd.DataFrame(left)
         rdf = pd.DataFrame(right).rename(columns=rename)
         rkeys_renamed = [rename.get(k, k) for k in rkeys]
-        merged = ldf.merge(rdf, left_on=lkeys, right_on=rkeys_renamed, how=plan.how)
+        if plan.residual is None:
+            merged = ldf.merge(rdf, left_on=lkeys, right_on=rkeys_renamed, how=plan.how)
+        else:
+            merged = self._residual_join(plan, ldf, rdf, lkeys, rkeys_renamed)
         out: B.Batch = {}
         for name in plan.output_columns:
             if name not in merged.columns:
                 raise KeyError(f"Join output column {name!r} missing")
             out[name] = merged[name].to_numpy()
         return out
+
+    @staticmethod
+    def _residual_join(plan: L.Join, ldf, rdf, lkeys, rkeys):
+        """Join with a non-equi ON residual: equi-match pairs, keep only
+        pairs satisfying the residual, then null-extend the unmatched side
+        rows for outer joins — ON-clause semantics, which a post-join filter
+        cannot express for left/right/full joins (a failing pair must
+        null-extend, not disappear). Residual references use post-join
+        (renamed) column names; NULL residual results drop the pair
+        (three-valued, like any SQL predicate)."""
+        import pandas as pd
+
+        from hyperspace_tpu.plan.expr import as_bool_mask
+
+        l_ = ldf.assign(__lrow=np.arange(len(ldf)))
+        r_ = rdf.assign(__rrow=np.arange(len(rdf)))
+        pairs = l_.merge(r_, left_on=lkeys, right_on=rkeys, how="inner")
+        if len(pairs):
+            # only the referenced columns feed the predicate (the planner
+            # resolved them to exact post-join names)
+            refs = plan.residual.references()
+            batch = {c: pairs[c].to_numpy() for c in pairs.columns if c in refs}
+            keep = as_bool_mask(plan.residual.eval(batch))
+            # a constant residual (ON ... AND 1 = 0) evaluates 0-d: broadcast
+            keep = np.broadcast_to(np.asarray(keep, dtype=bool), (len(pairs),))
+            surviving = pairs[keep]
+        else:
+            surviving = pairs
+        parts = [surviving]
+        if plan.how in ("left", "outer"):
+            lost = ldf[~np.isin(np.arange(len(ldf)), surviving["__lrow"].to_numpy())]
+            parts.append(lost)  # right columns null-extend via concat
+        if plan.how in ("right", "outer"):
+            lost_r = rdf[~np.isin(np.arange(len(rdf)), surviving["__rrow"].to_numpy())]
+            parts.append(lost_r)  # left columns null-extend
+        merged = pd.concat(parts, ignore_index=True, sort=False) if len(parts) > 1 else surviving
+        return merged.drop(columns=[c for c in ("__lrow", "__rrow") if c in merged.columns])

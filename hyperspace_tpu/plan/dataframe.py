@@ -39,7 +39,16 @@ class DataFrame:
             names.append(resolved)
         return DataFrame(L.Project(names, self.plan), self.session)
 
-    def join(self, other: "DataFrame", on: TUnion[str, List[str], Expr], how: str = "inner") -> "DataFrame":
+    def join(
+        self,
+        other: "DataFrame",
+        on: TUnion[str, List[str], Expr],
+        how: str = "inner",
+        residual: Optional[Expr] = None,
+    ) -> "DataFrame":
+        """``residual`` carries a non-equi ON-clause predicate evaluated
+        during the join (post-join column names) — for outer joins a failing
+        pair null-extends instead of matching."""
         if isinstance(on, Expr):
             condition = on
         else:
@@ -54,7 +63,7 @@ class DataFrame:
                 terms = term if terms is None else (terms & term)
             assert terms is not None
             condition = terms
-        return DataFrame(L.Join(self.plan, other.plan, condition, how), self.session)
+        return DataFrame(L.Join(self.plan, other.plan, condition, how, residual), self.session)
 
     def group_by(self, *keys: TUnion[str, Col]) -> "GroupedData":
         resolved = []

@@ -181,13 +181,29 @@ def join_output_names(left_cols: List[str], right_cols: List[str]) -> Tuple[List
 class Join(LogicalPlan):
     """Equi-join. ``condition`` must be a conjunction of col = col terms
     (the only shape the reference's JoinIndexRule accepts,
-    ref: HS/index/covering/JoinIndexRule.scala:149-155)."""
+    ref: HS/index/covering/JoinIndexRule.scala:149-155).
 
-    def __init__(self, left: LogicalPlan, right: LogicalPlan, condition: Expr, how: str = "inner"):
+    ``residual`` carries any extra non-equi ON-clause predicate (TPC-H q13's
+    ``LEFT JOIN orders ON c_custkey = o_custkey AND o_comment NOT LIKE ...``):
+    it is evaluated over the matched pairs DURING the join — for outer joins
+    a pair failing the residual null-extends instead of matching, which a
+    post-join filter cannot express. References use post-join (renamed)
+    column names. Index rules ignore joins with a residual (the reference's
+    rules are equi-CNF-only too)."""
+
+    def __init__(
+        self,
+        left: LogicalPlan,
+        right: LogicalPlan,
+        condition: Expr,
+        how: str = "inner",
+        residual: Optional[Expr] = None,
+    ):
         self.left = left
         self.right = right
         self.condition = condition
         self.how = how
+        self.residual = residual
 
     def children(self) -> Sequence[LogicalPlan]:
         return (self.left, self.right)
@@ -199,9 +215,11 @@ class Join(LogicalPlan):
 
     def with_children(self, children: Sequence[LogicalPlan]) -> "Join":
         left, right = children
-        return Join(left, right, self.condition, self.how)
+        return Join(left, right, self.condition, self.how, self.residual)
 
     def describe(self) -> str:
+        if self.residual is not None:
+            return f"Join({self.condition!r}, how={self.how}, residual={self.residual!r})"
         return f"Join({self.condition!r}, how={self.how})"
 
 

@@ -316,10 +316,10 @@ class SelectItem:
 
 
 class JoinClause:
-    def __init__(self, table_ref: "TableRef", how: str, on: List[Tuple[str, str]]):
+    def __init__(self, table_ref: "TableRef", how: str, on: Expr):
         self.table_ref = table_ref
         self.how = how
-        self.on = on
+        self.on = on  # full ON-clause expression (equi links extracted at plan time)
 
     @property
     def view(self):
@@ -485,13 +485,7 @@ def _parse_from_element(p: _Parser) -> FromElement:
             break
         jref = _parse_table_ref(p)
         p.expect_kw("on")
-        wrapped = p.accept_op("(") is not None
-        on = [_parse_on_eq(p)]
-        while p.accept_kw("and"):
-            on.append(_parse_on_eq(p))
-        if wrapped:
-            p.expect_op(")")
-        joins.append(JoinClause(jref, how, on))
+        joins.append(JoinClause(jref, how, _parse_or(p)))
     return FromElement(tref, joins)
 
 
@@ -533,13 +527,6 @@ def _parse_item(p: _Parser) -> SelectItem:
     text = p.text_since(start)
     alias = _maybe_alias(p)
     return SelectItem(e, alias, text)
-
-
-def _parse_on_eq(p: _Parser) -> Tuple[str, str]:
-    a = p.expect_ident()
-    p.expect_op("=")
-    b = p.expect_ident()
-    return a, b
 
 
 def _parse_group_item(p: _Parser) -> Any:
@@ -1354,23 +1341,76 @@ def _plan_from(q: Query, views):
             return views[tref.source]
         return plan_query(tref.source, views)
 
+    jk = [0]  # unique suffixes for computed join-key columns
+
     def build_element(elem: FromElement):
         """One comma element: its table plus chained JOIN ... ON clauses.
-        Returns (frame, local alias map)."""
+        The ON expression is split into equality links (possibly expression
+        keys, computed below the join) and a non-equi residual evaluated
+        DURING the join (ON-clause semantics: for outer joins a failing
+        pair null-extends — TPC-H q13's ``LEFT JOIN orders ON c_custkey =
+        o_custkey AND o_comment NOT LIKE ...``). Returns (frame, local
+        alias map)."""
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.plan.logical import Compute
+
         df_e = frame_of(elem.table_ref)
         amap: Dict[str, Dict[str, str]] = {
             elem.table_ref.alias.lower(): {c.lower(): c for c in df_e.plan.output_columns}
         }
         for j in elem.joins:
             right = frame_of(j.table_ref)
+            ramap = {j.alias.lower(): {c.lower(): c for c in right.plan.output_columns}}
+            links, residual_terms = [], []
+            for term in split_conjunctive(_factor_or_common(j.on)):
+                pair = None if _contains_marker(term) else _equi_link(
+                    term, amap, df_e, right, ramap
+                )
+                if pair is not None:
+                    links.append(pair)
+                else:
+                    residual_terms.append(term)
+            if not links:
+                raise SqlError(
+                    f"JOIN ... ON for {j.alias!r} needs at least one equality "
+                    "predicate linking the two sides"
+                )
             condition: Optional[Expr] = None
-            left_cols = {c.lower() for c in df_e.plan.output_columns}
-            for a, b in j.on:
-                an, bn = _resolve_side(a, b, j.alias, amap, left_cols)
-                term = col(an) == col(bn)
+            for ln, rn in links:
+                if not isinstance(ln, str):
+                    name = f"__jk{jk[0]}"
+                    jk[0] += 1
+                    df_e = DataFrame(Compute([(name, ln)], df_e.plan), df_e.session)
+                    ln = name
+                if not isinstance(rn, str):
+                    name = f"__jk{jk[0]}"
+                    jk[0] += 1
+                    right = DataFrame(Compute([(name, rn)], right.plan), right.session)
+                    rn = name
+                term = col(ln) == col(rn)
                 condition = term if condition is None else (condition & term)
             _, rename = join_output_names(df_e.plan.output_columns, right.plan.output_columns)
-            df_e = df_e.join(right, on=condition, how=j.how)
+            residual: Optional[Expr] = None
+            if residual_terms:
+                if any(_contains_marker(t) for t in residual_terms):
+                    raise SqlError("Subqueries/aggregates are not supported in JOIN ... ON")
+                mapping: Dict[str, str] = {}
+                left_lower = {c.lower(): c for c in df_e.plan.output_columns}
+                right_lower = {c.lower(): c for c in right.plan.output_columns}
+                for t in residual_terms:
+                    for r in t.references():
+                        got = _classify_two_sided(r, amap, ramap, left_lower, right_lower)
+                        if got is None:
+                            raise SqlError(f"Unknown column {r!r} in ON clause")
+                        side, actual = got
+                        if side == "ambiguous":
+                            raise SqlError(f"Ambiguous column {r!r} in ON clause; qualify it")
+                        # residual refs use POST-JOIN names: right side renamed
+                        mapping[r] = rename.get(actual, actual) if side == "right" else actual
+                for t in residual_terms:
+                    t2 = _rewrite(t, mapping)
+                    residual = t2 if residual is None else (residual & t2)
+            df_e = df_e.join(right, on=condition, how=j.how, residual=residual)
             amap[j.alias.lower()] = {
                 c.lower(): rename.get(c, c) for c in right.plan.output_columns
             }
@@ -1382,7 +1422,6 @@ def _plan_from(q: Query, views):
 
     conjuncts: Optional[List[Expr]] = None
     used: Set[int] = set()
-    jk_counter = 0
     if len(built) > 1:
         where_n = _factor_or_common(q.where) if q.where is not None else None
         conjuncts = split_conjunctive(where_n) if where_n is not None else []
@@ -1409,13 +1448,13 @@ def _plan_from(q: Query, views):
                     # column on its frame (Spark projects the expression
                     # below the SortMergeJoin the same way)
                     if not isinstance(ln, str):
-                        name = f"__jk{jk_counter}"
-                        jk_counter += 1
+                        name = f"__jk{jk[0]}"
+                        jk[0] += 1
                         df = DataFrame(Compute([(name, ln)], df.plan), session)
                         ln = name
                     if not isinstance(rn, str):
-                        name = f"__jk{jk_counter}"
-                        jk_counter += 1
+                        name = f"__jk{jk[0]}"
+                        jk[0] += 1
                         frame = DataFrame(Compute([(name, rn)], frame.plan), session)
                         rn = name
                     term = col(ln) == col(rn)
@@ -1568,6 +1607,33 @@ def _factor_or_common(e: Expr) -> Expr:
     return _and_all(common) & _or_all([r for r in residuals if r is not None])
 
 
+def _classify_two_sided(name: str, left_aliases, right_aliases, left_lower, right_lower):
+    """Resolve an ON-clause / comma-FROM reference against the two join
+    sides: ('left'|'right', actual column) on a unique resolution,
+    ('ambiguous', None) for an unqualified name present on both sides, None
+    when nothing resolves. The one resolver shared by equi-link extraction
+    and residual reference rewriting (so the two can never drift)."""
+    if "." in name:
+        qual, rest = name.split(".", 1)
+        ql = qual.lower()
+        if ql in right_aliases:
+            got = right_aliases[ql].get(rest.lower())
+            return ("right", got) if got is not None else None
+        if ql in left_aliases:
+            got = left_aliases[ql].get(rest.lower())
+            return ("left", got) if got is not None else None
+        return None
+    ln = name.lower()
+    in_left, in_right = ln in left_lower, ln in right_lower
+    if in_left and in_right:
+        return ("ambiguous", None)
+    if in_left:
+        return ("left", left_lower[ln])
+    if in_right:
+        return ("right", right_lower[ln])
+    return None
+
+
 def _equi_link(term: Expr, alias_cols, left_df, right_frame, right_aliases):
     """If ``term`` is ``expr = expr`` with one side's references resolving
     entirely into the joined composite and the other's entirely into the
@@ -1583,23 +1649,10 @@ def _equi_link(term: Expr, alias_cols, left_df, right_frame, right_aliases):
     right_lower = {c.lower(): c for c in right_frame.plan.output_columns}
 
     def classify(name: str):
-        if "." in name:
-            qual, rest = name.split(".", 1)
-            ql = qual.lower()
-            if ql in right_aliases:
-                got = right_aliases[ql].get(rest.lower())
-                return ("right", got) if got is not None else None
-            if ql in alias_cols:
-                got = alias_cols[ql].get(rest.lower())
-                return ("left", got) if got is not None else None
-            return None
-        ln = name.lower()
-        in_left, in_right = ln in left_lower, ln in right_lower
-        if in_left and not in_right:
-            return ("left", left_lower[ln])
-        if in_right and not in_left:
-            return ("right", right_lower[ln])
-        return None  # absent or ambiguous
+        got = _classify_two_sided(name, alias_cols, right_aliases, left_lower, right_lower)
+        if got is None or got[0] == "ambiguous":
+            return None  # absent or ambiguous: not a usable link side
+        return got
 
     def classify_side(e: Expr):
         """(side, key) where key is a str column or a rewritten Expr; None
@@ -2005,38 +2058,6 @@ def _map_qualified(mapping: Dict[str, str], qual: str, rest: str) -> str:
         f"Column {rest!r} not found in table/alias {qual!r} "
         f"(has {sorted(mapping.values())})"
     )
-
-
-def _resolve_side(a: str, b: str, right_alias: str, alias_cols, left_cols) -> Tuple[str, str]:
-    """Order an ON pair as (left column, right column) using qualifiers when
-    present, else membership; left references map through the alias column
-    map so keys renamed by an earlier join's dedup resolve correctly."""
-
-    def side_of(name: str) -> Optional[str]:
-        if "." in name:
-            qual = name.split(".", 1)[0].lower()
-            if qual == right_alias.lower():
-                return "right"
-            if qual in alias_cols:
-                return "left"
-        return None
-
-    def left_name(name: str) -> str:
-        if "." in name:
-            qual, rest = name.split(".", 1)
-            mapping = alias_cols.get(qual.lower())
-            if mapping is not None and rest.lower() in mapping:
-                return mapping[rest.lower()]
-        return _strip_qualifier(name)
-
-    sa, sb = side_of(a), side_of(b)
-    if sa == "right" or sb == "left":
-        a, b = b, a
-    elif sa is None and sb is None:
-        an_, bn_ = _strip_qualifier(a), _strip_qualifier(b)
-        if an_.lower() not in left_cols and bn_.lower() in left_cols:
-            a, b = b, a
-    return left_name(a), _strip_qualifier(b)
 
 
 def _surface_plain_names(items: List[SelectItem], names: List[str], renames: Dict[str, str]) -> None:

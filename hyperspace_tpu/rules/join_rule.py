@@ -158,6 +158,10 @@ def apply_join_index_rule(
     # a wildcard joinType, JoinIndexRule.scala:52-54)
     if not isinstance(plan, L.Join) or plan.how not in ("inner", "left", "right", "outer"):
         return plan, 0
+    if plan.residual is not None:
+        # non-equi ON residual: outside the rule's equi-CNF scope
+        # (ref: JoinPlanNodeFilter, JoinIndexRule.scala:149-155)
+        return plan, 0
     pairs = extract_equi_join_keys(plan.condition)
     if not pairs:
         return plan, 0
@@ -216,6 +220,6 @@ def apply_join_index_rule(
 
     new_left = transform_plan_to_use_index(ctx, l_best, plan.left, use_bucket_spec=True)
     new_right = transform_plan_to_use_index(ctx, r_best, plan.right, use_bucket_spec=True)
-    new_plan = L.Join(new_left, new_right, plan.condition, plan.how)
+    new_plan = L.Join(new_left, new_right, plan.condition, plan.how, plan.residual)
     score = int(70 * hybrid_coverage_fraction(l_best, l_scan) + 70 * hybrid_coverage_fraction(r_best, r_scan))
     return new_plan, max(score, 1)

@@ -322,7 +322,18 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
                 rr = on_side(c, right_cols)
                 if rr is not None:
                     r_needed.add(rr)
-            for c in plan.condition.references():
+            cond_refs = set(plan.condition.references())
+            if plan.residual is not None:
+                # residual refs use post-join names: map '#r' back to the
+                # right-side source column like the needed loop above
+                for c in plan.residual.references():
+                    if c.endswith("#r") and c[:-2] in right_cols:
+                        r_needed.add(c[:-2])
+                        if c[:-2] in left_cols:
+                            l_needed.add(c[:-2])
+                    else:
+                        cond_refs.add(c)
+            for c in cond_refs:
                 lr = on_side(c, left_cols)
                 if lr is not None:
                     l_needed.add(lr)
@@ -334,6 +345,7 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
             prune_columns(plan.right, r_needed),
             plan.condition,
             plan.how,
+            plan.residual,
         )
     if isinstance(plan, L.Scan):
         out = plan.output_columns

@@ -1,0 +1,153 @@
+"""Non-equi ON-clause residual predicates (TPC-H q13's
+``LEFT JOIN orders ON c_custkey = o_custkey AND o_comment NOT LIKE ...``):
+a pair failing the residual must NULL-EXTEND on outer joins — a post-join
+filter cannot express that. Oracle: pandas. The reference gets these from
+Spark's join executor; its index rules skip them (equi-CNF only), as do
+this framework's."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.plan.sql import SqlError
+
+
+@pytest.fixture()
+def cust_orders(session, tmp_path):
+    rng = np.random.default_rng(13)
+    nc, no = 60, 400
+    cust = pa.table({"c_custkey": np.arange(nc, dtype=np.int64),
+                     "c_name": np.array([f"c{i}" for i in range(nc)], dtype=object)})
+    orders = pa.table({
+        "o_orderkey": np.arange(no, dtype=np.int64),
+        "o_custkey": rng.integers(0, nc + 20, no).astype(np.int64),  # some dangling
+        "o_comment": np.array(
+            [("special requests here" if i % 5 == 0 else f"comment {i}") for i in range(no)],
+            dtype=object,
+        ),
+        "o_total": np.round(rng.uniform(10, 1000, no), 2),
+    })
+    for name, t in (("cust", cust), ("orders", orders)):
+        root = tmp_path / name
+        root.mkdir()
+        pq.write_table(t, root / "p.parquet")
+        session.read_parquet(str(root)).create_or_replace_temp_view(name)
+    return cust.to_pandas(), orders.to_pandas()
+
+
+def _oracle_left_residual(cp, op, keep_mask):
+    ok = op[keep_mask]
+    m = cp.merge(ok, left_on="c_custkey", right_on="o_custkey", how="left")
+    return m
+
+
+class TestResidualJoins:
+    def test_q13_shape_left_join_counts(self, session, cust_orders):
+        """The famous TPC-H q13: customers joined to NON-special orders;
+        customers with only special orders must still appear with count 0."""
+        cp, op = cust_orders
+        got = session.sql(
+            "SELECT c_custkey, count(o_orderkey) AS c_count FROM cust "
+            "LEFT JOIN orders ON c_custkey = o_custkey AND "
+            "o_comment NOT LIKE '%special%requests%' GROUP BY c_custkey"
+        ).collect()
+        m = _oracle_left_residual(cp, op, ~op.o_comment.str.contains("special requests"))
+        exp = m.groupby("c_custkey").o_orderkey.count()
+        got_map = dict(zip(got["c_custkey"].tolist(), got["c_count"].tolist()))
+        assert len(got_map) == len(cp)  # every customer present
+        for ck, cnt in exp.items():
+            assert got_map[int(ck)] == cnt, ck
+
+    def test_left_join_residual_nullextends_not_filters(self, session, cust_orders):
+        cp, op = cust_orders
+        got = session.sql(
+            "SELECT c_name, o_total FROM cust LEFT JOIN orders "
+            "ON c_custkey = o_custkey AND o_total > 900"
+        ).collect()
+        m = _oracle_left_residual(cp, op, op.o_total > 900)
+        assert len(got["c_name"]) == len(m)
+        # customers with no qualifying order appear exactly once with NULL total
+        nulls = sum(1 for v in got["o_total"] if v != v)
+        assert nulls == int(m.o_total.isna().sum()) and nulls > 0
+
+    def test_inner_join_residual_matches_filter(self, session, cust_orders):
+        cp, op = cust_orders
+        a = session.sql(
+            "SELECT o_orderkey FROM cust JOIN orders "
+            "ON c_custkey = o_custkey AND o_total > 500"
+        ).collect()
+        b = session.sql(
+            "SELECT o_orderkey FROM cust JOIN orders ON c_custkey = o_custkey "
+            "WHERE o_total > 500"
+        ).collect()
+        assert sorted(a["o_orderkey"].tolist()) == sorted(b["o_orderkey"].tolist())
+
+    def test_full_outer_residual(self, session, cust_orders):
+        cp, op = cust_orders
+        got = session.sql(
+            "SELECT c_custkey, o_orderkey FROM cust FULL OUTER JOIN orders "
+            "ON c_custkey = o_custkey AND o_total > 500"
+        ).collect()
+        keep = op.o_total > 500
+        pairs = cp.merge(op[keep], left_on="c_custkey", right_on="o_custkey", how="inner")
+        lost_c = len(cp) - pairs.c_custkey.nunique()
+        lost_o = (~np.isin(op.o_orderkey, pairs.o_orderkey)).sum()
+        assert len(got["c_custkey"]) == len(pairs) + lost_c + lost_o
+
+    def test_right_join_residual(self, session, cust_orders):
+        cp, op = cust_orders
+        got = session.sql(
+            "SELECT c_name, o_orderkey FROM cust RIGHT JOIN orders "
+            "ON c_custkey = o_custkey AND c_name != 'c3'"
+        ).collect()
+        assert len(got["o_orderkey"]) >= len(op)  # every order appears
+        # orders of customer 3 (and dangling custkeys) have NULL c_name
+        m = op.merge(cp[cp.c_name != "c3"], left_on="o_custkey", right_on="c_custkey", how="left")
+        nulls = sum(1 for v in got["c_name"] if v is None or v != v)
+        assert nulls == int(m.c_name.isna().sum()) and nulls > 0
+
+    def test_residual_on_index_rewrite_skipped(self, session, cust_orders, tmp_path):
+        """Joins with residuals stay outside JoinIndexRule's scope (the
+        reference's rule is equi-CNF-only), but queries still run with
+        hyperspace enabled."""
+        hs = hst.Hyperspace(session)
+        hs.create_index(
+            session._temp_views["orders"],
+            hst.CoveringIndexConfig("o_ck_r", ["o_custkey"], ["o_total"]),
+        )
+        session.enable_hyperspace()
+        q = session.sql(
+            "SELECT c_custkey, o_total FROM cust LEFT JOIN orders "
+            "ON c_custkey = o_custkey AND o_total > 500"
+        )
+        plan = q.optimized_plan().pretty()
+        on = q.collect()
+        session.disable_hyperspace()
+        off = q.collect()
+        session.enable_hyperspace()
+        assert len(on["o_total"]) == len(off["o_total"])
+        def norm(vals):
+            return sorted("NULL" if v != v else str(v) for v in vals)
+
+        assert norm(on["o_total"]) == norm(off["o_total"])
+
+    def test_on_without_equality_rejected(self, session, cust_orders):
+        with pytest.raises(SqlError, match="at least one equality"):
+            session.sql(
+                "SELECT c_name FROM cust JOIN orders ON o_total > 500"
+            ).collect()
+
+    def test_constant_residual_term(self, session, cust_orders):
+        # machine-generated SQL pads ON clauses with constants; a 0-d
+        # residual mask must broadcast, and ON ... AND 1 = 0 null-extends
+        # every left row
+        cp, _ = cust_orders
+        got = session.sql(
+            "SELECT c_custkey, o_orderkey FROM cust LEFT JOIN orders "
+            "ON c_custkey = o_custkey AND 1 = 0"
+        ).collect()
+        assert len(got["c_custkey"]) == len(cp)
+        assert all(v != v for v in got["o_orderkey"])  # all NULL
