@@ -255,6 +255,41 @@ def _coerce_compare(l, r):
     return l, r
 
 
+def _maybe_add_months(l, r, op: str):
+    """Calendar month/year intervals: ``date '1993-10-01' + interval '3'
+    month`` (TPC-H predicates). numpy cannot add a month timedelta to a
+    day-unit datetime, so months are applied on the month view with the
+    day-of-month preserved (clamped to the target month's length, SQL
+    semantics). Returns None when neither operand is a month interval."""
+    l_, r_ = np.asarray(l), np.asarray(r)
+
+    def is_month_td(a):
+        return a.dtype.kind == "m" and np.datetime_data(a.dtype)[0] == "M"
+
+    if l_.dtype.kind == "M" and is_month_td(r_):
+        date, months = l_, r_.astype(np.int64)
+    elif r_.dtype.kind == "M" and is_month_td(l_) and op == "+":
+        date, months = r_, l_.astype(np.int64)
+    else:
+        return None
+    if op == "-":
+        months = -months
+    d = date.astype("datetime64[D]")
+    m = d.astype("datetime64[M]")
+    day_off = (d - m.astype("datetime64[D]")).astype(np.int64)
+    nm = m + months.astype("timedelta64[M]")
+    month_len = (
+        (nm + np.timedelta64(1, "M")).astype("datetime64[D]") - nm.astype("datetime64[D]")
+    ).astype(np.int64)
+    day_off = np.minimum(day_off, month_len - 1)
+    shifted = nm.astype("datetime64[D]") + day_off.astype("timedelta64[D]")
+    if np.datetime_data(date.dtype)[0] in ("D", "M", "Y", "W"):
+        return shifted
+    # timestamp columns: preserve the time-of-day remainder and the dtype
+    tod = date - d.astype(date.dtype)
+    return shifted.astype(date.dtype) + tod
+
+
 def _missing_mask(v) -> np.ndarray:
     """Missing-value mask under the framework convention: NaN for floats,
     NaT for datetimes, None for object arrays; all-False otherwise."""
@@ -349,6 +384,10 @@ class BinaryOp(Expr):
             if np.any(unknown):
                 return NullableBool(res & ~unknown, unknown)
             return res
+        if op in ("+", "-"):
+            mres = _maybe_add_months(l, r, op)
+            if mres is not None:
+                return mres
         if op == "+":
             return l + r
         if op == "-":

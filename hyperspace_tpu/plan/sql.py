@@ -778,12 +778,18 @@ def _parse_factor(p: _Parser) -> Expr:
     if t == ("kw", "interval"):
         p.i += 1
         num = p.next()
-        if num[0] != "number":
+        if num[0] == "string" and num[1].lstrip("-").isdigit():
+            pass  # TPC-H style: interval '3' month
+        elif num[0] != "number":
             raise SqlError("INTERVAL expects a number")
         unit = p.next()[1].lower()
-        if unit.startswith("day"):
+        if unit in ("day", "days"):
             return Lit(np.timedelta64(int(num[1]), "D"))
-        raise SqlError(f"INTERVAL unit {unit!r} is not supported (days only)")
+        if unit in ("month", "months", "mon"):
+            return Lit(np.timedelta64(int(num[1]), "M"))
+        if unit in ("year", "years"):
+            return Lit(np.timedelta64(12 * int(num[1]), "M"))
+        raise SqlError(f"INTERVAL unit {unit!r} is not supported (day/month/year)")
     if t == ("kw", "exists"):
         p.i += 1
         p.expect_op("(")
@@ -792,6 +798,17 @@ def _parse_factor(p: _Parser) -> Expr:
         sub = _ExistsQuery(_parse_query(p))
         p.expect_op(")")
         return sub
+    if t[0] in ("ident", "kw") and t[1].lower() == "extract" and p.peek(1) == ("op", "("):
+        # EXTRACT(YEAR FROM expr) -> the equivalent date-part function
+        p.i += 1
+        p.expect_op("(")
+        unit = p.next()[1].lower()
+        _expect_word(p, "from")
+        e = _parse_or(p)
+        p.expect_op(")")
+        if unit not in ("year", "month", "day", "quarter"):
+            raise SqlError(f"EXTRACT unit {unit!r} is not supported")
+        return Func(unit, [e])
     if t[0] == "ident" and "." not in t[1] and p.peek(1) == ("op", "("):
         name = p.next()[1]
         p.expect_op("(")
@@ -1410,7 +1427,14 @@ def _plan_from(q: Query, views):
                 for t in residual_terms:
                     t2 = _rewrite(t, mapping)
                     residual = t2 if residual is None else (residual & t2)
-            df_e = df_e.join(right, on=condition, how=j.how, residual=residual)
+            if j.how == "inner" and residual is not None:
+                # for inner joins the residual is equivalent to a post-join
+                # filter — planning it that way keeps the join pure-equi, so
+                # the bucketed/device join stack and JoinIndexRule still apply
+                df_e = df_e.join(right, on=condition, how=j.how).filter(residual)
+                residual = None
+            else:
+                df_e = df_e.join(right, on=condition, how=j.how, residual=residual)
             amap[j.alias.lower()] = {
                 c.lower(): rename.get(c, c) for c in right.plan.output_columns
             }
@@ -1948,9 +1972,15 @@ def _plan_aggregate(q, df, prepared, having_e, resolve_ref, renames, session):
         if item_exprs[idx] is None:
             item_exprs[idx] = replace_aggs(e)
 
+    if having_e is not None:
+        # HAVING may aggregate without SELECT doing so (keys-only GROUP BY,
+        # TPC-H q18's inner ``SELECT l_orderkey ... GROUP BY l_orderkey
+        # HAVING sum(l_quantity) > 300``): register its aggregates so the
+        # Aggregate node computes them; the projection drops them after
+        replace_aggs(having_e)
     if not aggs:
         if having_e is not None:
-            raise SqlError("GROUP BY requires at least one aggregate in SELECT")
+            raise SqlError("HAVING must reference at least one aggregate")
         # aggregate-less GROUP BY is DISTINCT over the group keys (a common
         # TPC-DS idiom, e.g. q82)
         if group_computes:

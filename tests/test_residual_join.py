@@ -151,3 +151,29 @@ class TestResidualJoins:
         ).collect()
         assert len(got["c_custkey"]) == len(cp)
         assert all(v != v for v in got["o_orderkey"])  # all NULL
+
+    def test_inner_residual_plans_as_filter(self, session, cust_orders):
+        # inner joins keep the pure-equi Join node (bucketed/device paths and
+        # JoinIndexRule stay applicable); only outer joins carry a residual
+        q_in = session.sql(
+            "SELECT o_orderkey FROM cust JOIN orders ON c_custkey = o_custkey AND o_total > 500"
+        )
+        assert "residual=" not in q_in.optimized_plan().pretty()
+        q_left = session.sql(
+            "SELECT o_orderkey FROM cust LEFT JOIN orders ON c_custkey = o_custkey AND o_total > 500"
+        )
+        assert "residual=" in q_left.optimized_plan().pretty()
+
+
+class TestMonthIntervals:
+    def test_timestamp_keeps_time_of_day(self, session, tmp_path):
+        t = pa.table({"ts": pa.array(np.array(["2024-01-15T13:00:00", "2024-01-31T08:30:00"],
+                                              dtype="datetime64[s]"))})
+        root = tmp_path / "ts"
+        root.mkdir()
+        pq.write_table(t, root / "p.parquet")
+        session.read_parquet(str(root)).create_or_replace_temp_view("tst")
+        got = session.sql("SELECT ts + INTERVAL '1' month AS m FROM tst").collect()
+        vals = [str(np.datetime64(v, "s")) for v in got["m"]]
+        assert vals[0] == "2024-02-15T13:00:00"
+        assert vals[1] == "2024-02-29T08:30:00"  # clamped to Feb 29, time kept
