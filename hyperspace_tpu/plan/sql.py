@@ -36,6 +36,7 @@ non-projected columns (planned below the projection, Spark-style).
 
 from __future__ import annotations
 
+import itertools
 import re
 from typing import Any, Dict, List, Optional, Sequence, Set, Tuple
 
@@ -356,7 +357,7 @@ class Query:
         self.from_elements: List[FromElement] = []
         self.where: Optional[Expr] = None
         self.group_by: List[str] = []
-        self.rollup = False
+        self.group_sets: Optional[List[Tuple[int, ...]]] = None  # ROLLUP/CUBE/GROUPING SETS
         self.having: Optional[Expr] = None
         self.order_by: List[Tuple[Any, bool]] = []  # (column name | Expr, asc)
         self.limit: Optional[int] = None
@@ -463,17 +464,71 @@ def _parse_select_core(p: _Parser) -> Query:
         q.where = _parse_or(p)
     if p.accept_kw("group"):
         p.expect_kw("by")
-        q.rollup = p.accept_kw("rollup") is not None
-        if q.rollup:
+        nxt = p.peek()
+        word = nxt[1].lower() if nxt is not None and nxt[0] in ("ident", "kw") else ""
+        # cube/grouping are CONTEXTUAL words: only their full syntactic forms
+        # (a following paren / SETS() list) commit, so columns with these
+        # names stay valid GROUP BY keys
+        if p.accept_kw("rollup"):
             p.expect_op("(")
-        q.group_by = [_parse_group_item(p)]
-        while p.accept_op(","):
-            q.group_by.append(_parse_group_item(p))
-        if q.rollup:
+            q.group_by = _parse_group_list(p)
             p.expect_op(")")
+            k = len(q.group_by)
+            q.group_sets = [tuple(range(j)) for j in range(k, -1, -1)]
+        elif word == "cube" and p.peek(1) == ("op", "("):
+            p.i += 1
+            p.expect_op("(")
+            q.group_by = _parse_group_list(p)
+            p.expect_op(")")
+            k = len(q.group_by)
+            q.group_sets = [
+                s
+                for size in range(k, -1, -1)
+                for s in itertools.combinations(range(k), size)
+            ]
+        elif (
+            word == "grouping"
+            and p.peek(1) is not None
+            and p.peek(1)[1].lower() == "sets"
+            and p.peek(2) == ("op", "(")
+        ):
+            p.i += 2
+            p.expect_op("(")
+            keys: List[Any] = []
+            sets: List[Tuple[int, ...]] = []
+            while True:
+                names: List[Any] = []
+                if p.accept_op("("):
+                    if p.peek() != ("op", ")"):
+                        names = _parse_group_list(p)
+                    p.expect_op(")")
+                else:  # a bare column is a one-element set (standard SQL)
+                    names.append(_parse_group_item(p))
+                idxs = []
+                for nm in names:
+                    if not isinstance(nm, str):
+                        raise SqlError("GROUPING SETS keys must be plain columns")
+                    if nm not in keys:
+                        keys.append(nm)
+                    idxs.append(keys.index(nm))
+                sets.append(tuple(idxs))
+                if not p.accept_op(","):
+                    break
+            p.expect_op(")")
+            q.group_by = keys
+            q.group_sets = sets
+        else:
+            q.group_by = _parse_group_list(p)
     if p.accept_kw("having"):
         q.having = _parse_or(p)
     return q
+
+
+def _parse_group_list(p: _Parser) -> List[Any]:
+    out = [_parse_group_item(p)]
+    while p.accept_op(","):
+        out.append(_parse_group_item(p))
+    return out
 
 
 def _parse_from_element(p: _Parser) -> FromElement:
@@ -1194,7 +1249,7 @@ def _plan_single(q: Query, views: Dict[str, "DataFrame"]) -> "DataFrame":  # noq
     if is_agg:
         if prepared is None:
             raise SqlError("SELECT * cannot be combined with GROUP BY/aggregates")
-        if q.rollup:
+        if q.group_sets is not None:
             df, names, canonical_out = _plan_rollup(
                 q, df, prepared, having_e, resolve_ref, renames, session
             )
@@ -1768,8 +1823,10 @@ def _substitute_windows(e: Expr, mapping) -> Expr:
 
 
 def _plan_rollup(q, df, prepared, having_e, resolve_ref, renames, session):
-    """GROUP BY ROLLUP(k1..kn): the union of n+1 grouping sets (every key
-    prefix), rolled-up keys NULL, with __grp{i} indicator columns feeding
+    """GROUP BY ROLLUP / CUBE / GROUPING SETS: the union of one Aggregate
+    per grouping set (ROLLUP = key prefixes, CUBE = all subsets, GROUPING
+    SETS = the explicit list), absent keys NULL, with __grp{i} indicator
+    columns feeding
     grouping() (ref: Spark's Rollup/grouping semantics, used by TPC-DS
     q5/q18/q22/q27/q36/q67/q70/q77/q80/q86). Windows and grouping()
     arithmetic apply over the UNION (cross-set partitions), matching Spark.
@@ -1778,12 +1835,20 @@ def _plan_rollup(q, df, prepared, having_e, resolve_ref, renames, session):
     from hyperspace_tpu.plan.logical import Aggregate, Compute, Union
 
     group_keys: List[str] = []
+    parse_to_dedup: List[int] = []  # parse-time key position -> deduped index
     for g in q.group_by:
         if not isinstance(g, str):
-            raise SqlError("ROLLUP keys must be plain columns")
+            raise SqlError("ROLLUP/CUBE/GROUPING SETS keys must be plain columns")
         r = resolve_ref(g)
-        if r.lower() not in {k.lower() for k in group_keys}:
+        lowered = [k.lower() for k in group_keys]
+        if r.lower() not in lowered:
+            parse_to_dedup.append(len(group_keys))
             group_keys.append(r)
+        else:  # GROUP BY ROLLUP(a, A): both positions map to one key
+            parse_to_dedup.append(lowered.index(r.lower()))
+    group_sets = [
+        tuple(sorted({parse_to_dedup[i] for i in s})) for s in q.group_sets
+    ]
     k = len(group_keys)
     key_index = {g.lower(): i for i, g in enumerate(group_keys)}
 
@@ -1839,7 +1904,9 @@ def _plan_rollup(q, df, prepared, having_e, resolve_ref, renames, session):
     item_exprs = [subst(e) for _, e in prepared]
     having2 = subst(having_e) if having_e is not None else None
     if not aggs:
-        raise SqlError("GROUP BY ROLLUP requires at least one aggregate in SELECT")
+        raise SqlError(
+            "GROUP BY ROLLUP/CUBE/GROUPING SETS requires at least one aggregate in SELECT"
+        )
 
     base = df
     if pre_computes:
@@ -1849,10 +1916,14 @@ def _plan_rollup(q, df, prepared, having_e, resolve_ref, renames, session):
     # output schemas: keys (NULL when rolled up) + aggregates + indicators
     out_order = group_keys + [out for out, _, _ in aggs] + [f"__grp{i}" for i in range(k)]
     frames = []
-    for j in range(k, -1, -1):
-        f = DataFrame(Aggregate(group_keys[:j], aggs, base.plan), session)
-        fills: List[Tuple[str, Expr]] = [(gk, Lit(None)) for gk in group_keys[j:]]
-        fills += [(f"__grp{i}", Lit(0 if i < j else 1)) for i in range(k)]
+    for s in group_sets:
+        in_set = set(s)
+        skeys = [group_keys[i] for i in sorted(in_set)]
+        f = DataFrame(Aggregate(skeys, aggs, base.plan), session)
+        fills: List[Tuple[str, Expr]] = [
+            (gk, Lit(None)) for i, gk in enumerate(group_keys) if i not in in_set
+        ]
+        fills += [(f"__grp{i}", Lit(0 if i in in_set else 1)) for i in range(k)]
         f = DataFrame(Compute(fills, f.plan), session)
         frames.append(f.select(*out_order).plan)
     df = DataFrame(Union(frames), session)

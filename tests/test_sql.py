@@ -1070,3 +1070,109 @@ class TestScalarFunctionBreadth:
         # trunc without a literal unit: clean error, not IndexError
         with pytest.raises(SqlError, match="trunc"):
             session.sql("SELECT trunc(d) AS r FROM fx").collect()
+
+
+class TestCubeAndGroupingSets:
+    """CUBE and GROUPING SETS generalize the ROLLUP machinery (one Aggregate
+    per grouping set, absent keys NULL, grouping() indicators)."""
+
+    @pytest.fixture()
+    def gdata(self, session, tmp_path):
+        t = pa.table(
+            {
+                "a": np.array(["x", "x", "y", "y", "y"], dtype=object),
+                "b": np.array(["p", "q", "p", "p", "q"], dtype=object),
+                "v": np.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+            }
+        )
+        root = tmp_path / "g"
+        root.mkdir()
+        pq.write_table(t, root / "p.parquet")
+        session.read_parquet(str(root)).create_or_replace_temp_view("g")
+        return t.to_pandas()
+
+    def _rows(self, got):
+        return sorted(
+            tuple("NULL" if (v is None or v != v) else str(v) for v in row)
+            for row in zip(*[got[k].tolist() for k in sorted(got)])
+        )
+
+    def test_cube(self, session, gdata):
+        got = session.sql(
+            "SELECT a, b, sum(v) AS s FROM g GROUP BY CUBE(a, b)"
+        ).collect()
+        import pandas as pd
+
+        parts = []
+        for keys in ([["a", "b"], ["a"], ["b"], []]):
+            if keys:
+                gp = gdata.groupby(keys, as_index=False).v.sum()
+            else:
+                gp = pd.DataFrame({"v": [gdata.v.sum()]})
+            for m in ("a", "b"):
+                if m not in gp.columns:
+                    gp[m] = None
+            parts.append(gp[["a", "b", "v"]])
+        exp = pd.concat(parts, ignore_index=True)
+        exp_rows = sorted(
+            tuple("NULL" if (v is None or v != v) else str(v) for v in row)
+            for row in zip(exp.a, exp.b, exp.v)
+        )
+        got_rows = sorted(
+            tuple("NULL" if (v is None or v != v) else str(v) for v in row)
+            for row in zip(got["a"].tolist(), got["b"].tolist(), got["s"].tolist())
+        )
+        assert got_rows == exp_rows
+        assert len(got["a"]) == 2 * 2 + 2 + 2 + 1  # ab(4) + a(2) + b(2) + total(1)
+
+    def test_grouping_sets_explicit(self, session, gdata):
+        got = session.sql(
+            "SELECT a, b, count(*) AS n FROM g GROUP BY GROUPING SETS ((a, b), (a), ())"
+        ).collect()
+        # identical to ROLLUP(a, b)
+        want = session.sql(
+            "SELECT a, b, count(*) AS n FROM g GROUP BY ROLLUP(a, b)"
+        ).collect()
+        assert self._rows(got) == self._rows(want)
+
+    def test_grouping_sets_bare_columns(self, session, gdata):
+        # GROUPING SETS (a, b) == two single-key sets (standard SQL)
+        got = session.sql(
+            "SELECT a, b, sum(v) AS s FROM g GROUP BY GROUPING SETS (a, b)"
+        ).collect()
+        assert len(got["a"]) == 2 + 2
+        # every row has exactly one non-NULL key
+        for av, bv in zip(got["a"].tolist(), got["b"].tolist()):
+            assert (av is None) != (bv is None)
+
+    def test_grouping_indicator_with_cube(self, session, gdata):
+        got = session.sql(
+            "SELECT a, grouping(a) AS ga, grouping(b) AS gb, sum(v) AS s "
+            "FROM g GROUP BY CUBE(a, b)"
+        ).collect()
+        pairs = set(zip(got["ga"].tolist(), got["gb"].tolist()))
+        assert pairs == {(0, 0), (0, 1), (1, 0), (1, 1)}
+
+    def test_case_duplicate_rollup_keys(self, session, gdata):
+        # ROLLUP(a, A): both positions resolve to ONE key, so the grouping
+        # sets are (a),(a),() — the duplicate set legitimately repeats the
+        # per-a rows (standard ROLLUP semantics for duplicate keys), and
+        # crucially there is no crash from the parse/plan index mismatch
+        got = session.sql("SELECT a, sum(v) AS s FROM g GROUP BY ROLLUP(a, A)").collect()
+        rollup1 = session.sql("SELECT a, sum(v) AS s FROM g GROUP BY ROLLUP(a)").collect()
+        per_a = session.sql("SELECT a, sum(v) AS s FROM g GROUP BY a").collect()
+        expect = sorted(self._rows(rollup1) + self._rows(per_a))
+        assert self._rows(got) == expect
+
+    def test_column_named_cube_still_groups(self, session, tmp_path):
+        t = pa.table({"cube": np.array(["c1", "c1", "c2"], dtype=object),
+                      "grouping": np.array(["g1", "g2", "g2"], dtype=object),
+                      "v": np.array([1.0, 2.0, 3.0])})
+        root = tmp_path / "cg"
+        root.mkdir()
+        pq.write_table(t, root / "p.parquet")
+        session.read_parquet(str(root)).create_or_replace_temp_view("cg")
+        got = session.sql("SELECT cube, sum(v) AS s FROM cg GROUP BY cube").collect()
+        assert sorted(got["cube"].tolist()) == ["c1", "c2"]
+        got2 = session.sql("SELECT grouping, cube, count(*) AS n FROM cg GROUP BY grouping, cube").collect()
+        assert len(got2["n"]) == 3
