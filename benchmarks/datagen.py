@@ -61,6 +61,7 @@ def gen_orders(root: str, sf: float, num_files: int = 8, seed: int = 1) -> str:
                 "o_custkey": rng.integers(0, int(150_000 * max(sf, 0.01)), rows).astype(np.int64),
                 "o_totalprice": np.round(rng.uniform(800.0, 600000.0, rows), 2),
                 "o_orderdate": base + rng.integers(0, 2406, rows).astype("timedelta64[D]"),
+                "o_shippriority": rng.integers(0, 2, rows).astype(np.int64),
             }
         )
         pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))

@@ -265,8 +265,52 @@ def config6(root, args):
            "string_gather_overhead_x": round(ti[0] / max(tin[0], 1e-9), 3)})
 
 
+def config7(root, args):
+    """Real TPC-H q3 text through the SQL front-end with covering indexes on
+    the join keys — the end-to-end SQL+optimizer+engine latency on the
+    benchmark family's own query, not a synthetic shape."""
+    li_d = datagen.gen_lineitem(root, args.sf)
+    o_d = datagen.gen_orders(root, args.sf)
+    c_d = datagen.gen_customer(root, args.sf)
+    sess, hs, hst = _session(root)
+    li = sess.read_parquet(li_d)
+    o = sess.read_parquet(o_d)
+    c = sess.read_parquet(c_d)
+    hs.create_index(
+        li, hst.CoveringIndexConfig("li_ok7", ["l_orderkey"], ["l_extendedprice", "l_discount", "l_shipdate"])
+    )
+    hs.create_index(
+        o, hst.CoveringIndexConfig("o_ok7", ["o_orderkey"], ["o_custkey", "o_orderdate", "o_shippriority"])
+    )
+    # the customer join needs orders bucketed by o_custkey (JoinIndexRule
+    # requires indexed cols == join cols on both sides)
+    hs.create_index(
+        o, hst.CoveringIndexConfig("o_ck7", ["o_custkey"], ["o_orderkey", "o_orderdate", "o_shippriority"])
+    )
+    hs.create_index(c, hst.CoveringIndexConfig("c_ck7", ["c_custkey"], ["c_mktsegment"]))
+    li.create_or_replace_temp_view("lineitem")
+    o.create_or_replace_temp_view("orders")
+    c.create_or_replace_temp_view("customer")
+    q = sess.sql("""
+      select l_orderkey, sum(l_extendedprice * (1 - l_discount)) as revenue,
+             o_orderdate, o_shippriority
+      from customer, orders, lineitem
+      where c_mktsegment = 'AUTOMOBILE'
+        and c_custkey = o_custkey
+        and l_orderkey = o_orderkey
+        and o_orderdate < date '1995-03-15'
+        and l_shipdate > date '1995-03-15'
+      group by l_orderkey, o_orderdate, o_shippriority
+      order by revenue desc, o_orderdate
+      limit 10
+    """)
+    ti, tp = _ab(sess, q, args.reps)
+    _emit(7, "tpch_q3_sql_latency", ti, tp, {"sf": args.sf})
+
+
 CONFIGS = {"config1": config1, "config2": config2, "config3": config3,
-           "config4": config4, "config5": config5, "config6": config6}
+           "config4": config4, "config5": config5, "config6": config6,
+           "config7": config7}
 
 
 def main():
