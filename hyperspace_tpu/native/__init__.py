@@ -39,17 +39,26 @@ def _build() -> None:
     src = os.path.join(_SRC_DIR, "hs_native.cc")
     if not os.path.exists(src):
         raise NativeUnsupported("native sources not present")
-    cmd = [
+    base = [
         os.environ.get("CXX", "g++"),
         "-O3",
         "-std=c++17",
         "-fPIC",
         "-shared",
         src,
-        "-o",
-        _SO_PATH,
     ]
-    res = subprocess.run(cmd, capture_output=True, text=True, cwd=_SRC_DIR)
+    # gzip decode links the system zlib; a host without libz dev files must
+    # not lose the whole native path — rebuild without gzip support instead
+    res = subprocess.run(
+        base + ["-lz", "-o", _SO_PATH], capture_output=True, text=True, cwd=_SRC_DIR
+    )
+    if res.returncode != 0:
+        res = subprocess.run(
+            base + ["-DHS_NO_ZLIB", "-o", _SO_PATH],
+            capture_output=True,
+            text=True,
+            cwd=_SRC_DIR,
+        )
     if res.returncode != 0:
         raise NativeUnsupported(f"native build failed: {res.stderr[-2000:]}")
 

@@ -4,19 +4,23 @@
 // stance: "a C++ Parquet column-chunk decode path into device-feedable
 // buffers"; the reference is 100% JVM and delegates scans to Spark executors,
 // SURVEY.md §0). Decodes flat Parquet columns — PLAIN or RLE_DICTIONARY
-// encoded, UNCOMPRESSED or SNAPPY — from an mmap'd file straight into
+// encoded; UNCOMPRESSED, SNAPPY, or GZIP — from an mmap'd file straight into
 // caller-allocated buffers (numpy arrays on the Python side) with zero copies
 // for uncompressed pages, so index scans feed jax.device_put without
 // pyarrow/JVM row pivoting.
 //
 // The framework's own index files are written uncompressed (zero-copy fast
-// path); SNAPPY keeps externally-written lake files (Spark's default codec)
-// on the native path too. Anything outside this dialect returns an error and
-// the Python caller falls back to pyarrow.
+// path); SNAPPY (Spark's default codec, own decompressor) and GZIP (system
+// zlib) keep externally-written lake files on the native path too. Anything
+// outside this dialect returns an error and the Python caller falls back to
+// pyarrow.
 //
-// Build: make -C native  (g++ -O3 -shared -fPIC)
+// Build: make -C native  (g++ -O3 -shared -fPIC, links -lz)
 
 #include <fcntl.h>
+#ifndef HS_NO_ZLIB
+#include <zlib.h>
+#endif
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <unistd.h>
@@ -459,10 +463,68 @@ static void snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t
   if (op != dst_len) throw ThriftError("snappy: short output");
 }
 
-enum Codec : int32_t { C_UNCOMPRESSED = 0, C_SNAPPY = 1 };
+enum Codec : int32_t { C_UNCOMPRESSED = 0, C_SNAPPY = 1, C_GZIP = 2 };
+
+#ifndef HS_NO_ZLIB
+// gzip (parquet codec 2): zlib inflate with gzip-header wrapping. One inflate
+// state per thread, reset per page (reinitializing the ~40KB window for every
+// page would dominate small-page decode); decode threads release the GIL, so
+// thread_local is the right scope.
+static void gzip_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t dst_len) {
+  if (dst_len == 0) return;  // empty values section (all-null v2 page)
+  struct TlsInflate {
+    z_stream zs;
+    bool ok;
+    TlsInflate() : zs(), ok(false) {
+      // 16+MAX_WBITS: accept a gzip wrapper (parquet-mr writes gzip members)
+      ok = inflateInit2(&zs, 16 + MAX_WBITS) == Z_OK;
+    }
+    ~TlsInflate() {
+      if (ok) inflateEnd(&zs);
+    }
+  };
+  thread_local TlsInflate tls;
+  if (!tls.ok) throw ThriftError("gzip: init failed");
+  if (inflateReset(&tls.zs) != Z_OK) throw ThriftError("gzip: reset failed");
+  tls.zs.next_in = const_cast<uint8_t*>(src);
+  tls.zs.avail_in = static_cast<uInt>(n);
+  tls.zs.next_out = dst;
+  tls.zs.avail_out = static_cast<uInt>(dst_len);
+  const int rc = inflate(&tls.zs, Z_FINISH);
+  const size_t produced = dst_len - tls.zs.avail_out;
+  if (rc != Z_STREAM_END || produced != dst_len)
+    throw ThriftError("gzip: malformed or short stream");
+}
+#endif
 
 static bool codec_supported(int32_t codec) {
+#ifndef HS_NO_ZLIB
+  if (codec == C_GZIP) return true;
+#endif
   return codec == C_UNCOMPRESSED || codec == C_SNAPPY;
+}
+
+// decompress a page body with the chunk's codec into scratch
+static void page_decompress(int32_t codec, const uint8_t* src, size_t n, uint8_t* dst,
+                            size_t dst_len) {
+  switch (codec) {
+    case C_SNAPPY:
+      if (dst_len == 0) {
+        size_t ulen = 0, hdr = 0;
+        if (!snappy_varint(src, n, &ulen, &hdr) || ulen != 0)
+          throw ThriftError("snappy: length mismatch on empty page");
+        return;
+      }
+      snappy_decompress(src, n, dst, dst_len);
+      return;
+#ifndef HS_NO_ZLIB
+    case C_GZIP:
+      gzip_decompress(src, n, dst, dst_len);
+      return;
+#endif
+    default:  // keep codec_supported and this switch decoupled-safe
+      throw ThriftError("page_decompress: unsupported codec " + std::to_string(codec));
+  }
 }
 
 // Per-chunk decode state shared by fixed-width and byte-array paths.
@@ -475,7 +537,7 @@ struct ChunkCursor {
   const uint8_t* dict = nullptr;
   int64_t dict_count = 0;
   bool optional;
-  // decompressed page bodies (snappy chunks); dict buffer outlives data pages
+  // decompressed page bodies (snappy/gzip); dict buffer outlives data pages
   std::vector<uint8_t> page_scratch;
   std::vector<uint8_t> dict_scratch;
 
@@ -514,10 +576,10 @@ static bool next_data_page(ChunkCursor& c, PageData& out) {
     if (ph.type == P_DICTIONARY_PAGE) {
       if (ph.dict_encoding != E_PLAIN && ph.dict_encoding != E_PLAIN_DICTIONARY)
         throw ThriftError("non-PLAIN dictionary page");
-      if (codec == C_SNAPPY) {
+      if (codec != C_UNCOMPRESSED) {
         c.dict_scratch.resize(ph.uncompressed_size);
-        snappy_decompress(body, ph.compressed_size, c.dict_scratch.data(),
-                          ph.uncompressed_size);
+        page_decompress(codec, body, ph.compressed_size, c.dict_scratch.data(),
+                        ph.uncompressed_size);
         c.dict = c.dict_scratch.data();
       } else {
         c.dict = body;
@@ -531,10 +593,10 @@ static bool next_data_page(ChunkCursor& c, PageData& out) {
       // v1: the whole body (levels + values) is compressed as one block
       const uint8_t* p = body;
       const uint8_t* bend = body + ph.compressed_size;
-      if (codec == C_SNAPPY) {
+      if (codec != C_UNCOMPRESSED) {
         c.page_scratch.resize(ph.uncompressed_size);
-        snappy_decompress(body, ph.compressed_size, c.page_scratch.data(),
-                          ph.uncompressed_size);
+        page_decompress(codec, body, ph.compressed_size, c.page_scratch.data(),
+                        ph.uncompressed_size);
         p = c.page_scratch.data();
         bend = p + ph.uncompressed_size;
       }
@@ -570,14 +632,14 @@ static bool next_data_page(ChunkCursor& c, PageData& out) {
         decode_rle_hybrid(p, p + ph.def_bytes, 1, ph.num_values, out.defs.data());
       }
       p += ph.def_bytes;
-      if (codec == C_SNAPPY && ph.v2_is_compressed) {
+      if (codec != C_UNCOMPRESSED && ph.v2_is_compressed) {
         // v2 keeps rep/def levels uncompressed; only the values section is
-        // a snappy block
+        // a compressed block
         const size_t vals_unc = static_cast<size_t>(ph.uncompressed_size) -
                                 static_cast<size_t>(ph.def_bytes) -
                                 static_cast<size_t>(ph.rep_bytes);
         c.page_scratch.resize(vals_unc);
-        snappy_decompress(p, static_cast<size_t>(bend - p), c.page_scratch.data(), vals_unc);
+        page_decompress(codec, p, static_cast<size_t>(bend - p), c.page_scratch.data(), vals_unc);
         out.values = c.page_scratch.data();
         out.values_len = vals_unc;
         out.num_values = ph.num_values;

@@ -156,10 +156,30 @@ def test_snappy_data_page_v2(tmp_path, sample_table, no_pyarrow_fallback):
     _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
 
 
-def test_unsupported_codec_falls_back(tmp_path, sample_table):
-    """Codecs outside the native dialect (gzip) still fall back to pyarrow."""
+def test_gzip_decodes_natively(tmp_path, sample_table, no_pyarrow_fallback):
+    """GZIP pages inflate through the system zlib on the native path."""
     p = str(tmp_path / "gzip.parquet")
-    pq.write_table(sample_table, p, compression="GZIP")
+    pq.write_table(sample_table, p, compression="GZIP", use_dictionary=False)
+    _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
+
+
+def test_gzip_dictionary_and_nulls(tmp_path, no_pyarrow_fallback):
+    t = pa.table(
+        {
+            "k": pa.array([1, None, 3], type=pa.int64()),
+            "s": pa.array(["a", None, "c"]),
+        }
+    )
+    p = str(tmp_path / "gzip_nulls.parquet")
+    pq.write_table(t, p, compression="GZIP")
+    got = read_parquet_batch([p], ["k", "s"])
+    assert np.isnan(got["k"][1]) and got["s"][2] == "c"
+
+
+def test_unsupported_codec_falls_back(tmp_path, sample_table):
+    """Codecs outside the native dialect (zstd) still fall back to pyarrow."""
+    p = str(tmp_path / "zstd.parquet")
+    pq.write_table(sample_table, p, compression="ZSTD")
     with pytest.raises(native.NativeUnsupported):
         native.read_columns(p, ["i64"])
     _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
