@@ -3,8 +3,11 @@ it to the given query
 (ref: HS/index/plananalysis/CandidateIndexAnalyzer.scala:29-346).
 
 Mechanism mirrors the reference: enable analysis mode, re-run the collector +
-optimizer so the filter chain tags each entry with ``FilterReason``s, then
-collect the tags into a table.
+optimizer so the filter chain tags each entry with ``FilterReason``s and the
+rules tag their ranker winners with ``APPLICABLE_INDEX_RULES``, then render
+the reference's four sections (applied / applicable-but-not-applied /
+outdated / no-applicable-plan, ref: CandidateIndexAnalyzer.scala:178-255)
+followed by the per-subplan reasons table.
 """
 
 from __future__ import annotations
@@ -27,6 +30,12 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
             return f"Index {index_name!r} does not exist or is not ACTIVE."
     from hyperspace_tpu.rules.apply import plans_including_subqueries, used_index_names
 
+    # entries are shared across queries (TTL cache): wipe analysis tags from
+    # previous runs or the sections misclassify indexes
+    # (ref: CandidateIndexAnalyzer.scala:64-80 prepare/cleanupAnalysisTags)
+    for entry in indexes:
+        entry.unset_tag_for_all_plans(R.FILTER_REASONS)
+        entry.unset_tag_for_all_plans(R.APPLICABLE_INDEX_RULES)
     plan = df.plan
     new_plan = applier.apply(plan)
     applied = set(used_index_names(new_plan))
@@ -46,34 +55,97 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
         ordinal = used_labels.get(base, 0)
         used_labels[base] = ordinal + 1
         labels[L.plan_key(s)] = base if ordinal == 0 else f"{base[:24]}#{ordinal + 1}"
+
+    selected = [e for e in indexes if index_name is None or e.name == index_name]
+
+    # reasons per entry, deduplicated rows for the table AND the section logic
+    rows = {}  # entry.name -> list of (label, reason)
+    for entry in selected:
+        seen = set()
+        out = []
+        for scan in scans:
+            label = labels[L.plan_key(scan)]
+            for reason in entry.get_tag(L.plan_key(scan), R.FILTER_REASONS) or []:
+                if (label, reason.code, reason.arg_str) in seen:
+                    continue
+                seen.add((label, reason.code, reason.arg_str))
+                out.append((label, reason))
+        rows[entry.name] = out
+
+    # "applicable, but not applied due to priority": a rule's ranker picked
+    # the index for some sub-plan, but the score-based optimizer chose a
+    # different rewrite (ref: CandidateIndexAnalyzer.scala:193-197)
+    applicable_not_applied = sorted(
+        e.name
+        for e in selected
+        if e.name not in applied
+        and any(e.get_tag(L.plan_key(s), R.APPLICABLE_INDEX_RULES) for s in scans)
+    )
+    outdated = sorted(
+        name
+        for name, rs in rows.items()
+        if name not in applied
+        and name not in applicable_not_applied
+        and any(r.code == "SOURCE_DATA_CHANGED" for _, r in rs)
+    )
+    no_applicable_plan = sorted(
+        name
+        for name, rs in rows.items()
+        if name not in applied
+        and name not in applicable_not_applied
+        and name not in outdated
+        and any(r.code not in ("COL_SCHEMA_MISMATCH", "SOURCE_DATA_CHANGED") for _, r in rs)
+    )
+
+    def names_section(buf: List[str], title: str, names) -> None:
+        buf.append(title)
+        for n in names:
+            buf.append(f"- {n}")
+        if not names:
+            buf.append("- No such index found.")
+        buf.append("")
+
     buf: List[str] = []
     buf.append("=" * 64)
     buf.append("whyNot report")
-    buf.append(f"Applied indexes: {sorted(applied) or '(none)'}")
-    buf.append("")
+    buf.append("=" * 64)
+    names_section(buf, "Applied indexes:", sorted(applied))
+    names_section(
+        buf, "Applicable indexes, but not applied due to priority:", applicable_not_applied
+    )
+    names_section(buf, "Non-applicable indexes - index is outdated:", outdated)
+    names_section(buf, "Non-applicable indexes - no applicable query plan:", no_applicable_plan)
+
     header = f"{'Index':<24} {'Subplan':<28} Reason"
     buf.append(header)
     buf.append("-" * len(header))
-    for entry in indexes:
-        if index_name is not None and entry.name != index_name:
-            continue
+    for entry in selected:
         if entry.name in applied:
             buf.append(f"{entry.name:<24} {'-':<28} (applied)")
             continue
-        seen = set()
-        for scan in scans:
-            label = labels[L.plan_key(scan)]
-            tagged = entry.get_tag(L.plan_key(scan), R.FILTER_REASONS) or []
-            for reason in tagged:
-                text = str(reason) if extended else f"[{reason.code}] {reason.arg_str}"
-                row = (label, text)
-                if row in seen:
-                    continue
-                seen.add(row)
-                buf.append(f"{entry.name:<24} {label:<28} {text}")
-        if not seen:
-            buf.append(f"{entry.name:<24} {'-':<28} [NO_CANDIDATE] not a candidate for any sub-plan")
+        if not rows[entry.name]:
+            buf.append(
+                f"{entry.name:<24} {'-':<28} [NO_CANDIDATE] not a candidate for any sub-plan"
+            )
+            continue
+        shown = 0
+        for label, reason in rows[entry.name]:
+            # non-extended drops schema-mismatch noise, like the reference's
+            # table filter (CandidateIndexAnalyzer.scala:229-233)
+            if not extended and reason.code == "COL_SCHEMA_MISMATCH":
+                continue
+            text = str(reason) if extended else f"[{reason.code}] {reason.arg_str}"
+            buf.append(f"{entry.name:<24} {label:<28} {text}")
+            shown += 1
+        if not shown:
+            buf.append(
+                f"{entry.name:<24} {'-':<28} [COL_SCHEMA_MISMATCH] "
+                "(details with extended=True)"
+            )
     buf.append("=" * 64)
+    for entry in indexes:
+        entry.unset_tag_for_all_plans(R.FILTER_REASONS)
+        entry.unset_tag_for_all_plans(R.APPLICABLE_INDEX_RULES)
     return "\n".join(buf)
 
 

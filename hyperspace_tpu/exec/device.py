@@ -32,9 +32,7 @@ from typing import Dict, List, Optional, Tuple
 
 import jax
 
-# int64 keys/sentinels require x64 even in query-only processes that never
-# import the build-path modules (ops/sort.py sets it for builds)
-jax.config.update("jax_enable_x64", True)
+from hyperspace_tpu.utils.x64 import ensure_x64
 
 import numpy as np
 
@@ -498,6 +496,7 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
     ``scan_key`` identifies an immutable file set (IndexScan bucket files);
     when given, encoded predicate columns are kept resident on device across
     queries."""
+    ensure_x64()
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -567,6 +566,7 @@ def device_filtered_aggregate(
 
     Raises DeviceUnsupported outside the device language (string aggregate
     inputs, unsupported predicate shapes, ...)."""
+    ensure_x64()
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -989,6 +989,7 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     encodings (_encoded_join_keys). Raises DeviceUnsupported when the join
     isn't a compatible bucketed pair (the executor then falls back to its
     generic merge join)."""
+    ensure_x64()
     compat = join_sides_compatible(plan)
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
@@ -1212,6 +1213,7 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     HS/index/covering/JoinIndexRule.scala:604-618). Pair expansion and column
     gathering happen host-side (variable-size output).
     """
+    ensure_x64()
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -1539,6 +1541,7 @@ def host_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.Ba
     """The shuffle-free bucketed SMJ with spans computed host-side. Used
     below the device-dispatch row threshold and for every key shape the
     device program doesn't cover."""
+    ensure_x64()
     setup = _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
     lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = setup
     span_of = _make_host_span_of(session, plan, setup, _compat)
@@ -1633,6 +1636,7 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
 
     This is TPU-framework-specific: the reference delegates aggregation to
     Spark above its rewritten scans."""
+    ensure_x64()
     if join.how != "inner":
         raise DeviceUnsupported("fused join-aggregate covers inner joins")
     compat = join_sides_compatible(join)

@@ -539,6 +539,14 @@ class IndexLogEntry(LogEntry):
     def unset_tag(self, plan_key: Any, tag: str) -> None:
         self.tags.pop((plan_key, tag), None)
 
+    def unset_tag_for_all_plans(self, tag: str) -> None:
+        """Drop a tag for every plan key (ref: IndexLogEntry
+        ``unsetTagValueForAllPlan``, HS/index/IndexLogEntry.scala:560-565) —
+        entries are shared across queries by the caching manager, so analysis
+        tags must be wiped before each whyNot run."""
+        for key in [k for k in self.tags if k[1] == tag]:
+            self.tags.pop(key, None)
+
     # --- serialization -----------------------------------------------------
     def to_dict(self) -> Dict[str, Any]:
         return {

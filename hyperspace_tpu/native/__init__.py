@@ -15,6 +15,7 @@ the toolchain or the file's encoding is outside the native dialect
 from __future__ import annotations
 
 import ctypes
+import logging
 import os
 import subprocess
 import threading
@@ -53,12 +54,32 @@ def _build() -> None:
         base + ["-lz", "-o", _SO_PATH], capture_output=True, text=True, cwd=_SRC_DIR
     )
     if res.returncode != 0:
+        # Only a genuinely missing zlib justifies dropping gzip support; any
+        # other failure (transient OOM, bad flag) must surface, not silently
+        # produce a gzip-less library.
+        # GNU ld, lld, ld64 and gcc/clang all word this differently
+        zlib_missing = any(
+            marker in res.stderr
+            for marker in (
+                "cannot find -lz",  # GNU ld
+                "unable to find library -lz",  # lld
+                "library 'z' not found",  # ld64 (macOS)
+                "library not found for -lz",  # older ld64
+                "-lz: not found",
+            )
+        ) or ("zlib.h" in res.stderr and ("No such file" in res.stderr or "not found" in res.stderr))
+        if not zlib_missing:
+            raise NativeUnsupported(f"native build failed: {res.stderr[-2000:]}")
         res = subprocess.run(
             base + ["-DHS_NO_ZLIB", "-o", _SO_PATH],
             capture_output=True,
             text=True,
             cwd=_SRC_DIR,
         )
+        if res.returncode == 0:
+            logging.getLogger(__name__).warning(
+                "hs_native built without gzip support (zlib missing on this host)"
+            )
     if res.returncode != 0:
         raise NativeUnsupported(f"native build failed: {res.stderr[-2000:]}")
 

@@ -20,7 +20,8 @@ from typing import Dict, List, Tuple
 
 import jax
 
-jax.config.update("jax_enable_x64", True)
+from hyperspace_tpu.utils.x64 import ensure_x64
+
 
 import jax.numpy as jnp  # noqa: E402
 from hyperspace_tpu.parallel.mesh import get_shard_map  # noqa: E402
@@ -153,6 +154,7 @@ def rebucket(
       count of rows dropped because a destination slot overflowed (callers
       must check it is all zero and retry with larger capacity otherwise).
     """
+    ensure_x64()
     axis = mesh.axis_names[0]
     n_dev = mesh.shape[axis]
     names = list(arrays)
@@ -197,6 +199,7 @@ def rebucket_and_sort(
     entire reference hot path (ref: SURVEY.md §3.1 boxed region) as one XLA
     computation over the mesh.
     """
+    ensure_x64()
     from hyperspace_tpu.ops.hashing import bucket_ids_jnp
     from hyperspace_tpu.ops.sort import lex_argsort
 
@@ -332,6 +335,7 @@ def distributed_bucket_sort_build(
     ``overflow.sum() == 0`` and retry with doubled capacity otherwise (the
     skew strategy — SURVEY.md §7 "hard parts").
     """
+    ensure_x64()
     import numpy as np
 
     fn = _build_exchange_program(mesh, tuple(kinds), int(num_buckets), int(capacity))
@@ -358,6 +362,7 @@ def rebucket_hierarchical(
     Returns (out_arrays, out_buckets, valid_mask, overflow) per global device
     shard, like ``rebucket``; ``overflow`` sums drops from both phases.
     """
+    ensure_x64()
     dcn_axis, ici_axis = mesh.axis_names
     S = mesh.shape[dcn_axis]
     L = mesh.shape[ici_axis]

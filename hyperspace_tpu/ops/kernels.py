@@ -21,7 +21,8 @@ from functools import partial
 
 import jax
 
-jax.config.update("jax_enable_x64", True)  # float64 sketch bounds, as in ops.sort
+from hyperspace_tpu.utils.x64 import ensure_x64
+
 
 import jax.numpy as jnp
 import numpy as np
@@ -164,6 +165,7 @@ def segmented_min_max(segments):
     elements; per-piece results fold together exactly on the host (each piece
     result is already an exact element of the segment).
     """
+    ensure_x64()
     n = len(segments)
     if n == 0:
         return np.empty(0), np.empty(0)
@@ -283,6 +285,7 @@ def _hist_call(buckets, num_buckets: int, interpret: bool):
 def bucket_histogram(bucket_ids, num_buckets: int):
     """Rows per bucket. ``bucket_ids`` is a 1-D int array (host or device);
     out-of-range ids land in no bucket. Returns int32 numpy array (num_buckets,)."""
+    ensure_x64()
     b = np.asarray(bucket_ids, dtype=np.int32)
     n = b.shape[0]
     if n == 0:

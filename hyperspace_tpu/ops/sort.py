@@ -20,8 +20,9 @@ Design notes:
   - the permutation comes back as int32 and can be fetched asynchronously
     (``copy_to_host_async``) while the host prepares the gather.
 
-int64 keys require x64; enabled process-wide on import of this module (the
-framework owns the process' JAX config the way Spark owns its executors).
+int64 keys require x64; enabled lazily at first use via utils.x64.ensure_x64
+so importing the library never mutates global JAX state (see
+docs/configuration.md).
 """
 
 from __future__ import annotations
@@ -30,10 +31,9 @@ from functools import partial
 from typing import Sequence, Tuple
 
 import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import jax.numpy as jnp  # noqa: E402
+
+from hyperspace_tpu.utils.x64 import ensure_x64
 import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 
@@ -43,6 +43,7 @@ _I64_SIGN = -0x8000000000000000
 def lex_argsort(keys) -> "jnp.ndarray":
     """Stable argsort by ``keys[0]`` then ``keys[1]`` ... (most-significant
     first), as one multi-operand XLA sort with a trailing iota tiebreak."""
+    ensure_x64()
     keys = list(keys)
     n = keys[0].shape[0]
     idx = lax.iota(jnp.int32, n)
@@ -63,6 +64,7 @@ def bucket_sort_perm(hash_inputs, sort_keys, num_buckets: int):
       (perm, sorted_buckets): ``perm`` (n,) row permutation; ``sorted_buckets``
       (n,) the bucket id of each permuted row (non-decreasing).
     """
+    ensure_x64()
     from hyperspace_tpu.ops.hashing import bucket_ids_jnp
 
     buckets = bucket_ids_jnp(list(hash_inputs), num_buckets)
@@ -139,6 +141,7 @@ def bucket_sort_build(
       (perm, counts) device arrays: int32 permutation of all padded rows
       (valid rows occupy positions [0, n_valid)) and int32 rows-per-bucket.
     """
+    ensure_x64()
     interpret = jax.default_backend() != "tpu"
     return _build_sorted(
         tuple(keys), tuple(host_hashes), np.int32(n_valid), num_buckets, tuple(kinds), interpret
@@ -149,6 +152,7 @@ def warm_build(n: int, kinds: Tuple[str, ...], key_dtypes: Sequence, num_buckets
     """Pre-compile the build program for a given padded size class so the
     first real build at that size is a cache hit (first XLA compile of the
     sort is tens of seconds; see bench.py methodology)."""
+    ensure_x64()
     keys = tuple(jnp.zeros(n, dtype=dt) for dt in key_dtypes)
     hh = tuple(jnp.zeros(n, dtype=jnp.uint32) for k in kinds if k == "s")
     perm, counts = bucket_sort_build(keys, hh, kinds, num_buckets, n)

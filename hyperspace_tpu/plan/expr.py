@@ -1101,6 +1101,13 @@ class CorrelatedScalarSubquery(SubqueryExpr):
             if vals.dtype == object:
                 vals = vals.copy()
                 vals[missing] = None if self.default is None else self.default
+            elif np.issubdtype(vals.dtype, np.datetime64):
+                # keep the datetime dtype — casting to float64 would leak raw
+                # epoch numbers into downstream date comparisons
+                vals = vals.copy()
+                vals[missing] = (
+                    np.datetime64("NaT") if self.default is None else self.default
+                )
             else:
                 vals = vals.astype(np.float64, copy=True)
                 vals[missing] = fill

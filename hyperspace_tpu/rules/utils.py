@@ -306,14 +306,26 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         if needed is None:
             l_needed = r_needed = None
         else:
+            def keep_renamed(c, l_needed, r_needed):
+                # join_output_names repeats the '#r' suffix until unique, so a
+                # doubly-renamed 'x#r#r' needs iterative stripping to find the
+                # right-side source column. The rename is positional: it only
+                # reproduces at execution if the LEFT side still emits every
+                # shorter name in the chain ('x', 'x#r', ...), so keep those
+                # too — pruning one would shift the suffix count.
+                base, chain = c, []
+                while base.endswith("#r"):
+                    chain.append(base[:-2])
+                    base = base[:-2]
+                    if base in right_cols:
+                        r_needed.add(base)
+                        l_needed.update(x for x in chain if x in left_cols)
+                        return True
+                return False
+
             l_needed, r_needed = set(), set()
             for c in needed:
-                if c.endswith("#r") and c[:-2] in right_cols:
-                    # the '#r' rename only exists while the column duplicates
-                    # across sides — keep the left copy too
-                    r_needed.add(c[:-2])
-                    if c[:-2] in left_cols:
-                        l_needed.add(c[:-2])
+                if keep_renamed(c, l_needed, r_needed):
                     continue
                 lr = on_side(c, left_cols)
                 if lr is not None:
@@ -327,11 +339,7 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
                 # residual refs use post-join names: map '#r' back to the
                 # right-side source column like the needed loop above
                 for c in plan.residual.references():
-                    if c.endswith("#r") and c[:-2] in right_cols:
-                        r_needed.add(c[:-2])
-                        if c[:-2] in left_cols:
-                            l_needed.add(c[:-2])
-                    else:
+                    if not keep_renamed(c, l_needed, r_needed):
                         cond_refs.add(c)
             for c in cond_refs:
                 lr = on_side(c, left_cols)

@@ -25,9 +25,14 @@ class Session:
         # and HS_PROCESS_ID), so Session() stays side-effect-free otherwise
         # (SURVEY §5.8)
         from hyperspace_tpu.parallel.distributed import configured_from_env, initialize_from_env
+        from hyperspace_tpu.utils.x64 import ensure_x64
 
         if configured_from_env():
             initialize_from_env()
+        # the device layer needs int64 keys / float64 sketch bounds; enabling
+        # x64 here (not at import) keeps `import hyperspace_tpu` free of
+        # global JAX side effects — documented in docs/configuration.md
+        ensure_x64()
         self.conf = HyperspaceConf(conf)
         self.provider_manager = FileBasedSourceProviderManager(self)
         self.hyperspace_enabled = False

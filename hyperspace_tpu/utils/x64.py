@@ -1,0 +1,33 @@
+"""Session-scoped enablement of 64-bit JAX types.
+
+The device execution layer needs int64 keys/sentinels and float64 sketch
+bounds, which require ``jax_enable_x64``. Flipping that flag is process-wide,
+so it must NOT happen as an import side effect (hostile to host applications
+that embed this library); instead ``Session()`` and every device entry point
+call :func:`ensure_x64` lazily, immediately before any tracing happens.
+
+The flag is still global to the process once enabled — that is a JAX
+constraint, documented in docs/configuration.md — but importing
+``hyperspace_tpu`` alone no longer mutates global JAX state.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_enabled = False
+_lock = threading.Lock()
+
+
+def ensure_x64() -> None:
+    """Enable ``jax_enable_x64`` once, at first use of the device layer."""
+    global _enabled
+    if _enabled:
+        return
+    with _lock:
+        if _enabled:
+            return
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _enabled = True

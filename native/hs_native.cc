@@ -401,16 +401,17 @@ static void snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t
   size_t ip = hdr, op = 0;
   while (ip < n) {
     const uint8_t tag = src[ip++];
-    uint32_t len;
+    uint64_t len;  // 64-bit end to end: a 0xFFFFFFFF extra-byte length must
+                   // not wrap on the +1 (or on the narrowing) and desync the parse
     size_t offset = 0;
     switch (tag & 3) {
       case 0: {  // literal; length-1 in high 6 bits (60-63 = extra LE bytes)
         len = (tag >> 2) + 1;
         if (len > 60) {
-          const uint32_t extra = len - 60;
+          const uint32_t extra = static_cast<uint32_t>(len) - 60;
           if (ip + extra > n) throw ThriftError("snappy: truncated literal length");
           len = 0;
-          for (uint32_t k = 0; k < extra; k++) len |= static_cast<uint32_t>(src[ip + k]) << (8 * k);
+          for (uint32_t k = 0; k < extra; k++) len |= static_cast<uint64_t>(src[ip + k]) << (8 * k);
           len += 1;
           ip += extra;
         }

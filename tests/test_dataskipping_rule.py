@@ -431,8 +431,10 @@ def test_why_not_reports_applied_dataskipping_index(session, tmp_path):
     q = df.filter(hst.col("v") == 123)
     assert "dsWhy" in hs.explain(q).split("Indexes used:")[1]
     report = hs.why_not(q)
-    line = [l for l in report.splitlines() if l.startswith("Applied indexes:")][0]
-    assert "dsWhy" in line, report
+    lines = report.splitlines()
+    start = lines.index("Applied indexes:")
+    section = lines[start + 1 : lines.index("", start)]
+    assert "- dsWhy" in section, report
 
 
 def test_usage_event_reports_applied_dataskipping_index(tmp_path):

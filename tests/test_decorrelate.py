@@ -373,3 +373,42 @@ class TestReviewRegressions:
                 "SELECT ok FROM orders o WHERE ok IN "
                 "(SELECT max(rok) FROM returns r WHERE o.cust = r.rcust)"
             ).collect()
+
+
+class TestScalarDatetime:
+    def test_scalar_subquery_date_missing_group_is_nat(self, session, tmp_path):
+        """A datetime-valued scalar subquery with empty groups must fill NaT,
+        not cast the column to raw epoch floats (which silently corrupts any
+        downstream date comparison)."""
+        custs = np.array([0, 1, 2, 3], dtype=np.int64)
+        orders = pa.table({"ok": np.arange(4, dtype=np.int64), "cust": custs})
+        rdate = np.array(
+            ["2024-01-05", "2024-03-01", "2024-02-11"], dtype="datetime64[ns]"
+        )
+        returns = pa.table(
+            {
+                "rcust": np.array([0, 0, 2], dtype=np.int64),  # cust 1 and 3 absent
+                "rdate": rdate,
+            }
+        )
+        for name, t in (("o2", orders), ("r2", returns)):
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+        got = session.sql(
+            "SELECT ok, (SELECT max(r.rdate) FROM r2 r WHERE o.cust = r.rcust) AS md"
+            " FROM o2 o"
+        ).collect()
+        vals = np.asarray(got["md"])
+        assert np.issubdtype(vals.dtype, np.datetime64), vals.dtype
+        by_ok = dict(zip(got["ok"], got["md"]))
+        assert pd.Timestamp(by_ok[0]) == pd.Timestamp("2024-03-01")
+        assert pd.Timestamp(by_ok[2]) == pd.Timestamp("2024-02-11")
+        assert pd.isna(by_ok[1]) and pd.isna(by_ok[3])
+        # and the NaT rows must not satisfy a date comparison
+        got2 = session.sql(
+            "SELECT ok FROM o2 o WHERE (SELECT max(r.rdate) FROM r2 r"
+            " WHERE o.cust = r.rcust) > DATE '2024-02-01'"
+        ).collect()
+        assert sorted(got2["ok"].tolist()) == [0, 2]

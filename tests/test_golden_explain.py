@@ -145,3 +145,109 @@ def test_golden_why_not_index_name(golden_env):
         "whyNot_indexName.txt",
         _normalize(hs.why_not(q, index_name="filterIndex", extended=True), roots),
     )
+
+
+@pytest.fixture()
+def priority_env(tmp_path):
+    """Two indexes where the join rewrite (score 140) outranks an applicable
+    filter rewrite (score 50) on the same scan — the filter index lands in
+    whyNot's "applicable, but not applied due to priority" section
+    (ref: CandidateIndexAnalyzer.scala:193-197)."""
+    rng = np.random.default_rng(777)
+    n = 800
+    table = pa.table(
+        {
+            "clicks": rng.integers(0, 40, n).astype(np.int64),
+            "imprs": rng.integers(0, 200, n).astype(np.int64),
+        }
+    )
+    data = tmp_path / "pdata"
+    data.mkdir()
+    for i in range(4):
+        pq.write_table(table.slice(i * 200, 200), data / f"part-{i:05d}.parquet")
+    sysp = tmp_path / "indexes"
+    sysp.mkdir()
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: str(sysp), hst.keys.NUM_BUCKETS: 8})
+    hst.set_session(sess)
+    hs = hst.Hyperspace(sess)
+    df = sess.read_parquet(str(data))
+    hs.create_index(df, hst.CoveringIndexConfig("fIdx", ["clicks"], ["imprs"]))
+    hs.create_index(df, hst.CoveringIndexConfig("jIdx", ["imprs"], ["clicks"]))
+    sess.enable_hyperspace()
+    yield sess, hs, df, [tmp_path]
+    hst.set_session(None)
+
+
+def test_golden_why_not_priority_section(priority_env):
+    sess, hs, df, roots = priority_env
+    q = df.filter(hst.col("clicks") == 7).join(df, on=["imprs"]).select("clicks")
+    report = _normalize(hs.why_not(q), roots)
+    _check("whyNot_priority.txt", report)
+    # structural guard independent of golden text: fIdx was applicable (its
+    # rule's ranker picked it) but the join rewrite won the score race
+    lines = report.splitlines()
+    start = lines.index("Applicable indexes, but not applied due to priority:")
+    assert "- fIdx" in lines[start + 1 : lines.index("", start)], report
+    applied = lines.index("Applied indexes:")
+    assert "- jIdx" in lines[applied + 1 : lines.index("", applied)], report
+
+
+def test_golden_explain_bucket_pruned_filter(golden_env):
+    """Bucket-pruned filter scan (ref: FilterIndexRule.scala:162-167
+    useBucketSpec): the explain output must pin the pruned-bucket dispatch."""
+    sess, hs, df, roots = golden_env
+    sess.conf.set(hst.keys.FILTER_RULE_USE_BUCKET_SPEC, True)
+    try:
+        q = df.filter(hst.col("clicks") == 7).select("query")
+        _check("filter_bucket_pruned.txt", _normalize(hs.explain(q, verbose=True), roots))
+    finally:
+        sess.conf.set(hst.keys.FILTER_RULE_USE_BUCKET_SPEC, False)
+
+
+def test_golden_explain_hybrid_scan(golden_env, tmp_path):
+    """Hybrid scan explain (ref: HybridScanSuite's BucketUnionExec
+    assertions): index + appended source files merged via BucketUnion."""
+    sess, hs, df, roots = golden_env
+    # append one more file to the dataset AFTER the index was built
+    rng = np.random.default_rng(54321)
+    n = 100
+    extra = pa.table(
+        {
+            "clicks": rng.integers(0, 100, n).astype(np.int64),
+            "imprs": rng.integers(0, 1000, n).astype(np.int64),
+            "score": np.round(rng.standard_normal(n), 6),
+            "query": np.array([f"q{i % 23}" for i in range(n)]),
+        }
+    )
+    data_dir = [p for p in roots[0].iterdir() if p.name == "data"][0]
+    pq.write_table(extra, data_dir / "part-00004.parquet")
+    sess.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+    try:
+        df2 = sess.read_parquet(str(data_dir))
+        q = df2.filter(hst.col("clicks") == 7).select("query")
+        _check("filter_hybrid_scan.txt", _normalize(hs.explain(q, verbose=True), roots))
+    finally:
+        sess.conf.set(hst.keys.HYBRID_SCAN_ENABLED, False)
+
+
+def test_why_not_tags_do_not_leak_across_queries(priority_env):
+    """Entries are shared via the TTL cache: analysis tags from one whyNot
+    run must not bleed into the next (ref: CandidateIndexAnalyzer
+    prepare/cleanupAnalysisTags, scala:64-80)."""
+    sess, hs, df, roots = priority_env
+    q1 = df.filter(hst.col("clicks") == 7).join(df, on=["imprs"]).select("clicks")
+    r1 = hs.why_not(q1)
+    lines = r1.splitlines()
+    start = lines.index("Applicable indexes, but not applied due to priority:")
+    assert "- fIdx" in lines[start + 1 : lines.index("", start)]
+    # a pure filter query: fIdx simply APPLIES; no priority section entry,
+    # and q1's join reasons must not reappear
+    q2 = df.filter(hst.col("clicks") == 7).select("imprs")
+    r2 = hs.why_not(q2)
+    lines2 = r2.splitlines()
+    start2 = lines2.index("Applicable indexes, but not applied due to priority:")
+    section2 = lines2[start2 + 1 : lines2.index("", start2)]
+    assert section2 == ["- No such index found."], r2
+    assert "NOT_ALL_JOIN_COLS_INDEXED" not in r2, r2
+    applied2 = lines2.index("Applied indexes:")
+    assert "- fIdx" in lines2[applied2 + 1 : lines2.index("", applied2)], r2

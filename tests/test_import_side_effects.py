@@ -1,0 +1,53 @@
+"""Importing the library must not mutate global JAX state.
+
+The reference is a guest inside SparkSession and never flips engine-wide
+flags behind the host's back; the same courtesy applies here — x64 is
+enabled by ``Session()`` / lazily at first device use (utils/x64.py), not
+at import (ref: HS/package.scala:29-69 installs rules only on an explicit
+``spark.enableHyperspace()`` call).
+"""
+
+import subprocess
+import sys
+
+
+def test_import_does_not_enable_x64():
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import hyperspace_tpu\n"
+        "import hyperspace_tpu.exec.device\n"
+        "import hyperspace_tpu.ops.sort\n"
+        "import hyperspace_tpu.ops.bucketize\n"
+        "import hyperspace_tpu.ops.kernels\n"
+        "assert jax.config.jax_enable_x64 is False, 'import flipped x64'\n"
+        "from hyperspace_tpu.session import Session\n"
+        "Session()\n"
+        "assert jax.config.jax_enable_x64 is True, 'Session() must enable x64'\n"
+        "print('ok')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ok" in res.stdout
+
+
+def test_ops_entry_points_self_enable_x64():
+    # direct library users who skip Session still get working int64 sorts
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from hyperspace_tpu.ops.sort import lex_argsort\n"
+        "assert jax.config.jax_enable_x64 is False\n"
+        "perm = lex_argsort([np.array([3, 1, 2], dtype=np.int64)])\n"
+        "assert list(np.asarray(perm)) == [1, 2, 0]\n"
+        "assert jax.config.jax_enable_x64 is True\n"
+        "print('ok')\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, timeout=180
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    assert "ok" in res.stdout

@@ -288,3 +288,47 @@ def test_expand_pairs_matches_numpy():
     exp_r = np.arange(total) - np.repeat(offsets, counts) + np.repeat(lo, counts)
     np.testing.assert_array_equal(lidx, exp_l)
     np.testing.assert_array_equal(ridx, exp_r)
+
+
+def test_snappy_adversarial_literal_length_rejected():
+    """A 4-extra-byte literal length of 0xFFFFFFFF must be rejected, not
+    wrap to 0 on the +1 and silently desynchronize the parse (the bounds
+    checks kept it memory-safe, but the tag was skipped instead of the
+    input being refused)."""
+    # varint uncompressed length = 10, then literal tag with len-1 = 63
+    # (=> 4 extra LE length bytes), all 0xFF
+    blob = bytes([10, (63 << 2) | 0, 0xFF, 0xFF, 0xFF, 0xFF])
+    try:
+        with pytest.raises(ValueError):
+            native.snappy_decompress(blob)
+    except native.NativeUnsupported:
+        pytest.skip("native library unavailable")
+
+
+def test_snappy_roundtrip_long_literal():
+    # 70000-byte literal exercises the multi-extra-byte length path end to end
+    payload = bytes(range(256)) * 274
+    compressed = _snappy_compress_literal(payload)
+    try:
+        assert native.snappy_decompress(compressed) == payload
+    except native.NativeUnsupported:
+        pytest.skip("native library unavailable")
+
+
+def _snappy_compress_literal(payload: bytes) -> bytes:
+    """Minimal raw-snappy encoder: one big literal (valid per the format)."""
+    out = bytearray()
+    n = len(payload)
+    while n >= 0x80:  # varint uncompressed length
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+    m = len(payload) - 1
+    if m < 60:
+        out.append(m << 2)
+    else:
+        nbytes = (m.bit_length() + 7) // 8
+        out.append((59 + nbytes) << 2)
+        out += m.to_bytes(nbytes, "little")
+    out += payload
+    return bytes(out)

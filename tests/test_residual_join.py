@@ -177,3 +177,39 @@ class TestMonthIntervals:
         vals = [str(np.datetime64(v, "s")) for v in got["m"]]
         assert vals[0] == "2024-02-15T13:00:00"
         assert vals[1] == "2024-02-29T08:30:00"  # clamped to Feb 29, time kept
+
+
+class TestDoublyRenamedResidual:
+    def test_chained_join_residual_on_doubly_renamed_column(self, session, tmp_path):
+        """Three tables sharing column names: the second join's right side is
+        renamed 'x#r#r'. A residual referencing it must survive column
+        pruning (the prune pass strips '#r' suffixes iteratively, mirroring
+        join_output_names' repeat-until-unique renaming)."""
+        rng = np.random.default_rng(3)
+        frames = {}
+        for name in ("ta", "tb", "tc"):
+            t = pa.table({
+                "k": np.arange(20, dtype=np.int64),
+                "x": rng.integers(0, 50, 20).astype(np.int64),
+            })
+            root = tmp_path / name
+            root.mkdir()
+            pq.write_table(t, root / "p.parquet")
+            session.read_parquet(str(root)).create_or_replace_temp_view(name)
+            frames[name] = t.to_pandas()
+        df = session.sql(
+            "SELECT ta.k FROM ta JOIN tb ON ta.k = tb.k "
+            "JOIN tc ON tb.k = tc.k AND tc.x > ta.x"
+        )
+        # run the pruning pass explicitly (ApplyHyperspace runs it whenever
+        # indexes exist); the pruned plan must still execute correctly
+        from hyperspace_tpu.plan.dataframe import DataFrame
+        from hyperspace_tpu.rules.utils import prune_columns
+
+        pruned = DataFrame(prune_columns(df.plan), session)
+        a, b, c = frames["ta"], frames["tb"], frames["tc"]
+        m = a.merge(b, on="k", suffixes=("", "_b")).merge(c, on="k", suffixes=("", "_c"))
+        expect = sorted(m[m.x_c > m.x].k.tolist())
+        for frame in (df, pruned):
+            got = frame.collect()
+            assert sorted(got["k"].tolist()) == expect

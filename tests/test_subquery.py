@@ -240,7 +240,9 @@ class TestWhyNotSeesSubquery:
         q = main.filter(hst.col("k").isin(dim.filter(hst.col("tag") == "t1").select("id")))
         report = hs.why_not(q)
         assert "dimWhy" in report and "(applied)" not in report.split("dimWhy")[0]
-        assert "dimWhy" in report.split("Applied indexes:")[1].splitlines()[0]
+        lines = report.splitlines()
+        start = lines.index("Applied indexes:")
+        assert "- dimWhy" in lines[start + 1 : lines.index("", start)], report
 
     def test_subquery_scan_disqualification_reported(self, session, hs, two_tables):
         mroot, droot = two_tables
