@@ -1,0 +1,249 @@
+"""Vectorized full-schema TPC-H data generation (all 8 tables), sized by
+scale factor (SF 1 ~= 6M lineitem rows, official row-count scaling). Not
+dbgen: value distributions follow what the 22 query texts predicate on, the
+same shaping as the correctness fixture (tests/test_tpch_queries.py) but
+vectorized for millions of rows.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+ROWS_SF1 = {
+    "region": 5,
+    "nation": 25,
+    "supplier": 10_000,
+    "customer": 150_000,
+    "part": 200_000,
+    "partsupp": 800_000,
+    "orders": 1_500_000,
+    "lineitem": 6_000_000,
+}
+
+_REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+_NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+_PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+_SHIPMODES = ["AIR", "AIR REG", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+_INSTRUCT = ["COLLECT COD", "DELIVER IN PERSON", "NONE", "TAKE BACK RETURN"]
+_TYPES = [
+    f"{a} {b} {c}"
+    for a in ("STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO")
+    for b in ("ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED")
+    for c in ("TIN", "NICKEL", "BRASS", "STEEL", "COPPER")
+]
+_CONTAINERS = [
+    f"{a} {b}"
+    for a in ("SM", "MED", "LG", "JUMBO", "WRAP")
+    for b in ("CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM")
+]
+_BRANDS = [f"Brand#{i}{j}" for i in range(1, 6) for j in range(1, 6)]
+_P_NAME_WORDS = np.array(
+    ["almond", "antique", "aquamarine", "azure", "beige", "bisque", "black",
+     "blanched", "blue", "blush", "brown", "burlywood", "burnished", "chartreuse",
+     "chiffon", "chocolate", "coral", "cornflower", "cornsilk", "cream", "cyan",
+     "dark", "deep", "dim", "dodger", "drab", "firebrick", "floral", "forest",
+     "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey", "honeydew",
+     "hot", "hotpink", "indian", "ivory", "khaki", "lace", "lavender", "lawn",
+     "lemon", "light", "lime", "linen", "magenta", "maroon", "medium", "metallic",
+     "midnight", "mint", "misty", "moccasin", "navajo", "navy", "olive", "orange"]
+)
+
+
+def _write_chunked(d: str, n: int, num_files: int, make_chunk) -> str:
+    os.makedirs(d, exist_ok=True)
+    per = max(1, n // num_files)
+    off = 0
+    i = 0
+    while off < n:
+        rows = min(per, n - off) if i < num_files - 1 else n - off
+        t = make_chunk(off, rows)
+        pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+        off += rows
+        i += 1
+    return d
+
+
+def _rows(name: str, sf: float) -> int:
+    if name in ("region", "nation"):
+        return ROWS_SF1[name]
+    return max(20, int(ROWS_SF1[name] * sf))
+
+
+def _comments(rng, rows, special_frac=0.1):
+    base = np.array([f"notes {i}" for i in range(97)], dtype=object)
+    out = base[rng.integers(0, len(base), rows)]
+    hits = rng.random(rows) < special_frac
+    # q13/q16/q19-class LIKE patterns need occupants
+    specials = np.array(
+        ["special requests handle", "pending deposits accounts",
+         "unusual packages wake", "express Customer Complaints"], dtype=object
+    )
+    out[hits] = specials[rng.integers(0, len(specials), int(hits.sum()))]
+    return out
+
+
+def gen_all(root: str, sf: float, seed: int = 7) -> dict:
+    """Generate all 8 tables under ``root``; returns {table: dir}."""
+    rng = np.random.default_rng(seed)
+    dirs = {}
+    n_cust = _rows("customer", sf)
+    n_supp = _rows("supplier", sf)
+    n_part = _rows("part", sf)
+    n_ord = _rows("orders", sf)
+    n_li = _rows("lineitem", sf)
+    n_ps = _rows("partsupp", sf)
+    base = np.datetime64("1992-01-01")
+
+    # region / nation (fixed)
+    dirs["region"] = _write_chunked(
+        os.path.join(root, "region"), 5, 1,
+        lambda off, rows: pa.table({
+            "r_regionkey": np.arange(5, dtype=np.int64),
+            "r_name": np.array(_REGIONS, dtype=object),
+            "r_comment": np.array([f"region {i}" for i in range(5)], dtype=object),
+        }),
+    )
+    nat_names = np.array([n for n, _ in _NATIONS], dtype=object)
+    nat_regions = np.array([r for _, r in _NATIONS], dtype=np.int64)
+    dirs["nation"] = _write_chunked(
+        os.path.join(root, "nation"), 25, 1,
+        lambda off, rows: pa.table({
+            "n_nationkey": np.arange(25, dtype=np.int64),
+            "n_name": nat_names,
+            "n_regionkey": nat_regions,
+            "n_comment": np.array([f"nation {i}" for i in range(25)], dtype=object),
+        }),
+    )
+
+    def supplier_chunk(off, rows):
+        k = np.arange(off, off + rows, dtype=np.int64)
+        return pa.table({
+            "s_suppkey": k,
+            "s_name": np.array([f"Supplier#{v:09d}" for v in k], dtype=object),
+            "s_address": np.array([f"{v % 9999} Dock Rd" for v in k], dtype=object),
+            "s_nationkey": rng.integers(0, 25, rows).astype(np.int64),
+            "s_phone": np.array([f"{13 + (v % 20)}-{v % 997:03d}-55" for v in k], dtype=object),
+            "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, rows), 2),
+            "s_comment": _comments(rng, rows),
+        })
+
+    dirs["supplier"] = _write_chunked(os.path.join(root, "supplier"), n_supp, 2, supplier_chunk)
+
+    segs = np.array(_SEGMENTS, dtype=object)
+
+    def customer_chunk(off, rows):
+        k = np.arange(off, off + rows, dtype=np.int64)
+        return pa.table({
+            "c_custkey": k,
+            "c_name": np.array([f"Customer#{v:09d}" for v in k], dtype=object),
+            "c_address": np.array([f"{v % 9999} Market St" for v in k], dtype=object),
+            "c_nationkey": rng.integers(0, 25, rows).astype(np.int64),
+            "c_phone": np.array([f"{13 + (v % 20)}-{v % 997:03d}-55" for v in k], dtype=object),
+            "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, rows), 2),
+            "c_mktsegment": segs[rng.integers(0, 5, rows)],
+            "c_comment": _comments(rng, rows),
+        })
+
+    dirs["customer"] = _write_chunked(os.path.join(root, "customer"), n_cust, 4, customer_chunk)
+
+    types = np.array(_TYPES, dtype=object)
+    containers = np.array(_CONTAINERS, dtype=object)
+    brands = np.array(_BRANDS, dtype=object)
+
+    def part_chunk(off, rows):
+        k = np.arange(off, off + rows, dtype=np.int64)
+        w1 = _P_NAME_WORDS[rng.integers(0, len(_P_NAME_WORDS), rows)]
+        w2 = _P_NAME_WORDS[rng.integers(0, len(_P_NAME_WORDS), rows)]
+        return pa.table({
+            "p_partkey": k,
+            "p_name": np.array([f"{a} {b}" for a, b in zip(w1, w2)], dtype=object),
+            "p_mfgr": np.array([f"Manufacturer#{1 + (v % 5)}" for v in k], dtype=object),
+            "p_brand": brands[rng.integers(0, len(brands), rows)],
+            "p_type": types[rng.integers(0, len(types), rows)],
+            "p_size": rng.integers(1, 51, rows).astype(np.int64),
+            "p_container": containers[rng.integers(0, len(containers), rows)],
+            "p_retailprice": np.round(rng.uniform(900.0, 2000.0, rows), 2),
+            "p_comment": _comments(rng, rows),
+        })
+
+    dirs["part"] = _write_chunked(os.path.join(root, "part"), n_part, 4, part_chunk)
+
+    def partsupp_chunk(off, rows):
+        return pa.table({
+            "ps_partkey": rng.integers(0, n_part, rows).astype(np.int64),
+            "ps_suppkey": rng.integers(0, n_supp, rows).astype(np.int64),
+            "ps_availqty": rng.integers(1, 10_000, rows).astype(np.int64),
+            "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, rows), 2),
+            "ps_comment": _comments(rng, rows),
+        })
+
+    dirs["partsupp"] = _write_chunked(os.path.join(root, "partsupp"), n_ps, 4, partsupp_chunk)
+
+    prios = np.array(_PRIORITIES, dtype=object)
+    stats = np.array(["F", "O", "P"], dtype=object)
+
+    def orders_chunk(off, rows):
+        k = np.arange(off, off + rows, dtype=np.int64)
+        return pa.table({
+            "o_orderkey": k,
+            "o_custkey": rng.integers(0, max(1, int(n_cust * 0.85)), rows).astype(np.int64),
+            "o_orderstatus": stats[rng.integers(0, 3, rows)],
+            "o_totalprice": np.round(rng.uniform(800.0, 600000.0, rows), 2),
+            "o_orderdate": base + rng.integers(0, 2406, rows).astype("timedelta64[D]"),
+            "o_orderpriority": prios[rng.integers(0, 5, rows)],
+            "o_clerk": np.array([f"Clerk#{v % 1000:09d}" for v in k], dtype=object),
+            "o_shippriority": np.zeros(rows, dtype=np.int64),
+            "o_comment": _comments(rng, rows),
+        })
+
+    dirs["orders"] = _write_chunked(os.path.join(root, "orders"), n_ord, 8, orders_chunk)
+
+    modes = np.array(_SHIPMODES, dtype=object)
+    instr = np.array(_INSTRUCT, dtype=object)
+    flags = np.array(["A", "N", "R"], dtype=object)
+    lstat = np.array(["F", "O"], dtype=object)
+
+    def lineitem_chunk(off, rows):
+        ship = base + rng.integers(366, 2526, rows).astype("timedelta64[D]")
+        commit = ship + rng.integers(7, 30, rows).astype("timedelta64[D]")
+        late = rng.random(rows) < 0.2
+        receipt = commit + np.where(
+            late, rng.integers(1, 6, rows), rng.integers(-5, 1, rows)
+        ).astype("timedelta64[D]")
+        okeys = rng.integers(0, n_ord, rows).astype(np.int64)
+        heavy = rng.random(rows) < 0.02  # q18's heavy orders
+        okeys[heavy] = rng.integers(0, max(1, n_ord // 1000), int(heavy.sum()))
+        return pa.table({
+            "l_orderkey": okeys,
+            "l_partkey": rng.integers(0, n_part, rows).astype(np.int64),
+            "l_suppkey": rng.integers(0, n_supp, rows).astype(np.int64),
+            "l_linenumber": rng.integers(1, 8, rows).astype(np.int64),
+            "l_quantity": rng.integers(1, 51, rows).astype(np.int64),
+            "l_extendedprice": np.round(rng.uniform(900.0, 105000.0, rows), 2),
+            "l_discount": np.round(rng.integers(0, 11, rows) / 100.0, 2),
+            "l_tax": np.round(rng.integers(0, 9, rows) / 100.0, 2),
+            "l_returnflag": flags[rng.integers(0, 3, rows)],
+            "l_linestatus": lstat[rng.integers(0, 2, rows)],
+            "l_shipdate": ship,
+            "l_commitdate": commit,
+            "l_receiptdate": receipt,
+            "l_shipinstruct": instr[rng.integers(0, 4, rows)],
+            "l_shipmode": modes[rng.integers(0, 8, rows)],
+            "l_comment": _comments(rng, rows),
+        })
+
+    dirs["lineitem"] = _write_chunked(os.path.join(root, "lineitem"), n_li, 16, lineitem_chunk)
+    return dirs

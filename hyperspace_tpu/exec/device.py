@@ -37,6 +37,7 @@ from hyperspace_tpu.utils.x64 import ensure_x64
 import numpy as np
 
 from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.exec import trace
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.plan.expr import (
     BinaryOp,
@@ -185,8 +186,33 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
     def is_string_col(e: Expr) -> bool:
         return isinstance(e, Col) and codecs[e.name].kind == "string"
 
+    def _const_subtree(e: Expr) -> bool:
+        if isinstance(e, Lit):
+            return True
+        if isinstance(e, BinaryOp) and e.op in ("+", "-", "*", "/", "%"):
+            return _const_subtree(e.left) and _const_subtree(e.right)
+        return False
+
+    def _fold_const(e: Expr) -> Expr:
+        """Fold literal-only arithmetic on host: calendar-unit intervals
+        (date '1994-01-01' + interval '1' year => timedelta64[M]) have no
+        JAX dtype, but their folded result is a plain datetime scalar."""
+        if isinstance(e, Lit) or not _const_subtree(e):
+            return e
+        v = e.eval({})
+        arr = np.asarray(v)
+        return Lit(arr.reshape(-1)[0] if arr.ndim else arr[()])
+
+    def _has_datetime(e: Expr) -> bool:
+        if isinstance(e, Col):
+            return codecs[e.name].kind == "datetime"
+        if isinstance(e, Lit):
+            return isinstance(e.value, (np.datetime64, np.timedelta64))
+        return any(_has_datetime(c) for c in e.children())
+
     def build_num(e: Expr):
         """Numeric-valued subexpression -> device fn."""
+        e = _fold_const(e)
         if isinstance(e, Col):
             codec = codecs[e.name]
             if codec.kind == "string":
@@ -370,6 +396,10 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
             return value, terms[0][1]  # all terms share the child's null mask
         if isinstance(e, BinaryOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
             left, right, op = e.left, e.right, e.op
+            # fold literal-only sides FIRST so a folded datetime constant
+            # takes the Col-vs-Lit path below, where _literal_numeric
+            # converts it to the column codec's epoch unit
+            left, right = _fold_const(left), _fold_const(right)
             # normalize: Col OP Lit
             if isinstance(right, Col) and isinstance(left, Lit):
                 left, right, op = right, left, _FLIP[op]
@@ -383,7 +413,12 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
                 val = _literal_numeric(codec, right.value)
                 i = slots.add(_as_lit_scalar(val))
                 return _compare(lf, lambda cols, lits: lits[i], op, lu=num_unknown_expr(left))
-            # general numeric compare (col-vs-col, arithmetic)
+            # general numeric compare (col-vs-col, arithmetic): datetime
+            # operands have per-column epoch units the generic path cannot
+            # reconcile — reject rather than compare mismatched units
+            for side in (left, right):
+                if _has_datetime(side):
+                    raise DeviceUnsupported("datetime arithmetic compare on device")
             return _compare(
                 build_num(left), build_num(right), op,
                 lu=num_unknown_expr(left), ru=num_unknown_expr(right),
@@ -403,12 +438,18 @@ def compile_predicate(expr: Expr, codecs: Dict[str, ColumnCodec]):
 def _as_lit_scalar(v):
     """Fix the dtype a literal is passed with (jit traces lits as 0-d arrays;
     a stable dtype per slot keeps the executable cache warm)."""
+    if isinstance(v, (np.timedelta64, np.datetime64)):
+        # calendar units have no JAX dtype; raise here (not deep inside jit
+        # tracing, where the ValueError would escape the fallback machinery)
+        raise DeviceUnsupported(f"literal dtype {type(v).__name__} not device-representable")
     if isinstance(v, np.generic):
         return v
     if isinstance(v, bool):
         return np.int64(v)
     if isinstance(v, int):
         return np.int64(v)
+    if isinstance(v, (str, bytes)):
+        raise DeviceUnsupported("string literal in numeric slot")
     return np.float64(v)
 
 
@@ -455,6 +496,9 @@ def _device_cache_put(key, value, nbytes: int) -> None:
 
 def clear_device_cache() -> None:
     _device_cache.clear()
+    # the join rank cache short-circuits per-bucket key decodes, so it must
+    # clear too or decode-count dispatch traces depend on run history
+    _RANK_CACHE.clear()
 
 
 def _cached_predicate_jit(skeleton: str, fn):
@@ -676,8 +720,9 @@ def device_filtered_aggregate(
     for (name, fn, c), val, n_valid in zip(aggs, outs, valids):
         if fn == "count":
             result[name] = np.asarray([int(val)])
-        elif fn in ("min", "max", "avg") and n_valid == 0:
-            # no non-null matches: host pandas yields NaN (all-NaN groups too)
+        elif fn in ("sum", "min", "max", "avg") and n_valid == 0:
+            # no non-null matches: SQL yields NULL (sum included — SUM over
+            # zero rows is NULL, not 0)
             result[name] = np.asarray([np.nan])
         else:
             src = batch[c]
@@ -759,6 +804,7 @@ def _read_buckets(scan: L.IndexScan, columns: List[str], sort_keys: Optional[Lis
     refresh merges delta files into existing buckets, UpdateMode.Merge —
     ref: actions/RefreshIncrementalAction.scala:115-128) is only piecewise
     sorted after concatenation."""
+    trace.record("scan", "index-bucketed")
     from hyperspace_tpu.indexes.covering import bucket_of_file
 
     per_bucket: Dict[int, List[str]] = {}
@@ -1005,10 +1051,14 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     setup = _bucketed_join_setup(session, plan, compat)
     if total >= session.conf.device_exec_min_rows:
         try:
-            return device_bucketed_join(session, plan, _compat=compat, _setup=setup)
+            out = device_bucketed_join(session, plan, _compat=compat, _setup=setup)
+            trace.record("join", "device-smj")
+            return out
         except DeviceUnsupported:
             pass  # e.g. a decoded batch outside the device language
-    return host_bucketed_join(session, plan, _compat=compat, _setup=setup)
+    out = host_bucketed_join(session, plan, _compat=compat, _setup=setup)
+    trace.record("join", "host-span-smj")
+    return out
 
 
 def _bucketed_join_setup(session, plan: L.Join, compat=None, needed_override=None):
@@ -1530,8 +1580,11 @@ def _make_host_span_of(session, plan: L.Join, setup, compat):
         rk = rkeys_by_bucket[b]
         try:
             # single O(n+m) merge walk in C over the pre-sorted runs
-            return native.merge_spans(lk, rk)
+            spans = native.merge_spans(lk, rk)
+            trace.record("spans", "native")
+            return spans
         except native.NativeUnsupported:
+            trace.record("spans", "searchsorted")
             return np.searchsorted(rk, lk, side="left"), np.searchsorted(rk, lk, side="right")
 
     return span_of
@@ -1783,8 +1836,11 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             out[name] = np.asarray([total_pairs])
         elif fn == "count":
             out[name] = np.asarray([a["cnt"]])
+        elif fn == "sum" and a["cnt"] == 0:
+            # SQL: SUM over zero (non-null) rows is NULL, not 0
+            out[name] = np.asarray([np.nan])
         elif fn == "sum":
-            # pandas: sum of an all-null/empty series is 0; int inputs stay int
+            # int inputs stay int (exact)
             if is_int_out[name] and abs(a["sum"]) >= 2 ** 63:
                 # exact Python-int total exceeds int64 across buckets: the
                 # materialized path defines the (wrapping/float) behavior

@@ -17,6 +17,7 @@ import numpy as np
 import pyarrow.dataset as pads
 
 from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.exec import trace
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.plan.expr import (
     INPUT_FILE_NAME,
@@ -343,6 +344,10 @@ class Executor:
             )
 
         if isinstance(plan, L.IndexScan):
+            if plan.pruned_buckets is not None:
+                trace.record("scan", f"index-bucket-pruned({len(plan.pruned_buckets)} buckets)")
+            else:
+                trace.record("scan", "index")
             fcols = plan.file_columns if plan.file_columns is not None else list(plan.columns)
             batch = _read_files(list(plan.files), "parquet", list(fcols), with_file_names)
             if plan.file_columns is not None:
@@ -519,11 +524,15 @@ class Executor:
             from hyperspace_tpu.exec import device as D
 
             try:
-                return D.device_filter_mask(
+                mask = D.device_filter_mask(
                     self.session, child, plan.condition, scan_key=_scan_identity(plan.child)
                 )
+                trace.record("filter", "device")
+                return mask
             except D.DeviceUnsupported:
-                pass
+                trace.record("filter", "host-fallback")
+                return as_bool_mask(plan.condition.eval(child))
+        trace.record("filter", "host")
         return as_bool_mask(plan.condition.eval(child))
 
     def _exec_aggregate(self, plan: L.Aggregate, with_file_names: bool) -> B.Batch:
@@ -544,12 +553,15 @@ class Executor:
                 from hyperspace_tpu.exec import device as D
 
                 try:
-                    return D.aggregate_over_bucketed_join(self.session, plan, join_node)
+                    got = D.aggregate_over_bucketed_join(self.session, plan, join_node)
+                    trace.record("agg", "fused-bucketed-join")
+                    return got
                 except D.DeviceUnsupported:
                     pass
         if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
             got, scan_batch, filter_node = self._try_device_aggregate(plan)
             if got is not None:
+                trace.record("agg", "device-fused-scan")
                 return got
             if scan_batch is not None:
                 # the device gate already materialized the scan — reuse it
@@ -585,9 +597,13 @@ class Executor:
                 return int(s.nunique(dropna=True))
             if fn in ("sum_distinct", "avg_distinct"):
                 d = s.dropna().drop_duplicates()
-                return d.sum() if fn == "sum_distinct" else d.mean()
+                return d.sum(min_count=1) if fn == "sum_distinct" else d.mean()
             if fn == "stddev_samp":
                 return s.std(ddof=1)
+            if fn == "sum":
+                # SQL: SUM over zero rows (or all NULLs) is NULL, not 0 —
+                # pandas' min_count=0 default returns 0
+                return s.sum(min_count=1)
             return getattr(s, _PD_FN[fn])()
 
         if not plan.keys:
@@ -612,11 +628,16 @@ class Executor:
             elif fn == "count_distinct":
                 pieces[name] = grouped[col_name].nunique(dropna=True)
             elif fn == "sum_distinct":
-                pieces[name] = grouped[col_name].agg(lambda s: s.dropna().drop_duplicates().sum())
+                pieces[name] = grouped[col_name].agg(
+                    lambda s: s.dropna().drop_duplicates().sum(min_count=1)
+                )
             elif fn == "avg_distinct":
                 pieces[name] = grouped[col_name].agg(lambda s: s.dropna().drop_duplicates().mean())
             elif fn == "stddev_samp":
                 pieces[name] = grouped[col_name].std(ddof=1)
+            elif fn == "sum":
+                # an all-NULL group must sum to NULL (SQL), not pandas' 0
+                pieces[name] = grouped[col_name].sum(min_count=1)
             else:
                 pieces[name] = getattr(grouped[col_name], _PD_FN[fn])()
         result = pd.DataFrame(pieces).reset_index()
@@ -668,6 +689,7 @@ class Executor:
                     return D.dispatch_bucketed_join(self.session, plan)
                 except D.DeviceUnsupported:
                     pass
+        trace.record("join", "generic-merge")
 
         pairs = extract_equi_join_keys(plan.condition)
         if pairs is None:

@@ -19,6 +19,7 @@ import pyarrow.dataset as pads
 import pyarrow.parquet as pq
 
 from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.exec import trace
 
 # ---------------------------------------------------------------------------
 # Per-file decoded-batch cache (the framework's buffer pool). Spark gets this
@@ -104,9 +105,14 @@ def _dtype_hints(schema: pa.Schema, columns: List[str]) -> Optional[Dict[str, np
         t = schema.field(c).type
         if pa.types.is_timestamp(t):
             hints[c] = np.dtype(f"datetime64[{t.unit}]")
+        elif pa.types.is_date32(t):
+            # INT32 days since epoch; pyarrow surfaces datetime64[D] — the
+            # native wrapper widens int32 -> datetime64[D] by astype
+            hints[c] = np.dtype("datetime64[D]")
+        elif pa.types.is_date64(t):
+            hints[c] = np.dtype("datetime64[ms]")
         elif (
-            pa.types.is_date(t)       # INT32-backed date: pyarrow keeps datetime64[D]
-            or pa.types.is_time(t)    # time32/time64 surface as datetime.time objects
+            pa.types.is_time(t)       # time32/time64 surface as datetime.time objects
             or pa.types.is_duration(t)
             or pa.types.is_decimal(t)
             or pa.types.is_nested(t)
@@ -127,6 +133,7 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
     from hyperspace_tpu import native
 
     def _dataset_read() -> B.Batch:
+        trace.record("decode", "pyarrow-dataset")
         try:
             # unify per-file schemas so evolved columns survive regardless of
             # file order (a bare dataset takes the FIRST fragment's schema)
@@ -173,6 +180,8 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
     # through the pre-scan below before trusting the cache.
     cached = [_io_cache_get(_io_cache_key(f, columns)) for f in files]
     if columns is not None and cached and all(b is not None for b in cached):
+        for _ in cached:
+            trace.record("decode", "cached")
         return cached[0] if len(cached) == 1 else B.concat(cached)
 
     # pre-scan schemas; any inconsistency -> unified dataset read
@@ -193,16 +202,24 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
         ckey = _io_cache_key(f, columns)
         got = _io_cache_get(ckey)
         if got is not None:
+            trace.record("decode", "cached")
             return got
         try:
             cols = list(columns) if columns is not None else list(schema.names)
             hints = _dtype_hints(schema, cols)
             got = native.read_columns(f, cols, hints) if hints is not None else None
-        except (native.NativeUnsupported, OSError, KeyError):
+        except (native.NativeUnsupported, OSError, KeyError) as e:
+            if os.environ.get("HS_DEBUG_DECODE_FALLBACK"):
+                import sys
+
+                print(f"DECODE-FALLBACK {f}: {type(e).__name__}: {e}", file=sys.stderr)
             got = None
         if got is None:
+            trace.record("decode", "pyarrow")
             t = pads.dataset([f], format="parquet").to_table(columns=columns)
             got = B.table_to_batch(t)
+        else:
+            trace.record("decode", "native")
         _io_cache_put(ckey, got)
         return got
 
@@ -211,6 +228,8 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
     # completion. Fully-cached reads (here: the columns=None case, now known
     # schema-consistent) skip the pool: no decode to parallelize.
     if cached and all(b is not None for b in cached):
+        for _ in cached:
+            trace.record("decode", "cached")
         batches = cached
     elif len(files) > 1:
         batches = list(_decode_pool().map(read_one, files, schemas))

@@ -1,0 +1,60 @@
+"""Execution-dispatch trace: which physical path each operator actually took.
+
+The reference approves a *simplified executedPlan* tree per TPC-DS query
+(ref: goldstandard/PlanStabilitySuite.scala:83-290), so falling off a fast
+path (bucketed SMJ -> generic merge, codegen -> interpreted) is a test
+failure. This framework's physical dispatch is decided at runtime (device vs
+host by row-count gates, native vs pyarrow decode per file, DeviceUnsupported
+fallbacks), so the equivalent pin is a recorded trace: decision points call
+:func:`record`, and the golden tests approve the counted summary alongside
+the optimized plan.
+
+Recording is off by default (one ``is None`` check per event) and
+process-global, NOT thread-local: the parquet decode pool's worker threads
+must land their events in the caller's recording. One recording at a time;
+list.append is atomic under the GIL. Enable with::
+
+    with trace.recording() as events:
+        q.collect()
+    print(trace.summarize(events))
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+from typing import Iterator, List, Optional
+
+_events: Optional[List] = None
+
+
+def record(kind: str, detail: str) -> None:
+    """Append a dispatch event (e.g. ``record("join", "device-smj")``) to the
+    active recorder, if any."""
+    events = _events
+    if events is not None:
+        events.append((kind, detail))
+
+
+def active() -> bool:
+    return _events is not None
+
+
+@contextlib.contextmanager
+def recording() -> Iterator[List]:
+    """Collect dispatch events for the duration of the block."""
+    global _events
+    prev = _events
+    _events = []
+    try:
+        yield _events
+    finally:
+        _events = prev
+
+
+def summarize(events: List) -> str:
+    """Stable text form for goldens: one ``kind: detail xN`` line per distinct
+    event, sorted."""
+    counts = Counter(events)
+    lines = [f"{kind}: {detail} x{n}" for (kind, detail), n in sorted(counts.items())]
+    return "\n".join(lines) if lines else "(no dispatch events)"

@@ -314,8 +314,13 @@ def read_columns(path: str, columns: List[str], dtype_hints: Optional[Dict[str, 
         for c in columns:
             values, validity = f.read_column(c)
             hint = hints.get(c)
-            if hint is not None and values.dtype.kind in ("i", "u") and hint.itemsize == values.dtype.itemsize:
-                values = values.view(hint)
+            if hint is not None and values.dtype.kind in ("i", "u"):
+                if hint.itemsize == values.dtype.itemsize:
+                    values = values.view(hint)
+                elif hint.kind == "M":
+                    # int32-backed date32 widens to datetime64[D] (astype
+                    # treats ints as counts of the target unit since epoch)
+                    values = values.astype(hint)
             if validity is not None and not validity.all():
                 if values.dtype.kind == "f":
                     values = values.copy()

@@ -1504,6 +1504,8 @@ def _plan_from(q: Query, views):
     if len(built) > 1:
         where_n = _factor_or_common(q.where) if q.where is not None else None
         conjuncts = split_conjunctive(where_n) if where_n is not None else []
+        _push_single_frame_conjuncts(built, conjuncts, used)
+        df, alias_cols = built[0]
         pending = built[1:]
         while pending:
             progress = False
@@ -1589,7 +1591,9 @@ def _is_single_row(plan) -> bool:
     from hyperspace_tpu.plan import logical as L
 
     node = plan
-    while isinstance(node, (L.Project, L.Rename, L.Compute, L.Sort)):
+    # Filter included: a filtered single-row frame is still <= 1 row (the
+    # pushdown pass may wrap a global-aggregate derived table in a Filter)
+    while isinstance(node, (L.Project, L.Rename, L.Compute, L.Sort, L.Filter)):
         (node,) = node.children()
     if isinstance(node, L.Limit):
         return node.n <= 1
@@ -1684,6 +1688,56 @@ def _factor_or_common(e: Expr) -> Expr:
         # a branch reduced to exactly the common part: the OR is implied
         return _and_all(common)
     return _and_all(common) & _or_all([r for r in residuals if r is not None])
+
+
+def _push_single_frame_conjuncts(built, conjuncts, used) -> None:
+    """Filter each FROM frame by the WHERE conjuncts that reference only that
+    frame, BEFORE any join is built (Catalyst's PushDownPredicates role). An
+    upper filter over an N-way self-join (TPC-DS q4/q11/q31: 4 references to
+    one year_total CTE, distinguished only by per-reference year/channel
+    predicates) otherwise materializes the unfiltered cross-growth first —
+    quadratic-to-quartic row explosion that the filter then throws away."""
+    frame_lowers = [{c.lower(): c for c in fr.plan.output_columns} for fr, _ in built]
+
+    def owner(name: str):
+        """(frame index, actual column) when the ref resolves into exactly
+        one frame; None otherwise (unknown alias, or bare name in several)."""
+        if "." in name:
+            qual, rest = name.split(".", 1)
+            ql, rl = qual.lower(), rest.lower()
+            hits = [
+                (i, amap[ql][rl])
+                for i, (_, amap) in enumerate(built)
+                if ql in amap and rl in amap[ql]
+            ]
+            return hits[0] if len(hits) == 1 else None
+        ln = name.lower()
+        hits = [(i, low[ln]) for i, low in enumerate(frame_lowers) if ln in low]
+        return hits[0] if len(hits) == 1 else None
+
+    for ci, term in enumerate(conjuncts):
+        if ci in used or _contains_marker(term):
+            continue
+        refs = sorted(term.references())
+        if not refs:
+            continue
+        target, mapping, ok = None, {}, True
+        for r in refs:
+            got = owner(r)
+            if got is None:
+                ok = False
+                break
+            i, cn = got
+            if target is None:
+                target = i
+            elif target != i:
+                ok = False
+                break
+            mapping[r] = cn
+        if ok and target is not None:
+            fr, amap_r = built[target]
+            built[target] = (fr.filter(_rewrite(term, mapping)), amap_r)
+            used.add(ci)
 
 
 def _classify_two_sided(name: str, left_aliases, right_aliases, left_lower, right_lower):

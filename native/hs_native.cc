@@ -536,6 +536,7 @@ struct ChunkCursor {
   size_t end;        // end of chunk region
   // dictionary (raw PLAIN-encoded dictionary page payload)
   const uint8_t* dict = nullptr;
+  size_t dict_len = 0;  // payload length — the bound for parsing dict entries
   int64_t dict_count = 0;
   bool optional;
   // decompressed page bodies (snappy/gzip); dict buffer outlives data pages
@@ -582,8 +583,10 @@ static bool next_data_page(ChunkCursor& c, PageData& out) {
         page_decompress(codec, body, ph.compressed_size, c.dict_scratch.data(),
                         ph.uncompressed_size);
         c.dict = c.dict_scratch.data();
+        c.dict_len = static_cast<size_t>(ph.uncompressed_size);
       } else {
         c.dict = body;
+        c.dict_len = static_cast<size_t>(ph.compressed_size);
       }
       c.dict_count = ph.dict_num_values;
       continue;
@@ -835,6 +838,8 @@ int64_t hsn_read_fixed(void* hp, int32_t col, void* out, uint8_t* validity) {
           if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
           int bw = pd.values[0];
           if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
+          if (static_cast<uint64_t>(cur.dict_count) * width > cur.dict_len)
+            throw ThriftError("truncated dictionary");  // header claims more entries than payload holds
           idx.assign(present, 0);
           decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
           int64_t vi = 0;
@@ -927,8 +932,12 @@ int64_t hsn_read_binary(void* hp, int32_t col, int64_t* offsets, uint8_t* data,
           if (!dict_resolved) {
             dict_spans.clear();
             const uint8_t* p = cur.dict;
-            // dictionary page payload is PLAIN byte arrays; bound by chunk end
-            const uint8_t* dend = h->map + cur.end;
+            // bound by the dictionary PAYLOAD length: a decompressed dict
+            // lives in heap scratch, so any file-offset bound (h->map +
+            // cur.end) is meaningless for it — comparing heap pointers
+            // against mmap offsets made decode fail or pass depending on
+            // address-space layout
+            const uint8_t* dend = cur.dict + cur.dict_len;
             for (int64_t d = 0; d < cur.dict_count; d++) {
               if (dend - p < 4) throw ThriftError("truncated dictionary");
               uint32_t len;

@@ -354,7 +354,8 @@ class TestAggregates:
         host = q.collect()
         for k in ("lo", "hi", "mean"):
             assert np.isnan(dev[k][0]) and np.isnan(host[k][0]), k
-        assert dev["total"][0] == host["total"][0] == 0.0
+        # SQL: SUM over zero non-null values is NULL (not pandas' 0)
+        assert np.isnan(dev["total"][0]) and np.isnan(host["total"][0])
 
     def test_device_declines_bare_count_star(self, session, hs, data):
         """count(*) with no predicate has no device-resident columns — the

@@ -1101,7 +1101,8 @@ def test_empty_join_float_sum_dtype(session, tmp_path):
     plain = ldf.join(rdf, on="k").agg(s=("v", "sum")).collect()
     session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
     assert got["s"].dtype == plain["s"].dtype == np.float64
-    assert got["s"][0] == plain["s"][0] == 0.0
+    # SQL: SUM over an empty join is NULL (not 0) on both paths
+    assert np.isnan(got["s"][0]) and np.isnan(plain["s"][0])
 
 
 class TestGroupedFusedJoinAggregate:

@@ -303,3 +303,24 @@ class TestColumnPruning:
         q = df.filter(hst.col("c3") > 100.0)
         text = hs.explain(q, mode="console")
         assert "<----" not in text  # no spurious plan diff when nothing applied
+
+
+def test_pushed_conjunct_keeps_single_row_cross_join(session, tmp_path):
+    """A single-row derived table that gets a WHERE conjunct pushed onto it
+    (wrapping it in Filter) must still cross-join via the single-row path
+    (code-review regression: _is_single_row must unwrap Filter)."""
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    root = tmp_path / "t1"
+    root.mkdir()
+    pq.write_table(
+        pa.table({"k": np.arange(10, dtype=np.int64), "x": np.arange(10, dtype=np.int64) * 2}),
+        root / "p.parquet",
+    )
+    session.read_parquet(str(root)).create_or_replace_temp_view("tt")
+    got = session.sql(
+        "SELECT k FROM tt, (SELECT max(x) AS m FROM tt) s WHERE s.m > 0 AND tt.k < s.m"
+    ).collect()
+    assert sorted(got["k"].tolist()) == list(range(10))

@@ -332,3 +332,33 @@ def _snappy_compress_literal(payload: bytes) -> bytes:
         out += m.to_bytes(nbytes, "little")
     out += payload
     return bytes(out)
+
+
+def test_date32_decodes_natively(tmp_path, no_pyarrow_fallback):
+    """date32 (INT32 days) columns must decode natively — TPC-H's biggest
+    tables carry dates, and a date column must not push the whole file onto
+    the pyarrow fallback."""
+    n = 1000
+    days = np.arange(n, dtype=np.int64) % 2500
+    dates = (np.datetime64("1992-01-01") + days.astype("timedelta64[D]"))
+    t = pa.table({
+        "d": dates,  # arrow date32
+        "k": np.arange(n, dtype=np.int64),
+    })
+    assert pa.types.is_date32(t.schema.field("d").type)
+    p = str(tmp_path / "dates.parquet")
+    pq.write_table(t, p)
+    got = read_parquet_batch([p], ["d", "k"])
+    assert got["d"].dtype == np.dtype("datetime64[D]")
+    np.testing.assert_array_equal(got["d"], dates)
+
+
+def test_date32_nulls_decode_natively(tmp_path, no_pyarrow_fallback):
+    vals = [0, None, 100, None, 9000]
+    t = pa.table({"d": pa.array(vals, type=pa.date32())})
+    p = str(tmp_path / "dates_null.parquet")
+    pq.write_table(t, p)
+    got = read_parquet_batch([p], ["d"])
+    assert got["d"].dtype.kind == "M"
+    assert np.isnat(got["d"][1]) and np.isnat(got["d"][3])
+    assert got["d"][4] == np.datetime64("1970-01-01") + np.timedelta64(9000, "D")

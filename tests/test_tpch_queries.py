@@ -134,12 +134,37 @@ def _gen(cname, kind, n, rng):
     return np.array([f"{cname[:5]}_{i % 37}" for i in range(n)], dtype=object)
 
 
+# Covering indexes wide enough that the rules actually fire on the standard
+# query texts (a join index must cover every column its side needs,
+# ref: JoinIndexRule.scala:419-448 — the dispatch goldens prove which of the
+# 22 queries rewrite and which physical path each one takes)
 INDEXES = [
-    ("lineitem", "li_ok", ["l_orderkey"], ["l_extendedprice", "l_discount", "l_quantity"]),
-    ("lineitem", "li_sd", ["l_shipdate"], ["l_extendedprice", "l_discount"]),
-    ("orders", "o_ok", ["o_orderkey"], ["o_orderdate", "o_totalprice"]),
-    ("customer", "c_ck", ["c_custkey"], ["c_name", "c_acctbal"]),
-    ("part", "p_pk", ["p_partkey"], ["p_brand", "p_type"]),
+    ("lineitem", "li_ok", ["l_orderkey"],
+     ["l_extendedprice", "l_discount", "l_quantity", "l_tax", "l_shipdate",
+      "l_commitdate", "l_receiptdate", "l_shipmode", "l_returnflag",
+      "l_linestatus", "l_suppkey", "l_partkey"]),
+    ("lineitem", "li_sd", ["l_shipdate"],
+     ["l_extendedprice", "l_discount", "l_quantity"]),
+    ("lineitem", "li_pk", ["l_partkey"],
+     ["l_extendedprice", "l_discount", "l_quantity", "l_shipdate",
+      "l_shipmode", "l_shipinstruct"]),
+    ("orders", "o_ok", ["o_orderkey"],
+     ["o_custkey", "o_orderdate", "o_totalprice", "o_orderpriority",
+      "o_orderstatus", "o_shippriority"]),
+    ("orders", "o_ck", ["o_custkey"],
+     ["o_orderkey", "o_orderdate", "o_totalprice", "o_shippriority",
+      "o_comment"]),
+    ("customer", "c_ck", ["c_custkey"],
+     ["c_name", "c_acctbal", "c_mktsegment", "c_nationkey", "c_phone",
+      "c_address", "c_comment"]),
+    ("part", "p_pk", ["p_partkey"],
+     ["p_name", "p_mfgr", "p_brand", "p_type", "p_size", "p_container",
+      "p_retailprice"]),
+    ("supplier", "s_sk", ["s_suppkey"],
+     ["s_name", "s_address", "s_nationkey", "s_phone", "s_acctbal",
+      "s_comment"]),
+    ("partsupp", "ps_pk", ["ps_partkey"],
+     ["ps_suppkey", "ps_availqty", "ps_supplycost"]),
 ]
 
 _ROWS = {"region": 3, "nation": 6, "supplier": 40, "customer": 60, "part": 80,
@@ -257,6 +282,34 @@ def test_query_plans_and_answers(tpch, qname):
     # make the on/off parity assertion meaningless
     n_rows = len(next(iter(on.values()))) if on else 0
     assert n_rows > 0, f"{qname} returned no rows; fixture degraded"
+
+    # physical-dispatch golden (ref: PlanStabilitySuite approves the
+    # *executedPlan*, scala:83-290): record which path every operator took
+    # with the device gate open, so silently falling off the device/native
+    # fast paths fails the test, not just slows the query
+    from hyperspace_tpu.exec import device as D
+    from hyperspace_tpu.exec import io as hs_io
+    from hyperspace_tpu.exec import trace
+
+    hs_io.clear_io_cache()
+    D.clear_device_cache()
+    sess.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+    try:
+        with trace.recording() as events:
+            q.collect()
+    finally:
+        sess.conf.unset(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS)
+    dispatch = trace.summarize(events)
+    dpath = os.path.join(APPROVED_DIR, f"{qname}.dispatch.txt")
+    if GENERATE:
+        with open(dpath, "w") as f:
+            f.write(dispatch)
+    else:
+        with open(dpath) as f:
+            assert dispatch == f.read(), (
+                f"physical dispatch for {qname} changed; review and regen "
+                "with HS_GENERATE_GOLDEN=1"
+            )
 
 
 def test_all_22_covered():

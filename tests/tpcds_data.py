@@ -1,0 +1,500 @@
+"""TPC-DS-shaped fixture data: small tables whose value domains satisfy the
+103 reference query texts' predicates, so on/off parity checks are not
+vacuous (a query returning 0 rows proves nothing).
+
+Shape sources:
+- ``date_dim`` is a real calendar (1998-01-01 .. 2002-12-31) with consistent
+  d_year/d_moy/d_dom/d_qoy/d_dow/d_month_seq/d_week_seq (TPC-DS convention:
+  d_month_seq 1200 == Jan 2000), because nearly every query correlates
+  through it.
+- String/numeric domains below were extracted from the literals the query
+  texts actually use (i_category = 'Books', s_state = 'TN', d_dow in (6,0),
+  ...): ~60%% of each column's rows draw from the query-relevant domain.
+- Foreign keys land inside the referenced table's surrogate-key range, and
+  each returns table's (item_sk, order/ticket number) pairs are sampled from
+  its sales table so returns join back to sales (q24/q64/q78 shapes).
+
+The reference fills these tables from dsdgen at scale; this is the smallest
+generator whose data makes the query suite meaningful (TPCDSBase.scala
+creates the schema over EMPTY dirs — plan-stability only; this suite also
+checks answers).
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import numpy as np
+
+from tpcds_schema import TPCDS_SCHEMAS
+
+# literals the 103 query texts predicate on (lower-cased column -> values)
+STRING_DOMAINS = {
+    "c_preferred_cust_flag": ["Y", "N"],
+    "ca_city": ["Edgewood", "Fairview", "Midway", "Oakland"],
+    "ca_country": ["United States"],
+    "ca_county": ["Dona Ana County", "Jefferson County", "La Porte County",
+                  "Rush County", "Toole County", "Williamson County"],
+    "ca_state": ["AR", "CA", "CO", "CT", "GA", "IA", "IL", "IN", "KY", "LA",
+                 "MN", "MS", "MT", "NC", "ND", "NE", "NM", "NY", "OH", "OK",
+                 "OR", "SC", "SD", "TN", "TX", "UT", "VA", "WA", "WI", "WV"],
+    "cc_county": ["Williamson County"],
+    "cd_education_status": ["2 yr Degree", "4 yr Degree", "Advanced Degree",
+                            "College", "Primary", "Secondary", "Unknown"],
+    "cd_gender": ["M", "F"],
+    "cd_marital_status": ["M", "S", "D", "W", "U"],
+    "cd_credit_rating": ["Good", "High Risk", "Low Risk", "Unknown"],
+    "hd_buy_potential": [">10000", "unknown", "Unknown", "1001-5000", "0-500"],
+    "i_brand": ["amalgimporto #1", "edu packscholar #1", "exportiimporto #1",
+                "exportiunivamalg #9", "importoamalg #1",
+                "scholaramalgamalg #14", "scholaramalgamalg #7",
+                "scholaramalgamalg #9"],
+    "i_category": ["Books", "Children", "Electronics", "Home", "Jewelry",
+                   "Men", "Music", "Shoes", "Sports", "Women"],
+    "i_class": ["accessories", "birdal", "classical", "computers", "dresses",
+                "football", "fragrances", "maternity", "pants", "personal",
+                "portable", "reference", "self-help", "shirts", "wallpaper"],
+    "i_color": ["pale", "chiffon", "slate", "blanched", "burnished", "purple",
+                "burlywood", "indian", "spring", "floral", "medium", "brown",
+                "cornflower", "cyan", "deep", "forest", "frosted", "ghost",
+                "honeydew", "khaki", "light", "midnight", "orange", "papaya",
+                "powder", "snow", "rose", "metallic", "dim", "smoke"],
+    "i_size": ["N/A", "extra large", "large", "medium", "petite", "small"],
+    "i_units": ["Box", "Bunch", "Bundle", "Cup", "Dozen", "Dram", "Each",
+                "Gross", "Lb", "N/A", "Ounce", "Oz", "Pallet", "Pound",
+                "Tbl", "Ton"],
+    "p_channel_dmail": ["Y", "N"],
+    "p_channel_email": ["N", "Y"],
+    "p_channel_event": ["N", "Y"],
+    "p_channel_tv": ["N", "Y"],
+    "r_reason_desc": ["reason 28", "reason 1", "reason 2"],
+    "s_city": ["Fairview", "Midway"],
+    "s_county": ["Bronx County", "Franklin Parish", "Orange County",
+                 "Williamson County"],
+    "s_state": ["TN", "SD", "AL"],
+    "s_store_name": ["ese", "ought", "able", "bar"],
+    "sm_carrier": ["BARIAN", "DHL", "UPS", "FEDEX"],
+    "sm_type": ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR", "TWO DAY"],
+    "t_meal_time": ["breakfast", "dinner", "lunch", "N/A"],
+    "web_company_name": ["pri", "sec"],
+    "s_geography_class": ["Unknown"],
+    "c_birth_country": ["CANADA", "MEXICO", "GERMANY", "FRANCE", "JAPAN",
+                        "BRAZIL", "INDIA", "UNITED STATES"],
+    "ca_location_type": ["condo", "single family", "apartment"],
+    # q8's zip list (substr(ca_zip,1,5) membership; s_zip joins by prefix)
+    "ca_zip": ["24128", "76232", "65084", "87816", "83926", "77556", "20548",
+               "26231", "43848", "15126", "91137", "61265", "98294", "25782",
+               "10144", "10336", "10390", "10445", "10516", "10567"],
+    "s_zip": ["24128", "76232", "65084", "87816", "83926", "77556", "20548",
+              "26231", "43848", "15126"],
+}
+
+NUM_DOMAINS = {
+    "hd_dep_count": [0, 1, 2, 3, 4, 5, 6, 7, 8, 9],
+    "hd_vehicle_count": [-1, 0, 1, 2, 3, 4],
+    "i_manager_id": [1, 8, 28, 33, 36, 38, 40, 59, 91, 100],
+    "i_manufact_id": [128, 129, 270, 350, 423, 677, 694, 808, 821, 940, 977],
+    "t_hour": [8, 9, 10, 11, 12, 15, 16, 20],
+    "c_birth_month": [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12],
+    "s_number_employees": [200, 250, 290, 295, 300],
+    "s_market_id": [5, 7, 8, 10],
+    "ss_quantity": list(range(1, 101)),
+    "cs_quantity": list(range(1, 101)),
+    "ws_quantity": list(range(1, 101)),
+}
+
+GMT_OFFSETS = [-5.0, -6.0, -7.0, -8.0]
+
+ROWS = {
+    "date_dim": None,  # calendar-determined (1826)
+    "time_dim": 720,
+    "customer": 200,
+    "customer_address": 160,
+    "customer_demographics": 240,
+    "household_demographics": 72,
+    "income_band": 20,
+    "item": 140,
+    "store": 12,
+    "warehouse": 8,
+    "promotion": 30,
+    "reason": 10,
+    "ship_mode": 12,
+    "web_site": 6,
+    "web_page": 20,
+    "call_center": 6,
+    "catalog_page": 40,
+    "store_sales": 6000,
+    "catalog_sales": 3000,
+    "web_sales": 3000,
+    "store_returns": 1200,
+    "catalog_returns": 800,
+    "web_returns": 800,
+    "inventory": 2400,
+}
+
+# foreign keys by suffix -> referenced table (sk base offset for date_dim)
+DATE_SK0 = 2450815  # TPC-DS julian-day convention for 1998-01-01
+
+_FK_SUFFIX = {
+    "_date_sk": "date_dim",
+    "_time_sk": "time_dim",
+    "_item_sk": "item",
+    "_customer_sk": "customer",
+    "_cdemo_sk": "customer_demographics",
+    "_hdemo_sk": "household_demographics",
+    "_addr_sk": "customer_address",
+    "_store_sk": "store",
+    "_promo_sk": "promotion",
+    "_warehouse_sk": "warehouse",
+    "_ship_mode_sk": "ship_mode",
+    "_web_page_sk": "web_page",
+    "_web_site_sk": "web_site",
+    "_call_center_sk": "call_center",
+    "_reason_sk": "reason",
+    "_catalog_page_sk": "catalog_page",
+    "_income_band_sk": "income_band",
+    "_page_sk": "web_page",
+}
+
+
+def _calendar():
+    start = np.datetime64("1998-01-01")
+    end = np.datetime64("2003-01-01")
+    dates = np.arange(start, end, dtype="datetime64[D]")
+    n = len(dates)
+    years = dates.astype("datetime64[Y]").astype(int) + 1970
+    months0 = dates.astype("datetime64[M]").astype(int)  # months since 1970-01
+    moy = months0 % 12 + 1
+    dom = (dates - dates.astype("datetime64[M]")).astype(int) + 1
+    # TPC-DS d_dow: 0 = Sunday; numpy day 0 (1970-01-01) was a Thursday
+    dow = (dates.astype(int) + 4) % 7
+    day_names = np.array(["Sunday", "Monday", "Tuesday", "Wednesday",
+                          "Thursday", "Friday", "Saturday"], dtype=object)[dow]
+    qoy = (moy - 1) // 3 + 1
+    cols = {
+        "d_date_sk": np.arange(n, dtype=np.int64) + DATE_SK0,
+        "d_date_id": np.array([f"AAAAAAAA{i:08d}" for i in range(n)], dtype=object),
+        "d_date": dates,
+        "d_month_seq": ((years - 1900) * 12 + moy - 1).astype(np.int64),
+        "d_week_seq": ((dates.astype(int) + 4) // 7).astype(np.int64),
+        "d_quarter_seq": ((years - 1900) * 4 + qoy - 1).astype(np.int64),
+        "d_year": years.astype(np.int64),
+        "d_dow": dow.astype(np.int64),
+        "d_moy": moy.astype(np.int64),
+        "d_dom": dom.astype(np.int64),
+        "d_qoy": qoy.astype(np.int64),
+        "d_fy_year": years.astype(np.int64),
+        "d_fy_quarter_seq": ((years - 1900) * 4 + qoy - 1).astype(np.int64),
+        "d_fy_week_seq": ((dates.astype(int) + 4) // 7).astype(np.int64),
+        "d_day_name": day_names,
+        "d_quarter_name": np.array([f"{y}Q{q}" for y, q in zip(years, qoy)], dtype=object),
+        "d_holiday": np.where(dow == 0, "Y", "N").astype(object),
+        "d_weekend": np.where((dow == 0) | (dow == 6), "Y", "N").astype(object),
+        "d_following_holiday": np.where(dow == 1, "Y", "N").astype(object),
+        "d_first_dom": np.arange(n, dtype=np.int64) + DATE_SK0 - (dom - 1),
+        "d_last_dom": np.arange(n, dtype=np.int64) + DATE_SK0 + 27,
+        "d_same_day_ly": np.arange(n, dtype=np.int64) + DATE_SK0 - 365,
+        "d_same_day_lq": np.arange(n, dtype=np.int64) + DATE_SK0 - 91,
+        "d_current_day": np.full(n, "N", dtype=object),
+        "d_current_week": np.full(n, "N", dtype=object),
+        "d_current_month": np.full(n, "N", dtype=object),
+        "d_current_quarter": np.full(n, "N", dtype=object),
+        "d_current_year": np.full(n, "N", dtype=object),
+    }
+    # keep only the roster's columns, in roster order
+    return {c: cols[c] for c in TPCDS_SCHEMAS["date_dim"]}
+
+
+def _time_dim():
+    n = ROWS["time_dim"]
+    i = np.arange(n, dtype=np.int64)
+    hour = (i * 24 // n).astype(np.int64)
+    minute = i % 60
+    meal = np.where(
+        (hour >= 6) & (hour <= 9), "breakfast",
+        np.where((hour >= 11) & (hour <= 13), "lunch",
+                 np.where((hour >= 17) & (hour <= 21), "dinner", "N/A")),
+    ).astype(object)
+    cols = {
+        "t_time_sk": i,
+        "t_time_id": np.array([f"TIME{k:08d}" for k in range(n)], dtype=object),
+        "t_time": hour * 3600 + minute * 60,
+        "t_hour": hour,
+        "t_minute": minute.astype(np.int64),
+        "t_second": np.zeros(n, dtype=np.int64),
+        "t_am_pm": np.where(hour < 12, "AM", "PM").astype(object),
+        "t_shift": np.where(hour < 8, "first", np.where(hour < 16, "second", "third")).astype(object),
+        "t_sub_shift": np.where(hour < 12, "morning", np.where(hour < 18, "afternoon", "night")).astype(object),
+        "t_meal_time": meal,
+    }
+    return {c: cols[c] for c in TPCDS_SCHEMAS["time_dim"]}
+
+
+def _fk_table(cname: str):
+    for suffix, table in _FK_SUFFIX.items():
+        if cname.endswith(suffix):
+            return table
+    return None
+
+
+def _sk_domain(table: str):
+    if table == "date_dim":
+        return DATE_SK0, DATE_SK0 + 1826
+    return 0, ROWS[table]
+
+
+def arrow_tables():
+    """-> {table: pa.Table} with the q76 NULL masks applied."""
+    import pyarrow as pa
+
+    tables, null_masks = build_tables()
+    out = {}
+    for name, cols in tables.items():
+        arrays = {}
+        for cn, v in cols.items():
+            mask = null_masks.get((name, cn))
+            arrays[cn] = pa.array(v, mask=mask) if mask is not None else pa.array(v)
+        out[name] = pa.table(arrays)
+    return out
+
+
+def build_tables():
+    """-> ({table: {column: np.ndarray}}, {(table, column): null mask}) with
+    deterministic per-table seeds."""
+    out = {"date_dim": _calendar(), "time_dim": _time_dim()}
+    order = [t for t in TPCDS_SCHEMAS if t not in out]
+    # dims first so fact FKs can reference sizes (sizes are static anyway)
+    for name in order:
+        rng = np.random.default_rng(zlib.crc32(name.encode()))
+        schema = TPCDS_SCHEMAS[name]
+        n = ROWS[name]
+        cols = {}
+        first = next(iter(schema))
+        for cname, t in schema.items():
+            lc = cname.lower()
+            if cname == first and cname.endswith("_sk") and _fk_table(lc) in (name, None):
+                # a dimension's own surrogate key (item.i_item_sk); a fact
+                # table's first column is a foreign key (ss_sold_date_sk)
+                cols[cname] = np.arange(n, dtype=np.int64)  # primary key
+            elif t == "I" and _fk_table(lc) is not None:
+                lo, hi = _sk_domain(_fk_table(lc))
+                if _fk_table(lc) == "date_dim":
+                    # concentrate activity in 2000-2001 (the years most query
+                    # windows target) plus a pinch on the exact dates
+                    # q58/q83 name, instead of uniform over five years
+                    u = rng.random(n)
+                    uniform = rng.integers(lo, hi, n)
+                    y2000 = lo + 365 * 2 + rng.integers(0, 730, n)  # 2000-2001
+                    hot = np.asarray(
+                        [np.datetime64(s) - np.datetime64("1998-01-01") for s in
+                         ("2000-01-03", "2000-06-30", "2000-09-27", "2000-11-17")]
+                    ).astype(np.int64) + lo
+                    vals = np.where(u < 0.45, uniform, np.where(u < 0.93, y2000, hot[rng.integers(0, 4, n)]))
+                    cols[cname] = vals.astype(np.int64)
+                else:
+                    cols[cname] = rng.integers(lo, hi, n).astype(np.int64)
+            elif lc in NUM_DOMAINS:
+                dom = np.asarray(NUM_DOMAINS[lc], dtype=np.int64)
+                cols[cname] = dom[rng.integers(0, len(dom), n)]
+            elif lc.endswith("_gmt_offset"):
+                cols[cname] = np.asarray(GMT_OFFSETS, dtype=np.float64)[
+                    rng.integers(0, len(GMT_OFFSETS), n)
+                ]
+            elif lc in STRING_DOMAINS:
+                dom = STRING_DOMAINS[lc]
+                # mild Zipf toward early entries: HAVING-count thresholds
+                # (q6's count >= 10 per state) need value concentration,
+                # not a uniform spread
+                w = 1.0 / (np.arange(len(dom)) + 2.0)
+                pick = rng.choice(len(dom), n, p=w / w.sum())
+                vals = np.array([dom[p] for p in pick], dtype=object)
+                other = rng.random(n) >= 0.9  # small tail outside the domain
+                vals[other] = np.array([f"{lc[:5]}_{v}" for v in np.nonzero(other)[0]], dtype=object)
+                cols[cname] = vals
+            elif lc.endswith("_id"):
+                # business ids UNIQUE (q4/q11/q31 CTE self-joins explode on
+                # collisions); customers deliberately share ids with nothing
+                cols[cname] = np.array(
+                    [f"{lc[:6]}_{i:06d}" for i in rng.permutation(n)], dtype=object
+                )
+            elif lc.endswith("_year"):
+                cols[cname] = rng.integers(1998, 2003, n).astype(np.int64)
+            elif lc.endswith(("_moy", "_month_seq")):
+                cols[cname] = rng.integers(1176, 1236, n).astype(np.int64) if lc.endswith("_month_seq") else rng.integers(1, 13, n).astype(np.int64)
+            elif t == "I":
+                cols[cname] = rng.integers(0, max(n, 20), n).astype(np.int64)
+            elif lc == "i_current_price":
+                # bimodal: q21's 0.99-1.49 window AND q37/q64/q82's 60-100
+                # BETWEEN windows both need occupants
+                lowp = rng.random(n) < 0.4
+                cols[cname] = np.round(
+                    np.where(lowp, rng.uniform(0.8, 1.6, n), rng.uniform(55, 105, n)), 2
+                )
+            elif lc.endswith(("_return_amt", "_return_amount")):
+                # q49's ratio CTEs require individual return amounts above
+                # 10000; heavy-tailed so both small and huge returns exist
+                cols[cname] = np.round(rng.exponential(6000.0, n), 2)
+            elif lc == "inv_quantity_on_hand":
+                # lognormal (median ~100, cov ~1.9): q37/q82's BETWEEN 100
+                # AND 500 window, q39's cov > 1 filter, and q72's
+                # inv < cs_quantity all need a heavy tail plus small values
+                cols[cname] = rng.lognormal(4.6, 1.2, n).astype(np.int64)
+            elif t == "F":
+                cols[cname] = np.round(rng.uniform(0, 160, n), 2)
+            elif t == "D":
+                cols[cname] = np.datetime64("1998-01-01") + rng.integers(0, 1826, n).astype("timedelta64[D]")
+            else:
+                cols[cname] = np.array([f"{lc[:6]}_{v}" for v in rng.integers(0, max(n // 2, 10), n)], dtype=object)
+        out[name] = cols
+
+    # q41 hand-crafted items: manufact ids in its BETWEEN 738 AND 778 window
+    # with the exact (category, color, units, size) conjunctions its EXISTS
+    # subquery counts — random draws essentially never co-produce these
+    q41 = [
+        ("Women", "powder", "Ounce", "medium"),
+        ("Women", "khaki", "Oz", "extra large"),
+        ("Women", "brown", "Bunch", "N/A"),
+        ("Women", "honeydew", "Ton", "small"),
+        ("Men", "floral", "N/A", "petite"),
+        ("Men", "deep", "Dozen", "large"),
+        ("Men", "light", "Box", "medium"),
+        ("Men", "cornflower", "Pound", "extra large"),
+        ("Women", "midnight", "Pallet", "medium"),
+        ("Women", "snow", "Gross", "extra large"),
+        ("Women", "cyan", "Cup", "N/A"),
+        ("Women", "papaya", "Dram", "small"),
+        ("Men", "orange", "Each", "petite"),
+        ("Men", "frosted", "Tbl", "large"),
+    ]
+    it = out["item"]
+    manu41 = [738, 742, 750, 758, 766, 778]
+    for j, (cat, color, units, size) in enumerate(q41):
+        it["i_category"][j] = cat
+        it["i_color"][j] = color
+        it["i_units"][j] = units
+        it["i_size"][j] = size
+        it["i_manufact_id"][j] = manu41[j % len(manu41)]
+        it["i_manufact"][j] = f"manu_{manu41[j % len(manu41)]}"
+
+    # inventory is a (warehouse x item-subset x weekly snapshot) GRID, like
+    # dsdgen's: q39's per-month coefficient of variation needs several
+    # observations per (w, i, month) group — independent random rows give
+    # group sizes of ~1 where stdev is identically 0
+    n_w = ROWS["warehouse"]
+    items_inv = np.arange(0, ROWS["item"], 5, dtype=np.int64)  # every 5th item
+    weeks = np.arange(DATE_SK0 + 730, DATE_SK0 + 1460, 14, dtype=np.int64)  # biweekly 2000-2001
+    grid_w, grid_i, grid_d = np.meshgrid(
+        np.arange(n_w, dtype=np.int64), items_inv, weeks, indexing="ij"
+    )
+    inv_rng = np.random.default_rng(41)
+    inv_n = grid_w.size
+    out["inventory"] = {
+        "inv_date_sk": grid_d.ravel(),
+        "inv_item_sk": grid_i.ravel(),
+        "inv_warehouse_sk": grid_w.ravel(),
+        "inv_quantity_on_hand": inv_rng.lognormal(4.6, 1.2, inv_n).astype(np.int64),
+    }
+    ROWS["inventory"] = inv_n
+
+    # income bands cover the queries' ib_lower_bound/ib_upper_bound windows
+    ib_n = ROWS["income_band"]
+    out["income_band"]["ib_lower_bound"] = (np.arange(ib_n, dtype=np.int64)) * 10000
+    out["income_band"]["ib_upper_bound"] = (np.arange(ib_n, dtype=np.int64)) * 10000 + 9999
+
+    # baskets: several lines share a ticket/order so returns and q64-style
+    # resale joins have multiplicity; ~15% of store tickets are BIG (15-20
+    # lines) because q34/q46/q68 filter on per-ticket line counts 15-20
+    rng = np.random.default_rng(9)
+    n_ss = ROWS["store_sales"]
+    tickets = []
+    tno = 0
+    while sum(len(t) for t in tickets) < n_ss:
+        size = int(rng.integers(15, 21)) if rng.random() < 0.12 else int(rng.integers(1, 6))
+        tickets.append([tno] * size)
+        tno += 1
+    flat = np.array([t for grp in tickets for t in grp][:n_ss], dtype=np.int64)
+    out["store_sales"]["ss_ticket_number"] = flat
+    # one customer+date+store+hdemo per ticket: the q34/q46 GROUP BY
+    # (ticket, customer) count only reaches 15-20 if the ticket's lines
+    # agree on those columns
+    for col in ("ss_customer_sk", "ss_sold_date_sk", "ss_store_sk",
+                "ss_hdemo_sk", "ss_addr_sk"):
+        vals = out["store_sales"][col]
+        first_of = {}
+        for i, t in enumerate(flat):
+            j = first_of.setdefault(int(t), i)
+            vals[i] = vals[j]
+    out["catalog_sales"]["cs_order_number"] = np.arange(ROWS["catalog_sales"], dtype=np.int64) // 2
+    out["web_sales"]["ws_order_number"] = np.arange(ROWS["web_sales"], dtype=np.int64) // 2
+
+    # returns reference REAL sales rows (same item + ticket/order), so
+    # sales-joins-returns queries produce rows
+    def link_returns(ret, sales, r_item, r_no, s_item, s_no, extra=(), date_pair=None):
+        m = ROWS[ret]
+        pick = rng.integers(0, ROWS[sales], m)
+        out[ret][r_item] = out[sales][s_item][pick]
+        out[ret][r_no] = out[sales][s_no][pick]
+        for rcol, scol in extra:
+            out[ret][rcol] = out[sales][scol][pick]
+        if date_pair is not None:
+            # a return happens days after its sale: q17/q25/q29/q91
+            # correlate the two date windows; a pinch of returns land
+            # exactly on q83's literal d_date values
+            rcol, scol = date_pair
+            hi = DATE_SK0 + 1825
+            dates = np.minimum(
+                out[sales][scol][pick] + rng.integers(1, 61, m), hi
+            ).astype(np.int64)
+            hot = np.asarray(
+                [np.datetime64(s) - np.datetime64("1998-01-01") for s in
+                 ("2000-06-30", "2000-09-27", "2000-11-17")]
+            ).astype(np.int64) + DATE_SK0
+            pin = rng.random(m) < 0.04
+            dates[pin] = hot[rng.integers(0, 3, pin.sum())]
+            out[ret][rcol] = dates
+
+    link_returns(
+        "store_returns", "store_sales", "sr_item_sk", "sr_ticket_number",
+        "ss_item_sk", "ss_ticket_number",
+        [("sr_customer_sk", "ss_customer_sk"), ("sr_store_sk", "ss_store_sk")],
+        date_pair=("sr_returned_date_sk", "ss_sold_date_sk"),
+    )
+    # q17/q29 chain: a catalog purchase by the same customer of the same
+    # item they returned in a store — rewrite 40% of catalog_sales rows from
+    # store_returns pairs (before catalog_returns links to cs)
+    m = ROWS["catalog_sales"]
+    take = np.nonzero(rng.random(m) < 0.4)[0]
+    pick_sr = rng.integers(0, ROWS["store_returns"], len(take))
+    out["catalog_sales"]["cs_item_sk"][take] = out["store_returns"]["sr_item_sk"][pick_sr]
+    out["catalog_sales"]["cs_bill_customer_sk"][take] = out["store_returns"]["sr_customer_sk"][pick_sr]
+
+    link_returns(
+        "catalog_returns", "catalog_sales", "cr_item_sk", "cr_order_number",
+        "cs_item_sk", "cs_order_number",
+        [("cr_returning_customer_sk", "cs_bill_customer_sk")],
+        date_pair=("cr_returned_date_sk", "cs_sold_date_sk"),
+    )
+    link_returns(
+        "web_returns", "web_sales", "wr_item_sk", "wr_order_number",
+        "ws_item_sk", "ws_order_number",
+        [("wr_returning_customer_sk", "ws_bill_customer_sk")],
+        date_pair=("wr_returned_date_sk", "ws_sold_date_sk"),
+    )
+    # q85 joins cd1/cd2 via refunded+returning demo sks requiring equal
+    # marital/education on both: make them literally the same demo row often
+    wr = out["web_returns"]
+    same = rng.random(ROWS["web_returns"]) < 0.6
+    wr["wr_refunded_cdemo_sk"] = np.where(same, wr["wr_returning_cdemo_sk"], wr["wr_refunded_cdemo_sk"])
+
+    # q76 counts fact rows with NULL dimension keys; ~7% NULLs on exactly
+    # the columns it scans (kept as masked int64 via pyarrow at write time)
+    nulls = {
+        "store_sales": ["ss_store_sk", "ss_addr_sk"],  # ss_addr_sk: q44
+        "web_sales": ["ws_ship_customer_sk"],
+        "catalog_sales": ["cs_ship_addr_sk"],
+    }
+    null_masks = {}
+    for tbl, colnames in nulls.items():
+        for cn in colnames:
+            null_masks[(tbl, cn)] = rng.random(ROWS[tbl]) < 0.07
+    return out, null_masks
