@@ -48,38 +48,49 @@ def _build() -> None:
         "-shared",
         src,
     ]
-    # gzip decode links the system zlib; a host without libz dev files must
-    # not lose the whole native path — rebuild without gzip support instead
-    res = subprocess.run(
-        base + ["-lz", "-o", _SO_PATH], capture_output=True, text=True, cwd=_SRC_DIR
-    )
-    if res.returncode != 0:
-        # Only a genuinely missing zlib justifies dropping gzip support; any
-        # other failure (transient OOM, bad flag) must surface, not silently
-        # produce a gzip-less library.
-        # GNU ld, lld, ld64 and gcc/clang all word this differently
-        zlib_missing = any(
-            marker in res.stderr
-            for marker in (
-                "cannot find -lz",  # GNU ld
-                "unable to find library -lz",  # lld
-                "library 'z' not found",  # ld64 (macOS)
-                "library not found for -lz",  # older ld64
-                "-lz: not found",
-            )
-        ) or ("zlib.h" in res.stderr and ("No such file" in res.stderr or "not found" in res.stderr))
-        if not zlib_missing:
-            raise NativeUnsupported(f"native build failed: {res.stderr[-2000:]}")
-        res = subprocess.run(
-            base + ["-DHS_NO_ZLIB", "-o", _SO_PATH],
-            capture_output=True,
-            text=True,
-            cwd=_SRC_DIR,
+    # gzip/zstd decode link the system zlib/libzstd; a host missing either
+    # dev package must not lose the whole native path — rebuild without that
+    # codec instead. Only a genuinely missing library justifies dropping it;
+    # any other failure (transient OOM, bad flag) must surface.
+    def _missing(stderr: str, lib: str, header: str) -> bool:
+        # GNU ld, lld, ld64 and gcc/clang all word this differently. The lib
+        # name must match as a whole word: 'cannot find -lz' is a substring
+        # of 'cannot find -lzstd', and matching it would drop zlib on hosts
+        # that are only missing libzstd.
+        import re
+
+        pats = (
+            rf"cannot find -l{lib}\b",  # GNU ld
+            rf"unable to find library -l{lib}\b",  # lld
+            rf"library '{lib}' not found",  # ld64 (macOS)
+            rf"library not found for -l{lib}\b",  # older ld64
+            rf"-l{lib}\b: not found",
         )
+        if any(re.search(p, stderr) for p in pats):
+            return True
+        return header in stderr and ("No such file" in stderr or "not found" in stderr)
+
+    flags: List[str] = ["-lz", "-lzstd"]
+    dropped: List[str] = []
+    res = subprocess.run(
+        base + flags + ["-o", _SO_PATH], capture_output=True, text=True, cwd=_SRC_DIR
+    )
+    for lib, header, define in (("z", "zlib.h", "-DHS_NO_ZLIB"),
+                                ("zstd", "zstd.h", "-DHS_NO_ZSTD")):
         if res.returncode == 0:
-            logging.getLogger(__name__).warning(
-                "hs_native built without gzip support (zlib missing on this host)"
-            )
+            break
+        if not _missing(res.stderr, lib, header):
+            continue
+        flags = [f for f in flags if f != f"-l{lib}"] + [define]
+        dropped.append(lib)
+        res = subprocess.run(
+            base + flags + ["-o", _SO_PATH], capture_output=True, text=True, cwd=_SRC_DIR
+        )
+    if res.returncode == 0 and dropped:
+        logging.getLogger(__name__).warning(
+            "hs_native built without %s support (missing on this host)",
+            "/".join(dropped),
+        )
     if res.returncode != 0:
         raise NativeUnsupported(f"native build failed: {res.stderr[-2000:]}")
 

@@ -21,6 +21,11 @@ class BytesLRU:
         self._entries: "OrderedDict[Hashable, tuple]" = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+        # observability for >cap working sets (benchmarks record these to
+        # show byte-capped eviction actually engaging at scale)
+        self.evictions = 0
+        self.hits = 0
+        self.misses = 0
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -33,7 +38,9 @@ class BytesLRU:
         with self._lock:
             got = self._entries.get(key)
             if got is None:
+                self.misses += 1
                 return None
+            self.hits += 1
             self._entries.move_to_end(key)
             return got[0]
 
@@ -49,6 +56,7 @@ class BytesLRU:
             while self._bytes > self.cap and self._entries:
                 _, (_, nb) = self._entries.popitem(last=False)
                 self._bytes -= nb
+                self.evictions += 1
 
     def clear(self) -> None:
         with self._lock:

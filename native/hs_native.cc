@@ -4,22 +4,26 @@
 // stance: "a C++ Parquet column-chunk decode path into device-feedable
 // buffers"; the reference is 100% JVM and delegates scans to Spark executors,
 // SURVEY.md §0). Decodes flat Parquet columns — PLAIN or RLE_DICTIONARY
-// encoded; UNCOMPRESSED, SNAPPY, or GZIP — from an mmap'd file straight into
+// encoded; UNCOMPRESSED, SNAPPY, GZIP, or ZSTD — from an mmap'd file straight into
 // caller-allocated buffers (numpy arrays on the Python side) with zero copies
 // for uncompressed pages, so index scans feed jax.device_put without
 // pyarrow/JVM row pivoting.
 //
 // The framework's own index files are written uncompressed (zero-copy fast
-// path); SNAPPY (Spark's default codec, own decompressor) and GZIP (system
-// zlib) keep externally-written lake files on the native path too. Anything
+// path); SNAPPY (Spark's default codec, own decompressor), GZIP (system
+// zlib), and ZSTD (system libzstd) keep externally-written lake files on the
+// native path too. Anything
 // outside this dialect returns an error and the Python caller falls back to
 // pyarrow.
 //
-// Build: make -C native  (g++ -O3 -shared -fPIC, links -lz)
+// Build: make -C native  (g++ -O3 -shared -fPIC, links -lz -lzstd)
 
 #include <fcntl.h>
 #ifndef HS_NO_ZLIB
 #include <zlib.h>
+#endif
+#ifndef HS_NO_ZSTD
+#include <zstd.h>
 #endif
 #include <sys/mman.h>
 #include <sys/stat.h>
@@ -464,7 +468,27 @@ static void snappy_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t
   if (op != dst_len) throw ThriftError("snappy: short output");
 }
 
-enum Codec : int32_t { C_UNCOMPRESSED = 0, C_SNAPPY = 1, C_GZIP = 2 };
+enum Codec : int32_t { C_UNCOMPRESSED = 0, C_SNAPPY = 1, C_GZIP = 2, C_ZSTD = 6 };
+
+#ifndef HS_NO_ZSTD
+// zstd (parquet codec 6): system libzstd, one reusable decompression context
+// per decode thread (context setup is the per-page overhead worth amortizing)
+static void zstd_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t dst_len) {
+  if (dst_len == 0) return;  // empty values section (all-null v2 page)
+  struct TlsDctx {
+    ZSTD_DCtx* ctx;
+    TlsDctx() : ctx(ZSTD_createDCtx()) {}
+    ~TlsDctx() {
+      if (ctx) ZSTD_freeDCtx(ctx);
+    }
+  };
+  thread_local TlsDctx tls;
+  if (!tls.ctx) throw ThriftError("zstd: context init failed");
+  const size_t got = ZSTD_decompressDCtx(tls.ctx, dst, dst_len, src, n);
+  if (ZSTD_isError(got) || got != dst_len)
+    throw ThriftError("zstd: malformed or short frame");
+}
+#endif
 
 #ifndef HS_NO_ZLIB
 // gzip (parquet codec 2): zlib inflate with gzip-header wrapping. One inflate
@@ -502,6 +526,9 @@ static bool codec_supported(int32_t codec) {
 #ifndef HS_NO_ZLIB
   if (codec == C_GZIP) return true;
 #endif
+#ifndef HS_NO_ZSTD
+  if (codec == C_ZSTD) return true;
+#endif
   return codec == C_UNCOMPRESSED || codec == C_SNAPPY;
 }
 
@@ -521,6 +548,11 @@ static void page_decompress(int32_t codec, const uint8_t* src, size_t n, uint8_t
 #ifndef HS_NO_ZLIB
     case C_GZIP:
       gzip_decompress(src, n, dst, dst_len);
+      return;
+#endif
+#ifndef HS_NO_ZSTD
+    case C_ZSTD:
+      zstd_decompress(src, n, dst, dst_len);
       return;
 #endif
     default:  // keep codec_supported and this switch decoupled-safe

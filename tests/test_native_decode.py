@@ -177,9 +177,9 @@ def test_gzip_dictionary_and_nulls(tmp_path, no_pyarrow_fallback):
 
 
 def test_unsupported_codec_falls_back(tmp_path, sample_table):
-    """Codecs outside the native dialect (zstd) still fall back to pyarrow."""
-    p = str(tmp_path / "zstd.parquet")
-    pq.write_table(sample_table, p, compression="ZSTD")
+    """Codecs outside the native dialect (lz4) still fall back to pyarrow."""
+    p = str(tmp_path / "lz4.parquet")
+    pq.write_table(sample_table, p, compression="LZ4")
     with pytest.raises(native.NativeUnsupported):
         native.read_columns(p, ["i64"])
     _assert_batch_matches(read_parquet_batch([p], sample_table.column_names), pq.read_table(p))
@@ -362,3 +362,32 @@ def test_date32_nulls_decode_natively(tmp_path, no_pyarrow_fallback):
     assert got["d"].dtype.kind == "M"
     assert np.isnat(got["d"][1]) and np.isnat(got["d"][3])
     assert got["d"][4] == np.datetime64("1970-01-01") + np.timedelta64(9000, "D")
+
+
+def test_zstd_plain_decodes_natively(tmp_path, sample_table, no_pyarrow_fallback):
+    p = str(tmp_path / "zstd.parquet")
+    pq.write_table(sample_table, p, compression="zstd", use_dictionary=False)
+    got = read_parquet_batch([p], ["i64", "f64", "s"])
+    np.testing.assert_array_equal(got["i64"], sample_table["i64"].to_numpy())
+    np.testing.assert_array_equal(got["f64"], sample_table["f64"].to_numpy())
+    assert got["s"].tolist() == sample_table["s"].to_pylist()
+
+
+def test_zstd_dictionary_decodes_natively(tmp_path, sample_table, no_pyarrow_fallback):
+    p = str(tmp_path / "zstd_dict.parquet")
+    pq.write_table(sample_table, p, compression="zstd", use_dictionary=True)
+    got = read_parquet_batch([p], ["i64", "s"])
+    np.testing.assert_array_equal(got["i64"], sample_table["i64"].to_numpy())
+    assert got["s"].tolist() == sample_table["s"].to_pylist()
+
+
+def test_zstd_nulls(tmp_path, no_pyarrow_fallback):
+    t = pa.table({
+        "a": pa.array([1, None, 3, None, 5], type=pa.int64()),
+        "s": pa.array(["x", None, "z", "w", None]),
+    })
+    p = str(tmp_path / "zstd_nulls.parquet")
+    pq.write_table(t, p, compression="zstd")
+    got = read_parquet_batch([p], ["a", "s"])
+    assert np.isnan(got["a"][1]) and np.isnan(got["a"][3])
+    assert got["s"].tolist() == ["x", None, "z", "w", None]

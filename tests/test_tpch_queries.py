@@ -144,7 +144,7 @@ INDEXES = [
       "l_commitdate", "l_receiptdate", "l_shipmode", "l_returnflag",
       "l_linestatus", "l_suppkey", "l_partkey"]),
     ("lineitem", "li_sd", ["l_shipdate"],
-     ["l_extendedprice", "l_discount", "l_quantity"]),
+     ["l_extendedprice", "l_discount", "l_quantity", "l_orderkey"]),
     ("lineitem", "li_pk", ["l_partkey"],
      ["l_extendedprice", "l_discount", "l_quantity", "l_shipdate",
       "l_shipmode", "l_shipinstruct"]),
