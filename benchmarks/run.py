@@ -279,6 +279,12 @@ def config7(root, args):
     hs.create_index(
         li, hst.CoveringIndexConfig("li_ok7", ["l_orderkey"], ["l_extendedprice", "l_discount", "l_shipdate"])
     )
+    # the round-4 tpch22 lesson: the selective l_shipdate filter leg must be
+    # covered by a filter index that also carries the downstream join key,
+    # else the lineitem leg stays a raw scan (benchmarks/RESULTS.md round 4)
+    hs.create_index(
+        li, hst.CoveringIndexConfig("li_sd7", ["l_shipdate"], ["l_orderkey", "l_extendedprice", "l_discount"])
+    )
     hs.create_index(
         o, hst.CoveringIndexConfig("o_ok7", ["o_orderkey"], ["o_custkey", "o_orderdate", "o_shippriority"])
     )
