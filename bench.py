@@ -141,11 +141,14 @@ def main() -> None:
         for s in sorted(sizes):
             hs_sort.warm_build(s, ("i",), (np.int32,), 64)
 
-        # steady-state throughput: two timed builds, best wins — the first
-        # also warms the OS page cache for the source files, which otherwise
-        # dominates run-to-run variance on shared machines
-        best = float("inf")
-        for i in range(2):
+        # steady-state throughput: N timed builds, best wins — the first
+        # also warms the OS page cache for the source files, and the min
+        # filters ambient dips of the shared tunnel/host (chip sessions have
+        # shown 2x run-to-run swings on identical code; the chip's own
+        # compute is deterministic)
+        reps = max(1, int(os.environ.get("BENCH_BUILD_REPS", 3)))
+        times = []
+        for i in range(reps):
             t0 = time.perf_counter()
             hs.create_index(
                 df,
@@ -153,8 +156,8 @@ def main() -> None:
                     f"bench_idx_{i}", ["l_orderkey"], ["l_extendedprice", "l_discount"]
                 ),
             )
-            best = min(best, time.perf_counter() - t0)
-        dt = best
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
 
         n_chips = max(1, len(jax.devices()))
         rows_per_sec_per_chip = num_rows / dt / n_chips
@@ -165,6 +168,7 @@ def main() -> None:
                     "value": round(rows_per_sec_per_chip, 1),
                     "unit": "rows/s/chip",
                     "vs_baseline": round(rows_per_sec_per_chip / 1_000_000.0, 4),
+                    "build_times_s": [round(t, 3) for t in times],
                 }
             )
         )

@@ -1,0 +1,66 @@
+"""A/B the HBM-resident join-input cache on the benchmark join configs.
+
+Runs config3 (bucketed SMJ), config6 (string-payload join), and config7
+(TPC-H q3 via SQL) twice in SEPARATE subprocesses — once with the device
+cache disabled (HS_DEVICE_CACHE_BYTES=0) and once enabled — so each arm
+is a fresh process with identical warmup discipline. Prints one JSON line
+per (config, arm).
+
+Usage: python benchmarks/ab_join_cache.py [--sf 0.2] [--reps 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.2)
+    ap.add_argument("--reps", type=int, default=10)
+    ap.add_argument("--configs", default="config3,config6,config7")
+    args = ap.parse_args()
+
+    for config in args.configs.split(","):
+        for arm, cache_bytes in (("nocache", "0"), ("cache", str(1 << 31))):
+            env = dict(os.environ, HS_DEVICE_CACHE_BYTES=cache_bytes)
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(HERE, "run.py"),
+                    config,
+                    "--sf",
+                    str(args.sf),
+                    "--reps",
+                    str(args.reps),
+                ],
+                env=env,
+                capture_output=True,
+                text=True,
+                timeout=1800,
+            )
+            line = next(
+                (ln for ln in r.stdout.splitlines() if ln.startswith("{")), None
+            )
+            print(
+                json.dumps(
+                    {
+                        "config": config,
+                        "arm": arm,
+                        "result": json.loads(line) if line else None,
+                        "rc": r.returncode,
+                        "err": None if r.returncode == 0 else r.stderr.strip()[-400:],
+                    }
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,115 @@
+"""Profile where the indexed bucketed-join latency goes, by module.
+
+Builds the config3 shape (lineitem ⋈ orders on l_orderkey with covering
+indexes both sides), runs the indexed query under cProfile, and prints a
+phase breakdown: cumulative time grouped by the package module that owns
+each frame (decode/IO, device exec, plan/optimizer, numpy glue). The same
+grouping runs for the non-indexed side so the two columns are comparable.
+
+Usage: python benchmarks/profile_join.py [--sf 0.2] [--reps 3]
+(JAX_PLATFORMS=cpu for the CPU engine; default drives the chip.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import cProfile
+import io
+import json
+import os
+import pstats
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks import datagen  # noqa: E402
+from benchmarks.run import _session  # noqa: E402
+
+GROUPS = [
+    ("native-decode", ("hyperspace_tpu/native", "hs_native")),
+    ("pyarrow-decode", ("pyarrow",)),
+    ("device-exec", ("exec/device", "ops/bucketize", "ops/sort", "ops/kernels")),
+    ("jax-dispatch", ("jax/", "jaxlib")),
+    ("executor-host", ("exec/executor", "exec/batch")),
+    ("plan+optimizer", ("rules/", "plan/", "analysis/")),
+    ("index-metadata", ("models/", "indexes/", "sources/", "manager", "hyperspace.py")),
+    ("pandas-glue", ("pandas",)),
+]
+
+
+def _group(path: str) -> str:
+    for name, pats in GROUPS:
+        if any(p in path for p in pats):
+            return name
+    return "other"
+
+
+def _breakdown(pr: cProfile.Profile):
+    st = pstats.Stats(pr, stream=io.StringIO())
+    tot = {}
+    for (path, _line, _fn), (_cc, _nc, tt, _ct, _callers) in st.stats.items():
+        tot[_group(path)] = tot.get(_group(path), 0.0) + tt
+    return dict(sorted(tot.items(), key=lambda kv: -kv[1]))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sf", type=float, default=0.2)
+    ap.add_argument("--reps", type=int, default=3)
+    args = ap.parse_args()
+
+    root = tempfile.mkdtemp(prefix="hs_prof_")
+    try:
+        li_d = datagen.gen_lineitem(root, args.sf)
+        o_d = datagen.gen_orders(root, args.sf)
+        sess, hs, hst = _session(root)
+        li = sess.read_parquet(li_d)
+        o = sess.read_parquet(o_d)
+        hs.create_index(
+            li,
+            hst.CoveringIndexConfig(
+                "li_ok", ["l_orderkey"], ["l_extendedprice", "l_discount"]
+            ),
+        )
+        hs.create_index(
+            o, hst.CoveringIndexConfig("o_ok", ["o_orderkey"], ["o_orderdate"])
+        )
+        q = li.join(o, on=hst.col("l_orderkey") == hst.col("o_orderkey")).select(
+            "l_extendedprice", "l_discount", "o_orderdate"
+        )
+
+        for label, enabled in (("indexed", True), ("noindex", False)):
+            (sess.enable_hyperspace if enabled else sess.disable_hyperspace)()
+            q.collect()  # warm: jit compiles + OS caches out of the profile
+            pr = cProfile.Profile()
+            pr.enable()
+            for _ in range(args.reps):
+                q.collect()
+            pr.disable()
+            bd = _breakdown(pr)
+            total = sum(bd.values())
+            print(
+                json.dumps(
+                    {
+                        "side": label,
+                        "total_s": round(total, 3),
+                        "per_rep_ms": round(total / args.reps * 1000, 1),
+                        "by_module_ms": {
+                            k: round(v / args.reps * 1000, 1) for k, v in bd.items()
+                        },
+                    }
+                ),
+                flush=True,
+            )
+            st = pstats.Stats(pr, stream=sys.stdout)
+            st.sort_stats("tottime")
+            print(f"--- top functions ({label}) ---")
+            st.print_stats(12)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

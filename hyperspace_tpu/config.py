@@ -43,6 +43,8 @@ class keys:
     TPU_QUERY_DEVICE_EXECUTION = "hyperspace.tpu.query.deviceExecution"
     TPU_QUERY_DEVICE_MIN_ROWS = "hyperspace.tpu.query.deviceMinRows"
     TPU_JOIN_DEVICE_MATERIALIZE = "hyperspace.tpu.join.deviceMaterialize"
+    TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES = "hyperspace.tpu.join.deviceMaterializeMaxBytes"
+    TPU_JOIN_DEVICE_SPAN_MAX_BYTES = "hyperspace.tpu.join.deviceSpanMaxBytes"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -93,6 +95,25 @@ DEFAULTS: Dict[str, Any] = {
     # gathers only string/object columns); False reverts to the host
     # expansion for every column.
     keys.TPU_JOIN_DEVICE_MATERIALIZE: True,
+    # Materialization placement is cost-based: the pair count is known from
+    # the span program BEFORE any payload moves, and a device-materialized
+    # join must download its whole output. Above this many estimated output
+    # bytes the expansion runs on host (native C pair kernels) instead —
+    # measured 282 s device vs ~25 s host for a 37.5M-pair join on a
+    # network-tunneled chip, where the device->host link is the bottleneck.
+    # Raise (or set very large) on directly-attached hosts.
+    keys.TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES: 256 * 1024 * 1024,
+    # The device span program's transfers are also known before dispatch:
+    # keys go up (8B/row/side) and the [lo, hi) matrices come down
+    # (16B/left row). Above this estimated round-trip the host span walk
+    # (np.searchsorted / native merge, zero transfer) wins; the 256 MiB
+    # default matches the materialize budget so the whole join dispatch
+    # shares one stance: "device round trips above ~256 MiB estimated
+    # transfer default to host". NOTE: with the default deviceMinRows
+    # (2^25 rows ≈ 768 MiB of span traffic) this makes the device-join
+    # window EMPTY by default — device SMJ is opt-in: co-located hosts
+    # lower deviceMinRows AND raise this budget together.
+    keys.TPU_JOIN_DEVICE_SPAN_MAX_BYTES: 256 * 1024 * 1024,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -240,6 +261,14 @@ class HyperspaceConf:
     @property
     def join_device_materialize(self) -> bool:
         return bool(self.get(keys.TPU_JOIN_DEVICE_MATERIALIZE))
+
+    @property
+    def join_device_materialize_max_bytes(self) -> int:
+        return int(self.get(keys.TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES))
+
+    @property
+    def join_device_span_max_bytes(self) -> int:
+        return int(self.get(keys.TPU_JOIN_DEVICE_SPAN_MAX_BYTES))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

@@ -1040,16 +1040,36 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
     if compat is None:
         raise DeviceUnsupported("join sides are not compatible bucketed index scans")
     lside, rside, lkeys, rkeys = compat
-    total = 0
-    for side in (lside, rside):
-        for f in _side_files(side):
-            try:
-                total += _file_num_rows(f)
-            except OSError:
-                total = 0
-                break
+    try:
+        total = sum(
+            _file_num_rows(f) for side in (lside, rside) for f in _side_files(side)
+        )
+    except OSError:
+        total = 0  # unreadable footer -> stay on host
     setup = _bucketed_join_setup(session, plan, compat)
-    if total >= session.conf.device_exec_min_rows:
+    # the device span program's round trip is EXACTLY computable here: the
+    # buckets are already decoded, and the key matrices are rectangles of
+    # nb_padded x (widest bucket) int64 — skewed buckets pad every other
+    # row to the widest, so raw row counts would badly undercount. Keys go
+    # up (both rectangles), [lo, hi) comes down (16B per left SLOT). Above
+    # the budget the host span walk (zero transfer) wins — the same
+    # cost-based stance as joinDeviceMaterializeMaxBytes one level down.
+    lbuckets_, rbuckets_, _lk_, _rk_, nb_, _lc_, _rc_ = setup
+    n_dev_ = session.mesh.devices.size
+    nb_padded_ = nb_ + ((-nb_) % n_dev_)
+    wl_ = max((B.num_rows(b) for b in lbuckets_.values()), default=1)
+    wr_ = max((B.num_rows(b) for b in rbuckets_.values()), default=1)
+    span_bytes = nb_padded_ * (wl_ + wr_) * 8
+    # the [lo, hi) matrices (16B/left slot) only come down when the
+    # device-materialize path won't consume them on device; a materialize
+    # run that later overflows ITS budget falls back to the host gather and
+    # does download them once — accepted imprecision, bounded by one rep
+    if plan.how != "inner" or not session.conf.join_device_materialize:
+        span_bytes += nb_padded_ * wl_ * 16
+    if (
+        total >= session.conf.device_exec_min_rows
+        and span_bytes <= session.conf.join_device_span_max_bytes
+    ):
         try:
             out = device_bucketed_join(session, plan, _compat=compat, _setup=setup)
             trace.record("join", "device-smj")
@@ -1269,10 +1289,6 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
 
     setup = _setup if _setup is not None else _bucketed_join_setup(session, plan, _compat)
     lbuckets, rbuckets, lkeys, rkeys, nb, lcols_needed, rcols_needed = setup
-    # shared per-bucket int64 encodings: identity for single int/date keys,
-    # dense cross-side ranks for composite/string keys — so every key shape
-    # rides the device span program
-    lkeys_by_bucket, rkeys_by_bucket = _encoded_join_keys(plan, setup, _compat)
 
     SENTINEL = np.int64(2**62)
     mesh = session.mesh
@@ -1280,29 +1296,55 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     axis = mesh.axis_names[0]
     nb_padded = nb + ((-nb) % n_dev)
 
-    def stack_side(buckets: Dict[int, B.Batch], keymap: Dict[int, np.ndarray]):
-        lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
-        width = max(max(lens), 1)
-        keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
-        for b in range(nb_padded):
-            enc = keymap.get(b)
-            if enc is not None and enc.shape[0]:
-                keys_mat[b, : enc.shape[0]] = enc
-        return keys_mat, np.asarray(lens, dtype=np.int64)
+    # index bucket files are immutable (versioned v__=N dirs), so the sharded
+    # key matrices stay resident in HBM across queries — same stance as the
+    # predicate-column cache above; only the first execution of a (sides,
+    # keys) pair pays the host->device transfer (which crosses a network
+    # tunnel in the single-chip harness)
+    compat = _compat or join_sides_compatible(plan)
+    pair_key = _rank_cache_key(compat[0], compat[1], lkeys, rkeys)
+    mesh_tag = (n_dev, axis, tuple(str(d) for d in mesh.devices.flat))
+    dev_key = ("join-keymats", pair_key, mesh_tag) if pair_key is not None else None
+    cached = _device_cache_get(dev_key) if dev_key is not None else None
+    if cached is not None:
+        lmat_dev, rmat_dev, llens, rlens = cached
+    else:
+        # shared per-bucket int64 encodings: identity for single int/date
+        # keys, dense cross-side ranks for composite/string keys — so every
+        # key shape rides the device span program
+        lkeys_by_bucket, rkeys_by_bucket = _encoded_join_keys(
+            plan, setup, compat, _pair_key=pair_key
+        )
 
-    lmat, llens = stack_side(lbuckets, lkeys_by_bucket)
-    rmat, rlens = stack_side(rbuckets, rkeys_by_bucket)
+        def stack_side(buckets: Dict[int, B.Batch], keymap: Dict[int, np.ndarray]):
+            lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
+            width = max(max(lens), 1)
+            keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
+            for b in range(nb_padded):
+                enc = keymap.get(b)
+                if enc is not None and enc.shape[0]:
+                    keys_mat[b, : enc.shape[0]] = enc
+            return keys_mat, np.asarray(lens, dtype=np.int64)
 
-    sharding = NamedSharding(mesh, P(axis))
+        lmat, llens = stack_side(lbuckets, lkeys_by_bucket)
+        rmat, rlens = stack_side(rbuckets, rkeys_by_bucket)
+        sharding = NamedSharding(mesh, P(axis))
+        lmat_dev = jax.device_put(lmat, sharding)
+        rmat_dev = jax.device_put(rmat, sharding)
+        if dev_key is not None:
+            _device_cache_put(
+                dev_key, (lmat_dev, rmat_dev, llens, rlens), lmat.nbytes + rmat.nbytes
+            )
 
     spans = _bucketed_span_program(mesh, axis)
-    lo, hi = spans(jax.device_put(lmat, sharding), jax.device_put(rmat, sharding))
+    lo, hi = spans(lmat_dev, rmat_dev)
 
     if plan.how == "inner" and session.conf.join_device_materialize:
         try:
             return _device_materialize_inner(
                 session, plan, lbuckets, rbuckets, lcols_needed, rcols_needed,
                 lo, hi, llens, rlens, nb, nb_padded,
+                _ident=(pair_key, mesh_tag) if pair_key is not None else None,
             )
         except DeviceUnsupported:
             pass  # e.g. typed-empty output or odd column shapes -> host gather
@@ -1317,13 +1359,15 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     return _expand_join_pairs(plan, lbuckets, rbuckets, nb, lcols_needed, rcols_needed, span_of)
 
 
-def _encoded_join_keys(plan: L.Join, setup, compat):
+def _encoded_join_keys(plan: L.Join, setup, compat, _pair_key=None):
     """Per-bucket int64 key arrays for both sides, order-preserving and
     cross-side comparable. Single int64-comparable keys pass through;
     composite and string keys encode per bucket into shared dense int64
     ranks, cached across queries on the sides' immutable file + filter
     identity. The SAME arrays feed the host merge walk and the device span
-    program, so both backends cover every key shape."""
+    program, so both backends cover every key shape. ``_pair_key`` lets a
+    caller that already computed `_rank_cache_key` (one os.stat sweep per
+    side) pass it through instead of re-statting."""
     lbuckets, rbuckets, lkeys, rkeys, _nb, _lc, _rc = setup
 
     single_int = len(lkeys) == 1
@@ -1339,7 +1383,11 @@ def _encoded_join_keys(plan: L.Join, setup, compat):
             single_int = False
     if not single_int:
         lside, rside = (compat or join_sides_compatible(plan))[:2]
-        cache_key = _rank_cache_key(lside, rside, lkeys, rkeys)
+        cache_key = (
+            _pair_key
+            if _pair_key is not None
+            else _rank_cache_key(lside, rside, lkeys, rkeys)
+        )
         cached = _RANK_CACHE.get(cache_key) if cache_key is not None else None
         if cached is not None:
             lkeys_by_bucket, rkeys_by_bucket = cached
@@ -1457,7 +1505,7 @@ def _bucket_pair_totals(lo, hi, ll, rl):
 
 def _device_materialize_inner(
     session, plan: L.Join, lbuckets, rbuckets, lcols_needed, rcols_needed,
-    lo_dev, hi_dev, llens, rlens, nb, nb_padded,
+    lo_dev, hi_dev, llens, rlens, nb, nb_padded, _ident=None,
 ) -> B.Batch:
     """Device-side materialization of a compatible bucketed INNER join: pair
     expansion and numeric column gathers run on device; only string/object
@@ -1501,7 +1549,21 @@ def _device_materialize_inner(
             dt = dtypes[name]
             out[name] = np.empty(0, dtype=dt)
         return out
+    # cost-based placement: a device-materialized join downloads its WHOLE
+    # output, so above the configured byte budget the host expansion (native
+    # C pair kernels, no device->host transfer) wins — measured 282 s device
+    # vs ~25 s host on a 37.5M-pair join over a network-tunneled chip.
+    # Downloads happen at the PADDED size (next power of two), and a host
+    # (string) gather additionally downloads the b/i/j index arrays.
     n_pad = padded_size(total)
+    est_bytes = n_pad * max(1, len(device_cols)) * 8
+    if host_cols:
+        est_bytes += 3 * n_pad * 8
+    if est_bytes > session.conf.join_device_materialize_max_bytes:
+        raise DeviceUnsupported(
+            f"materialized output ~{est_bytes >> 20} MiB exceeds "
+            "joinDeviceMaterializeMaxBytes -> host expansion"
+        )
 
     def rectangles(side_buckets, cols, width_of):
         """(name -> (nb_padded, W) device-feedable rectangle) per column."""
@@ -1524,18 +1586,39 @@ def _device_materialize_inner(
 
     l_device = [n for n in device_cols if sources[n][0]]
     r_device = [n for n in device_cols if not sources[n][0]]
-    wr = max((B.num_rows(rbuckets[b]) for b in participating), default=1)
-    lmats = rectangles(lbuckets, l_device, wl)
-    rmats = rectangles(rbuckets, r_device, wr)
+    # the payload rectangles are pure functions of the sides' immutable
+    # decoded buckets, so they stay HBM-resident across queries like the key
+    # matrices (only the first execution pays the host->device transfer)
+    mats_key = (
+        ("join-paymats", _ident, tuple(l_device), tuple(r_device))
+        if _ident is not None
+        else None
+    )
+    cached = _device_cache_get(mats_key) if mats_key is not None else None
+    if cached is not None:
+        llens_dev, rlens_dev, lmats_dev, rmats_dev = cached
+    else:
+        wr = max((B.num_rows(rbuckets[b]) for b in participating), default=1)
+        lmats = rectangles(lbuckets, l_device, wl)
+        rmats = rectangles(rbuckets, r_device, wr)
+        llens_dev = jax.device_put(llens_np)
+        rlens_dev = jax.device_put(rlens_np)
+        lmats_dev = tuple(jax.device_put(lmats[n]) for n in l_device)
+        rmats_dev = tuple(jax.device_put(rmats[n]) for n in r_device)
+        if mats_key is not None:
+            nbytes = sum(m.nbytes for m in (*lmats.values(), *rmats.values()))
+            _device_cache_put(
+                mats_key, (llens_dev, rlens_dev, lmats_dev, rmats_dev), nbytes
+            )
 
     run = _expand_gather_program(n_pad)
     louts, routs, b_idx, i_idx, j_idx, valid = run(
         lo_dev,
         hi_dev,
-        jax.device_put(llens_np),
-        jax.device_put(rlens_np),
-        tuple(jax.device_put(lmats[n]) for n in l_device),
-        tuple(jax.device_put(rmats[n]) for n in r_device),
+        llens_dev,
+        rlens_dev,
+        lmats_dev,
+        rmats_dev,
         np.int64(total),
     )
 

@@ -58,6 +58,10 @@ class BytesLRU:
                 self._bytes -= nb
                 self.evictions += 1
 
+    def keys(self):
+        with self._lock:
+            return list(self._entries.keys())
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

@@ -802,6 +802,176 @@ def test_composite_rank_cache_respects_filter_changes(session, tmp_path):
     assert B.num_rows(second) < B.num_rows(first)
 
 
+def test_join_input_device_cache_reuses_and_invalidates(session, tmp_path):
+    """The HBM-resident join-input cache (key matrices + payload rectangles)
+    must serve repeat executions without re-transfer — repeat results stay
+    identical — and must MISS when the underlying index data changes (a
+    refresh after an append writes new files, so the file-identity key
+    changes; a stale hit would silently drop the appended rows)."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 4)
+    rng = np.random.default_rng(17)
+    lroot, rroot = tmp_path / "cl", tmp_path / "cr"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 40, 500).astype(np.int64),
+                "a": rng.standard_normal(500),
+            }
+        ),
+        lroot / "p0.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "k": np.arange(40, dtype=np.int64),
+                "b": rng.standard_normal(40),
+            }
+        ),
+        rroot / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("ccL", ["k"], ["a"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("ccR", ["k"], ["b"]))
+    session.enable_hyperspace()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+
+    D.clear_device_cache()
+    q = ldf.join(rdf, on="k").select("k", "a", "b")
+    first = q.collect()
+    keymat_keys = [k for k in D._device_cache.keys() if k[0] == "join-keymats"]
+    assert keymat_keys, "first execution should populate the join-input cache"
+    second = q.collect()  # served from the HBM-resident entries
+    assert_batches_equal(first, second)
+    # the cached reply must ALSO equal the host path's answer
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    assert_batches_equal(second, q.collect())
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+
+    # append + full refresh -> new index files -> the old entries are stale
+    # by KEY (not by mutation); the fresh execution must see the new rows
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 40, 300).astype(np.int64),
+                "a": rng.standard_normal(300),
+            }
+        ),
+        lroot / "p1.parquet",
+    )
+    hs.refresh_index("ccL", "full")
+    ldf2 = session.read_parquet(str(lroot))
+    q2 = ldf2.join(rdf, on="k").select("k", "a", "b")
+    third = q2.collect()
+    assert B.num_rows(third) > B.num_rows(first)
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+    assert_batches_equal(third, q2.collect())
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+
+
+def test_span_byte_budget_routes_to_host_spans(session, tmp_path):
+    """Above joinDeviceSpanMaxBytes the dispatch must choose the host span
+    walk (zero transfer) even when the row count clears deviceMinRows; the
+    answer must not change."""
+    from hyperspace_tpu.exec import trace
+
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 8)
+    rng = np.random.default_rng(29)
+    lroot, rroot = tmp_path / "sl", tmp_path / "sr"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 100, 2000).astype(np.int64),
+                "lv": rng.standard_normal(2000),
+            }
+        ),
+        lroot / "p.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "k": np.arange(100, dtype=np.int64),
+                "rv": rng.standard_normal(100),
+            }
+        ),
+        rroot / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("sbL", ["k"], ["lv"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("sbR", ["k"], ["rv"]))
+    session.enable_hyperspace()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+    q = ldf.join(rdf, on="k").select("k", "lv", "rv")
+
+    with trace.recording() as dev_events:
+        device_ans = q.collect()
+    assert ("join", "device-smj") in dev_events
+
+    session.conf.set(hst.keys.TPU_JOIN_DEVICE_SPAN_MAX_BYTES, 1)
+    with trace.recording() as host_events:
+        host_ans = q.collect()
+    assert ("join", "host-span-smj") in host_events
+    assert_batches_equal(device_ans, host_ans)
+    session.conf.set(hst.keys.TPU_JOIN_DEVICE_SPAN_MAX_BYTES, 256 << 20)
+
+
+def test_materialize_byte_budget_routes_to_host_expansion(session, tmp_path):
+    """Above joinDeviceMaterializeMaxBytes the device join must keep its
+    span computation but expand pairs on host (no whole-output download);
+    results stay identical to the device-materialized answer."""
+    hs = hst.Hyperspace(session)
+    session.conf.set(hst.keys.NUM_BUCKETS, 4)
+    rng = np.random.default_rng(23)
+    lroot, rroot = tmp_path / "bl", tmp_path / "br"
+    lroot.mkdir(), rroot.mkdir()
+    pq.write_table(
+        pa.table(
+            {
+                "k": rng.integers(0, 30, 400).astype(np.int64),
+                "a": rng.standard_normal(400),
+            }
+        ),
+        lroot / "p.parquet",
+    )
+    pq.write_table(
+        pa.table(
+            {
+                "k": np.arange(30, dtype=np.int64),
+                "b": rng.standard_normal(30),
+            }
+        ),
+        rroot / "p.parquet",
+    )
+    ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+    hs.create_index(ldf, hst.CoveringIndexConfig("bbL", ["k"], ["a"]))
+    hs.create_index(rdf, hst.CoveringIndexConfig("bbR", ["k"], ["b"]))
+    session.enable_hyperspace()
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+    session.conf.set(hst.keys.TPU_QUERY_DEVICE_MIN_ROWS, 0)
+    q = ldf.join(rdf, on="k").select("k", "a", "b")
+
+    D.clear_device_cache()
+    device_mat = q.collect()  # default budget: device materialization
+    assert any(k[0] == "join-paymats" for k in D._device_cache.keys())
+    session.conf.set(hst.keys.TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES, 1)
+    D.clear_device_cache()
+    host_exp = q.collect()  # 400 pairs * 8B >> 1 byte -> host expansion
+    # the budget must fire BEFORE the payload rectangles ever transfer, so
+    # the paymats cache stays empty on the capped route (this is also what
+    # catches the budget check regressing to dead code)
+    assert not any(k[0] == "join-paymats" for k in D._device_cache.keys())
+    assert_batches_equal(device_mat, host_exp)
+    session.conf.set(
+        hst.keys.TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES,
+        256 * 1024 * 1024,
+    )
+
+
 class TestOuterBucketedJoin:
     """left/right/full outer equi-joins ride the span path too; unmatched
     rows null-fill the opposite side exactly like the pandas-merge fallback
