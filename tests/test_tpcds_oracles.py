@@ -531,3 +531,327 @@ def test_q41(env):
     outer = i[(i.i_manufact_id >= 738) & (i.i_manufact_id <= 778)]
     out = outer[outer.i_manufact.isin(qualifying_manufacts)][["i_product_name"]].drop_duplicates()
     _nonempty(check(sess, "q41", out), "q41")
+
+
+def test_q9(env):
+    sess, t = env
+    ss = t["store_sales"]
+    vals = {}
+    for n, (lo, hi, thresh) in enumerate(
+        [(1, 20, 62316685), (21, 40, 19045798), (41, 60, 365541424),
+         (61, 80, 216357808), (81, 100, 184483884)], start=1
+    ):
+        b = ss[(ss.ss_quantity >= lo) & (ss.ss_quantity <= hi)]
+        if len(b) > thresh:
+            vals[f"bucket{n}"] = [b.ss_ext_discount_amt.mean()]
+        else:
+            vals[f"bucket{n}"] = [b.ss_net_paid.mean()]
+    # one output row per qualifying reason row (r_reason_sk = 1)
+    nreason = int((t["reason"].r_reason_sk == 1).sum())
+    out = pd.DataFrame({k: v * nreason for k, v in vals.items()})
+    _nonempty(check(sess, "q9", out), "q9")
+
+
+def test_q10(env):
+    sess, t = env
+    c, ca, cd, d = t["customer"], t["customer_address"], t["customer_demographics"], t["date_dim"]
+    counties = {"Rush County", "Toole County", "Jefferson County",
+                "Dona Ana County", "La Porte County"}
+    window = d[(d.d_year == 2002) & (d.d_moy >= 1) & (d.d_moy <= 4)]
+
+    def active(fact, custcol, datecol):
+        m = t[fact].merge(window[["d_date_sk"]], left_on=datecol, right_on="d_date_sk")
+        return set(m[custcol].dropna())
+
+    store_c = active("store_sales", "ss_customer_sk", "ss_sold_date_sk")
+    web_c = active("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk")
+    cat_c = active("catalog_sales", "cs_ship_customer_sk", "cs_sold_date_sk")
+    m = c.merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk").merge(
+        cd, left_on="c_current_cdemo_sk", right_on="cd_demo_sk"
+    )
+    m = m[m.ca_county.isin(counties)
+          & m.c_customer_sk.isin(store_c)
+          & (m.c_customer_sk.isin(web_c) | m.c_customer_sk.isin(cat_c))]
+    keys = ["cd_gender", "cd_marital_status", "cd_education_status",
+            "cd_purchase_estimate", "cd_credit_rating", "cd_dep_count",
+            "cd_dep_employed_count", "cd_dep_college_count"]
+    g = m.groupby(keys, as_index=False).size().rename(columns={"size": "cnt1"})
+    for extra in ("cnt2", "cnt3", "cnt4", "cnt5", "cnt6"):
+        g[extra] = g["cnt1"]
+    out = g[["cd_gender", "cd_marital_status", "cd_education_status", "cnt1",
+             "cd_purchase_estimate", "cnt2", "cd_credit_rating", "cnt3",
+             "cd_dep_count", "cnt4", "cd_dep_employed_count", "cnt5",
+             "cd_dep_college_count", "cnt6"]]
+    _nonempty(check(sess, "q10", out), "q10")
+
+
+def test_q13(env):
+    sess, t = env
+    ss, s, cd, hd, ca, d = (t["store_sales"], t["store"], t["customer_demographics"],
+                            t["household_demographics"], t["customer_address"], t["date_dim"])
+    m = (
+        ss.merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        .merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(cd, left_on="ss_cdemo_sk", right_on="cd_demo_sk")
+        .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        .merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+    )
+    m = m[m.d_year == 2001]
+
+    def demo(ms, ed, plo, phi, dep):
+        return ((m.cd_marital_status == ms) & (m.cd_education_status == ed)
+                & (m.ss_sales_price >= plo) & (m.ss_sales_price <= phi)
+                & (m.hd_dep_count == dep))
+
+    def addr(states, nlo, nhi):
+        return ((m.ca_country == "United States") & m.ca_state.isin(states)
+                & (m.ss_net_profit >= nlo) & (m.ss_net_profit <= nhi))
+
+    m = m[
+        (demo("M", "Advanced Degree", 100.0, 150.0, 3)
+         | demo("S", "College", 50.0, 100.0, 1)
+         | demo("W", "2 yr Degree", 150.0, 200.0, 1))
+        & (addr(["TX", "OH"], 100, 200)
+           | addr(["OR", "NM", "KY"], 150, 300)
+           | addr(["VA", "TX", "MS"], 50, 250))
+    ]
+    out = pd.DataFrame({
+        "avg(ss_quantity)": [m.ss_quantity.mean()],
+        "avg(ss_ext_sales_price)": [m.ss_ext_sales_price.mean()],
+        "avg(ss_ext_wholesale_cost)": [m.ss_ext_wholesale_cost.mean()],
+        "sum(ss_ext_wholesale_cost)": [m.ss_ext_wholesale_cost.sum() if len(m) else np.nan],
+    })
+    check(sess, "q13", out)
+
+
+def _rollup(m, levels, aggfn):
+    """Pandas ROLLUP: one groupby per prefix of ``levels`` plus the grand
+    total, un-grouped levels filled with None (SQL NULL)."""
+    frames = []
+    for k in range(len(levels), -1, -1):
+        keys = levels[:k]
+        if keys:
+            g = m.groupby(keys, as_index=False, dropna=False).apply(aggfn, include_groups=False)
+        else:
+            g = aggfn(m).to_frame().T
+        for missing in levels[k:]:
+            g[missing] = None
+        frames.append(g)
+    return pd.concat(frames, ignore_index=True)
+
+
+def test_q18(env):
+    sess, t = env
+    cs, cd, c, ca, d, i = (t["catalog_sales"], t["customer_demographics"], t["customer"],
+                           t["customer_address"], t["date_dim"], t["item"])
+    cd1 = cd[(cd.cd_gender == "F") & (cd.cd_education_status == "Unknown")]
+    m = (
+        cs.merge(d[d.d_year == 1998][["d_date_sk"]], left_on="cs_sold_date_sk", right_on="d_date_sk")
+        .merge(i, left_on="cs_item_sk", right_on="i_item_sk")
+        .merge(cd1.add_prefix("one_"), left_on="cs_bill_cdemo_sk", right_on="one_cd_demo_sk")
+        .merge(c, left_on="cs_bill_customer_sk", right_on="c_customer_sk")
+        .merge(cd[["cd_demo_sk"]], left_on="c_current_cdemo_sk", right_on="cd_demo_sk")
+        .merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+    )
+    m = m[m.c_birth_month.isin([1, 6, 8, 9, 12, 2])
+          & m.ca_state.isin(["MS", "IN", "ND", "OK", "NM", "VA"])]
+
+    def aggs(g):
+        return pd.Series({
+            "agg1": g.cs_quantity.mean(), "agg2": g.cs_list_price.mean(),
+            "agg3": g.cs_coupon_amt.mean(), "agg4": g.cs_sales_price.mean(),
+            "agg5": g.cs_net_profit.mean(), "agg6": g.c_birth_year.mean(),
+            "agg7": g.one_cd_dep_count.mean(),
+        })
+
+    out = _rollup(m, ["i_item_id", "ca_country", "ca_state", "ca_county"], aggs)
+    out = out[["i_item_id", "ca_country", "ca_state", "ca_county",
+               "agg1", "agg2", "agg3", "agg4", "agg5", "agg6", "agg7"]]
+    _nonempty(check(sess, "q18", out), "q18")
+
+
+def test_q22(env):
+    sess, t = env
+    inv, d, i, w = t["inventory"], t["date_dim"], t["item"], t["warehouse"]
+    m = (
+        inv.merge(d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][["d_date_sk"]],
+                  left_on="inv_date_sk", right_on="d_date_sk")
+        .merge(i, left_on="inv_item_sk", right_on="i_item_sk")
+        .merge(w, left_on="inv_warehouse_sk", right_on="w_warehouse_sk")
+    )
+
+    def aggs(g):
+        return pd.Series({"qoh": g.inv_quantity_on_hand.mean()})
+
+    out = _rollup(m, ["i_product_name", "i_brand", "i_class", "i_category"], aggs)
+    out = out[["i_product_name", "i_brand", "i_class", "i_category", "qoh"]]
+    _nonempty(check(sess, "q22", out), "q22")
+
+
+def test_q33(env):
+    sess, t = env
+    d, ca, i = t["date_dim"], t["customer_address"], t["item"]
+    electronics = set(i[i.i_category == "Electronics"].i_manufact_id.dropna())
+    window = d[(d.d_year == 1998) & (d.d_moy == 5)]
+    addrs = ca[ca.ca_gmt_offset == -5]
+
+    def channel(fact, itemcol, datecol, addrcol, pricecol):
+        m = (
+            t[fact].merge(window[["d_date_sk"]], left_on=datecol, right_on="d_date_sk")
+            .merge(addrs[["ca_address_sk"]], left_on=addrcol, right_on="ca_address_sk")
+            .merge(i[["i_item_sk", "i_manufact_id"]], left_on=itemcol, right_on="i_item_sk")
+        )
+        m = m[m.i_manufact_id.isin(electronics)]
+        return m.groupby("i_manufact_id", as_index=False)[pricecol].sum().rename(
+            columns={pricecol: "total_sales"}
+        )
+
+    parts = pd.concat([
+        channel("store_sales", "ss_item_sk", "ss_sold_date_sk", "ss_addr_sk", "ss_ext_sales_price"),
+        channel("catalog_sales", "cs_item_sk", "cs_sold_date_sk", "cs_bill_addr_sk", "cs_ext_sales_price"),
+        channel("web_sales", "ws_item_sk", "ws_sold_date_sk", "ws_bill_addr_sk", "ws_ext_sales_price"),
+    ], ignore_index=True)
+    out = parts.groupby("i_manufact_id", as_index=False)["total_sales"].sum()
+    _nonempty(check(sess, "q33", out), "q33")
+
+
+def test_q34(env):
+    sess, t = env
+    ss, d, s, hd, c = (t["store_sales"], t["date_dim"], t["store"],
+                       t["household_demographics"], t["customer"])
+    m = (
+        ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+    )
+    ratio = np.where(m.hd_vehicle_count > 0, m.hd_dep_count / m.hd_vehicle_count, np.nan)
+    m = m[
+        (((m.d_dom >= 1) & (m.d_dom <= 3)) | ((m.d_dom >= 25) & (m.d_dom <= 28)))
+        & m.hd_buy_potential.isin([">10000", "unknown"])
+        & (m.hd_vehicle_count > 0)
+        & (ratio > 1.2)
+        & m.d_year.isin([1999, 2000, 2001])
+        & (m.s_county == "Williamson County")
+    ]
+    g = m.groupby(["ss_ticket_number", "ss_customer_sk"], as_index=False).size().rename(
+        columns={"size": "cnt"}
+    )
+    g = g[(g.cnt >= 15) & (g.cnt <= 20)]
+    out = g.merge(c, left_on="ss_customer_sk", right_on="c_customer_sk")[
+        ["c_last_name", "c_first_name", "c_salutation", "c_preferred_cust_flag",
+         "ss_ticket_number", "cnt"]
+    ]
+    _nonempty(check(sess, "q34", out), "q34")
+
+
+def test_q38(env):
+    sess, t = env
+    d, c = t["date_dim"], t["customer"]
+    window = d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][["d_date_sk", "d_date"]]
+
+    def triples(fact, datecol, custcol):
+        m = t[fact].merge(window, left_on=datecol, right_on="d_date_sk").merge(
+            c, left_on=custcol, right_on="c_customer_sk"
+        )
+        return set(zip(m.c_last_name, m.c_first_name, m.d_date))
+
+    inter = (
+        triples("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+        & triples("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk")
+        & triples("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk")
+    )
+    _nonempty(check(sess, "q38", pd.DataFrame({"count": [len(inter)]})), "q38")
+
+
+def test_q43(env):
+    sess, t = env
+    ss, d, s = t["store_sales"], t["date_dim"], t["store"]
+    m = ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk").merge(
+        s, left_on="ss_store_sk", right_on="s_store_sk"
+    )
+    m = m[(m.s_gmt_offset == -5) & (m.d_year == 2000)]
+    days = [("Sunday", "sun_sales"), ("Monday", "mon_sales"), ("Tuesday", "tue_sales"),
+            ("Wednesday", "wed_sales"), ("Thursday", "thu_sales"), ("Friday", "fri_sales"),
+            ("Saturday", "sat_sales")]
+
+    def aggs(g):
+        row = {}
+        for day, alias in days:
+            sel = g[g.d_day_name == day].ss_sales_price
+            row[alias] = sel.sum() if len(sel) else np.nan  # SUM over no rows = NULL
+        return pd.Series(row)
+
+    out = m.groupby(["s_store_name", "s_store_id"], as_index=False).apply(
+        aggs, include_groups=False
+    )
+    out = out[["s_store_name", "s_store_id"] + [a for _, a in days]]
+    _nonempty(check(sess, "q43", out), "q43")
+
+
+def test_q45(env):
+    sess, t = env
+    ws, c, ca, d, i = (t["web_sales"], t["customer"], t["customer_address"],
+                       t["date_dim"], t["item"])
+    m = (
+        ws.merge(c, left_on="ws_bill_customer_sk", right_on="c_customer_sk")
+        .merge(ca, left_on="c_current_addr_sk", right_on="ca_address_sk")
+        .merge(i, left_on="ws_item_sk", right_on="i_item_sk")
+        .merge(d, left_on="ws_sold_date_sk", right_on="d_date_sk")
+    )
+    zips = {"85669", "86197", "88274", "83405", "86475", "85392", "85460", "80348", "81792"}
+    special = set(i[i.i_item_sk.isin([2, 3, 5, 7, 11, 13, 17, 19, 23, 29])].i_item_id)
+    m = m[(m.ca_zip.astype(str).str[:5].isin(zips) | m.i_item_id.isin(special))
+          & (m.d_qoy == 2) & (m.d_year == 2001)]
+    out = m.groupby(["ca_zip", "ca_city"], as_index=False)["ws_sales_price"].sum()
+    out.columns = ["ca_zip", "ca_city", "sum(ws_sales_price)"]
+    _nonempty(check(sess, "q45", out), "q45")
+
+
+def test_q62(env):
+    sess, t = env
+    ws, w, sm, web, d = (t["web_sales"], t["warehouse"], t["ship_mode"],
+                         t["web_site"], t["date_dim"])
+    m = (
+        ws.merge(d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][["d_date_sk"]],
+                 left_on="ws_ship_date_sk", right_on="d_date_sk")
+        .merge(w, left_on="ws_warehouse_sk", right_on="w_warehouse_sk")
+        .merge(sm, left_on="ws_ship_mode_sk", right_on="sm_ship_mode_sk")
+        .merge(web, left_on="ws_web_site_sk", right_on="web_site_sk")
+    )
+    m = m.assign(wname=m.w_warehouse_name.astype(str).str[:20],
+                 lag=m.ws_ship_date_sk - m.ws_sold_date_sk)
+
+    def aggs(g):
+        return pd.Series({
+            "30 days ": int((g.lag <= 30).sum()),
+            "31 - 60 days ": int(((g.lag > 30) & (g.lag <= 60)).sum()),
+            "61 - 90 days ": int(((g.lag > 60) & (g.lag <= 90)).sum()),
+            "91 - 120 days ": int(((g.lag > 90) & (g.lag <= 120)).sum()),
+            ">120 days ": int((g.lag > 120).sum()),
+        })
+
+    out = m.groupby(["wname", "sm_type", "web_name"], as_index=False).apply(
+        aggs, include_groups=False
+    )
+    # the engine names unaliased expressions by their token-spaced SQL text
+    out = out.rename(columns={"wname": "substr ( w_warehouse_name , 1 , 20 )"})
+    _nonempty(check(sess, "q62", out), "q62")
+
+
+def test_q90(env):
+    sess, t = env
+    ws, hd, td, wp = (t["web_sales"], t["household_demographics"], t["time_dim"],
+                      t["web_page"])
+
+    def bucket(hlo, hhi):
+        m = (
+            ws.merge(td, left_on="ws_sold_time_sk", right_on="t_time_sk")
+            .merge(hd, left_on="ws_ship_hdemo_sk", right_on="hd_demo_sk")
+            .merge(wp, left_on="ws_web_page_sk", right_on="wp_web_page_sk")
+        )
+        return len(m[(m.t_hour >= hlo) & (m.t_hour <= hhi) & (m.hd_dep_count == 6)
+                     & (m.wp_char_count >= 5000) & (m.wp_char_count <= 5200)])
+
+    amc, pmc = bucket(8, 9), bucket(19, 20)
+    ratio = amc / pmc if pmc else np.nan
+    check(sess, "q90", pd.DataFrame({"am_pm_ratio": [ratio]}))
