@@ -1218,6 +1218,14 @@ def _expand_join_pairs(
 
     sources = {name: _join_column_source(name, lout, rout) for name in out_cols}
     participating = sorted({p[0] for p in pieces})
+    # USING-style joins coalesce the key (Spark's df.join(other, on="k")):
+    # a right/outer join's unmatched rows show the RIGHT side's key under
+    # the left name instead of NULL — map left key output -> right source col
+    coalesce_from = {}
+    if keep_right and plan.using_pairs:
+        for lk, rk in plan.using_pairs:
+            if lk in out_cols and rk in rout:
+                coalesce_from[lk] = rk
 
     def out_dtype(name: str) -> np.dtype:
         is_left, col = sources[name]
@@ -1259,8 +1267,9 @@ def _expand_join_pairs(
                 # side absent for this bucket (or filtered to zero rows):
                 # every index here is -1 by construction
                 out[name][off : off + ct] = null_value(out[name].dtype)
+                nulls = np.ones(ct, dtype=bool)
             else:
-                nulls = idx < 0
+                nulls = np.asarray(idx) < 0
                 if nulls.any():
                     vals = out[name][off : off + ct]
                     vals[:] = arr[np.clip(idx, 0, arr.shape[0] - 1)].astype(
@@ -1269,6 +1278,17 @@ def _expand_join_pairs(
                     vals[nulls] = null_value(out[name].dtype)
                 else:
                     out[name][off : off + ct] = arr[idx]
+            alt = coalesce_from.get(name) if is_left else None
+            if alt is not None and nulls.any():
+                # left-null rows came from right-unmatched emissions: their
+                # ridx is valid, so the USING key takes the right side's value
+                ralt = rbuckets.get(b, {}).get(alt)
+                fill = np.asarray(ridx)[nulls]
+                ok = fill >= 0
+                if ralt is not None and ralt.shape[0] and ok.any():
+                    vals = out[name][off : off + ct]
+                    sel = np.nonzero(nulls)[0][ok]
+                    vals[sel] = ralt[fill[ok]].astype(out[name].dtype, copy=False)
         off += ct
     return out
 

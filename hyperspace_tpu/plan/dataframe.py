@@ -49,11 +49,13 @@ class DataFrame:
         """``residual`` carries a non-equi ON-clause predicate evaluated
         during the join (post-join column names) — for outer joins a failing
         pair null-extends instead of matching."""
+        using_pairs = None
         if isinstance(on, Expr):
             condition = on
         else:
             keys = [on] if isinstance(on, str) else list(on)
             terms: Optional[Expr] = None
+            using_pairs = []
             for k in keys:
                 lk = resolve_column(k, self.plan.output_columns)
                 rk = resolve_column(k, other.plan.output_columns)
@@ -61,9 +63,13 @@ class DataFrame:
                     raise ValueError(f"Join key {k!r} must exist on both sides")
                 term = col(lk) == col(rk)
                 terms = term if terms is None else (terms & term)
+                using_pairs.append((lk, rk))
             assert terms is not None
             condition = terms
-        return DataFrame(L.Join(self.plan, other.plan, condition, how, residual), self.session)
+        return DataFrame(
+            L.Join(self.plan, other.plan, condition, how, residual, using_pairs),
+            self.session,
+        )
 
     def group_by(self, *keys: TUnion[str, Col]) -> "GroupedData":
         resolved = []

@@ -198,12 +198,20 @@ class Join(LogicalPlan):
         condition: Expr,
         how: str = "inner",
         residual: Optional[Expr] = None,
+        using_pairs: Optional[List[Tuple[str, str]]] = None,
     ):
         self.left = left
         self.right = right
         self.condition = condition
         self.how = how
         self.residual = residual
+        # (left key, right key) name pairs when the join came from a
+        # USING-style dataframe ``on="k"``: Spark coalesces the key column
+        # across sides, so a right/outer join's unmatched rows must show the
+        # RIGHT side's key under the left name, not NULL. Execution paths
+        # honor this; ON-condition joins leave it None (both keys retained
+        # verbatim, qualified access).
+        self.using_pairs = using_pairs
 
     def children(self) -> Sequence[LogicalPlan]:
         return (self.left, self.right)
@@ -215,7 +223,9 @@ class Join(LogicalPlan):
 
     def with_children(self, children: Sequence[LogicalPlan]) -> "Join":
         left, right = children
-        return Join(left, right, self.condition, self.how, self.residual)
+        return Join(
+            left, right, self.condition, self.how, self.residual, self.using_pairs
+        )
 
     def describe(self) -> str:
         if self.residual is not None:

@@ -220,6 +220,8 @@ def apply_join_index_rule(
 
     new_left = transform_plan_to_use_index(ctx, l_best, plan.left, use_bucket_spec=True)
     new_right = transform_plan_to_use_index(ctx, r_best, plan.right, use_bucket_spec=True)
-    new_plan = L.Join(new_left, new_right, plan.condition, plan.how, plan.residual)
+    new_plan = L.Join(
+        new_left, new_right, plan.condition, plan.how, plan.residual, plan.using_pairs
+    )
     score = int(70 * hybrid_coverage_fraction(l_best, l_scan) + 70 * hybrid_coverage_fraction(r_best, r_scan))
     return new_plan, max(score, 1)

@@ -354,6 +354,7 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
             plan.condition,
             plan.how,
             plan.residual,
+            plan.using_pairs,
         )
     if isinstance(plan, L.Scan):
         out = plan.output_columns

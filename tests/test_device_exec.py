@@ -998,6 +998,27 @@ class TestOuterBucketedJoin:
         session.enable_hyperspace()
         return ldf, rdf
 
+    @pytest.mark.parametrize("how", ["right", "outer"])
+    def test_using_key_coalesces_across_sides(self, session, outer_env, how):
+        """Spark's df.join(other, on="k") coalesces the USING key: unmatched
+        right rows must show the RIGHT side's key under "k", not NULL — on
+        the bucketed span path AND the generic pandas-merge fallback."""
+        ldf, rdf = outer_env
+        q = ldf.join(rdf, on="k", how=how).select("k", "a", "b")
+
+        def keys_of(batch):
+            ks = np.asarray(batch["k"], dtype=np.float64)
+            assert not np.isnan(ks).any(), "USING key must never be NULL here"
+            return sorted(ks.astype(np.int64).tolist())
+
+        span_keys = keys_of(run_both(session, q))  # indexed bucketed paths
+        session.disable_hyperspace()
+        generic_keys = keys_of(q.collect())  # generic merge fallback
+        session.enable_hyperspace()
+        assert span_keys == generic_keys
+        # right keys 5..14 all present (10..14 match nothing on the left)
+        assert set(range(5, 15)) <= set(span_keys)
+
     @pytest.mark.parametrize("how,expected_rows", [("left", 10), ("right", 10), ("outer", 15), ("inner", 5)])
     def test_outer_join_matches_pandas(self, session, outer_env, how, expected_rows):
         ldf, rdf = outer_env

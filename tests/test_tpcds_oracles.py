@@ -843,14 +843,15 @@ def test_q90(env):
     ws, hd, td, wp = (t["web_sales"], t["household_demographics"], t["time_dim"],
                       t["web_page"])
 
+    m = (
+        ws.merge(td, left_on="ws_sold_time_sk", right_on="t_time_sk")
+        .merge(hd, left_on="ws_ship_hdemo_sk", right_on="hd_demo_sk")
+        .merge(wp, left_on="ws_web_page_sk", right_on="wp_web_page_sk")
+    )
+    m = m[(m.hd_dep_count == 6) & (m.wp_char_count >= 5000) & (m.wp_char_count <= 5200)]
+
     def bucket(hlo, hhi):
-        m = (
-            ws.merge(td, left_on="ws_sold_time_sk", right_on="t_time_sk")
-            .merge(hd, left_on="ws_ship_hdemo_sk", right_on="hd_demo_sk")
-            .merge(wp, left_on="ws_web_page_sk", right_on="wp_web_page_sk")
-        )
-        return len(m[(m.t_hour >= hlo) & (m.t_hour <= hhi) & (m.hd_dep_count == 6)
-                     & (m.wp_char_count >= 5000) & (m.wp_char_count <= 5200)])
+        return len(m[(m.t_hour >= hlo) & (m.t_hour <= hhi)])
 
     amc, pmc = bucket(8, 9), bucket(19, 20)
     ratio = amc / pmc if pmc else np.nan
