@@ -326,22 +326,33 @@ class Executor:
     def execute(self, plan: L.LogicalPlan, required_columns: Optional[List[str]] = None) -> B.Batch:
         from hyperspace_tpu.plan.expr import subquery_scope
 
+        # execution-time column pruning for EVERY plan (Catalyst runs
+        # ColumnPruning unconditionally; ApplyHyperspace only prunes plans
+        # it rewrites, and hyperspace-off queries never saw it at all —
+        # TPC-H q7 carried 48-column join intermediates for ~10 referenced
+        # columns). The approved-plan goldens pin the rule-relevant
+        # optimized plan, like the reference's NORMALIZED approvals, so the
+        # mechanical Project-over-scan layer stays out of them; the
+        # dispatch trace still records what actually runs. Fallback keeps
+        # the never-break-a-query contract.
+        try:
+            from hyperspace_tpu.rules.utils import prune_columns
+
+            plan = prune_columns(plan)
+        except Exception:  # pruning must never kill a query
+            # visible in recorded dispatch traces (and so in the goldens):
+            # a silent fallback here once hid a RecursionError that cost
+            # 3x on every view-sharing query
+            trace.record("prune", "fallback-unpruned")
+
         # sub-plans referenced more than once (a CTE used N times holds ONE
-        # plan object) execute once per collect; only those roots memoize
-        counts: Dict[int, int] = {}
-
-        def walk(p: L.LogicalPlan) -> None:
-            c = counts.get(id(p), 0) + 1
-            counts[id(p)] = c
-            if c == 1:
-                for ch in p.children():
-                    walk(ch)
-
-        walk(plan)
+        # plan object) execute once per collect; only those roots memoize.
         # NOTE: joins served by the device bucketed-SMJ path decode their
         # sides from index files directly (with their own byte-capped
         # caches), so this memo pays off on the host execution paths
-        self._shared = {pid for pid, c in counts.items() if c > 1}
+        from hyperspace_tpu.rules.utils import shared_subplan_ids
+
+        self._shared = shared_subplan_ids(plan)
         self._memo: Dict[Tuple[int, bool], B.Batch] = {}
         try:
             with subquery_scope():  # each subquery runs once per execute

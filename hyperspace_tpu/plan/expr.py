@@ -299,9 +299,18 @@ def _missing_mask(v) -> np.ndarray:
     if a.dtype.kind == "M":
         return np.isnat(a)
     if a.dtype == object:
-        return np.array(
-            [x is None or (isinstance(x, float) and x != x) for x in a.ravel()], dtype=bool
-        ).reshape(a.shape)
+        try:
+            import pandas as pd
+
+            # C-speed elementwise missing check (None/NaN/NaT/pd.NA — a
+            # compatible superset of the framework convention); the Python
+            # loop was a per-row hotspot on string-heavy predicates
+            return np.asarray(pd.isna(a.ravel()), dtype=bool).reshape(a.shape)
+        except (TypeError, ValueError):  # exotic elements (nested arrays)
+            return np.array(
+                [x is None or (isinstance(x, float) and x != x) for x in a.ravel()],
+                dtype=bool,
+            ).reshape(a.shape)
     return np.zeros(a.shape, dtype=bool)
 
 

@@ -97,9 +97,12 @@ class ApplyHyperspace:
         plan, sub_score = self._rewrite_subqueries(plan)
         # normalize: push required columns down to the scans (Catalyst runs
         # ColumnPruning before the reference's rules; this IR does it here)
-        from hyperspace_tpu.rules.utils import prune_columns
+        from hyperspace_tpu.rules.utils import prune_columns_duplicating
 
-        pruned = prune_columns(plan)
+        # per-reference duplication: each join side must be an independent
+        # linear sub-plan for the rules to match (a self-join's two sides
+        # are one object before this)
+        pruned = prune_columns_duplicating(plan)
         candidates = collect_candidates(self.ctx, pruned, indexes)
         if candidates:
             new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(pruned, candidates)

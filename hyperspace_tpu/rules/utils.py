@@ -267,16 +267,141 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
     (ref: JoinIndexRule.scala:419-448 allRequiredCols over pruned plans);
     this IR has no separate optimizer, so ApplyHyperspace normalizes first.
     ``needed=None`` means "all columns".
+
+    Sharing-preserving: a sub-plan referenced more than once (a CTE bound
+    to one plan object) must remain ONE object after pruning, or the
+    executor's shared-subtree memo stops deduplicating and the CTE
+    re-executes once per reference. Shared roots act as barriers in a
+    first pass that accumulates the UNION of columns every reference
+    needs; each is then pruned once and swapped back in by identity.
     """
+    shared = shared_subplan_ids(plan)
+    if not shared:
+        return _prune(plan, needed, None)
+
+    return _prune_shared(plan, needed, shared)
+
+
+def shared_subplan_ids(plan: L.LogicalPlan) -> set:
+    """ids of sub-plans referenced more than once (a CTE bound to one plan
+    object) — the single definition of "shared" used by both pruning here
+    and the executor's shared-subtree memo."""
+    counts: dict = {}
+
+    def walk(p):
+        c = counts.get(id(p), 0) + 1
+        counts[id(p)] = c
+        if c == 1:
+            for ch in p.children():
+                walk(ch)
+
+    walk(plan)
+    return {pid for pid, c in counts.items() if c > 1}
+
+
+def prune_columns_duplicating(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
+    """Per-reference pruning: shared sub-plans (self-join sides, CTEs) are
+    rebuilt independently per use with each use's own needed-set. This is
+    what the INDEX RULES want — each join side must be an independent
+    linear sub-plan to match and rewrite — at the cost of the executor's
+    shared-subtree dedup. ApplyHyperspace uses this before rule matching;
+    the executor's own pass uses the sharing-preserving prune_columns."""
+    return _prune(plan, needed, None)
+
+
+def _prune_shared(plan: L.LogicalPlan, needed, shared) -> L.LogicalPlan:
+
+    acc: dict = {}  # id(shared node) -> union of needed sets (None = all)
+
+    def note(p, need):
+        if id(p) in acc:
+            prev = acc[id(p)]
+            acc[id(p)] = None if (need is None or prev is None) else prev | set(need)
+        else:
+            acc[id(p)] = None if need is None else set(need)
+
+    top = _prune(plan, needed, (shared, note))
+    if not acc:
+        return top
+    # prune each shared root with its accumulated union, to a FIXPOINT:
+    # pruning one shared node can record new needs for another (a CTE that
+    # reads a second CTE, in either tree order), so keep re-pruning any
+    # node whose union grew since it was last pruned. Unions only grow and
+    # are bounded by the column sets, so this terminates.
+    preorder: list = []
+    seen: set = set()
+
+    def pre(p):
+        if id(p) in seen:
+            return
+        seen.add(id(p))
+        preorder.append(p)
+        for ch in p.children():
+            pre(ch)
+
+    pre(plan)
+
+    def frozen(s):
+        return None if s is None else frozenset(s)
+
+    replaced: dict = {}
+    pruned_with: dict = {}
+    while True:
+        stale = [
+            n for n in preorder
+            if id(n) in acc and pruned_with.get(id(n), ()) != frozen(acc[id(n)])
+        ]
+        if not stale:
+            break
+        for node in stale:
+            key = frozen(acc[id(node)])
+            replaced[id(node)] = _prune(node, acc[id(node)], (shared, note), skip_self=True)
+            pruned_with[id(node)] = key
+    # swap pruned shared roots back in, preserving identity (memo by id).
+    # A pruned shared node often CONTAINS its original (a barrier'd Scan
+    # prunes to Project(cols, scan)); the in_progress guard keeps that
+    # self-reference pointing at the original instead of recursing forever.
+    memo: dict = {}
+    in_progress: set = set()
+
+    def swap(p):
+        got = memo.get(id(p))
+        if got is not None:
+            return got
+        if id(p) in in_progress:
+            return p
+        res = replaced.get(id(p), p)
+        if res is p:
+            new_children = [swap(ch) for ch in p.children()]
+            if any(n is not o for n, o in zip(new_children, p.children())):
+                res = p.with_children(new_children)
+        else:
+            in_progress.add(id(p))
+            try:
+                inner_children = [swap(ch) for ch in res.children()]
+                if any(n is not o for n, o in zip(inner_children, res.children())):
+                    res = res.with_children(inner_children)
+            finally:
+                in_progress.discard(id(p))
+        memo[id(p)] = res
+        return res
+
+    return swap(top)
+
+
+def _prune(plan: L.LogicalPlan, needed, barrier, skip_self: bool = False) -> L.LogicalPlan:
+    if barrier is not None and not skip_self and id(plan) in barrier[0]:
+        barrier[1](plan, needed)
+        return plan  # shared root: record needs, prune later, keep identity
     if isinstance(plan, L.Project):
         child_needed = set()
         for c in plan.columns:
             child_needed.add(c)
-        return L.Project(plan.columns, prune_columns(plan.child, child_needed))
+        return L.Project(plan.columns, _prune(plan.child, child_needed, barrier))
     if isinstance(plan, L.Filter):
         child_needed = None if needed is None else set(needed) | set(plan.condition.references())
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, child_needed)])
+        return plan.with_children([_prune(child, child_needed, barrier)])
     if isinstance(plan, L.Compute):
         # a computed column needs its expression's inputs instead of itself
         if needed is None:
@@ -290,7 +415,7 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
                 else:
                     child_needed.add(c)
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, child_needed)])
+        return plan.with_children([_prune(child, child_needed, barrier)])
     if isinstance(plan, L.Join):
         left_cols = set(plan.left.output_columns)
         right_cols = set(plan.right.output_columns)
@@ -325,11 +450,17 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
 
             l_needed, r_needed = set(), set()
             for c in needed:
-                if keep_renamed(c, l_needed, r_needed):
-                    continue
+                # LEFT membership first: join_output_names passes left names
+                # through verbatim, so an 'x#r' that exists on the left IS a
+                # left column (a lower join's rename product) — the right
+                # side's colliding 'x' renames PAST it to 'x#r#r'. Chain-
+                # stripping first would misattribute it to the right side
+                # (and mis-prune a 3-way join with thrice-repeated names).
                 lr = on_side(c, left_cols)
                 if lr is not None:
                     l_needed.add(lr)
+                    continue
+                if keep_renamed(c, l_needed, r_needed):
                     continue
                 rr = on_side(c, right_cols)
                 if rr is not None:
@@ -338,8 +469,11 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
             if plan.residual is not None:
                 # residual refs use post-join names: map '#r' back to the
                 # right-side source column like the needed loop above
+                # (left-first, same reasoning)
                 for c in plan.residual.references():
-                    if not keep_renamed(c, l_needed, r_needed):
+                    if on_side(c, left_cols) is not None:
+                        cond_refs.add(c)
+                    elif not keep_renamed(c, l_needed, r_needed):
                         cond_refs.add(c)
             for c in cond_refs:
                 lr = on_side(c, left_cols)
@@ -349,8 +483,8 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
                 if rr is not None:
                     r_needed.add(rr)
         return L.Join(
-            prune_columns(plan.left, l_needed),
-            prune_columns(plan.right, r_needed),
+            _prune(plan.left, l_needed, barrier),
+            _prune(plan.right, r_needed, barrier),
             plan.condition,
             plan.how,
             plan.residual,
@@ -365,17 +499,22 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
         # dotted refs survive pruning as their own projected columns (the
         # reference relies on Catalyst extracting nested field accesses)
         dotted = {c for c in needed if c not in out_set and "." in c and c.split(".")[0] in out_set}
+        if not flat and not dotted:
+            # a count(*)-only consumer needs the ROW COUNT: a zero-column
+            # scan would report zero rows, so keep the narrowest thing we
+            # have (Catalyst keeps a cheapest column here too)
+            flat = {out[0]} if out else set()
         if flat | {d.split(".")[0] for d in dotted} < out_set or dotted:
             ordered = [c for c in out if c in flat] + sorted(dotted)
             if set(ordered) != out_set:
                 return L.Project(ordered, plan)
         return plan
     if isinstance(plan, L.Union):
-        return plan.with_children([prune_columns(c, needed) for c in plan.children()])
+        return plan.with_children([_prune(c, needed, barrier) for c in plan.children()])
     if isinstance(plan, L.Aggregate):
         child_needed = set(plan.keys) | {c for _, _, c in plan.aggs if c is not None}
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, child_needed)])
+        return plan.with_children([_prune(child, child_needed, barrier)])
     if isinstance(plan, L.Window):
         produced = {s[0] for s in plan.specs}
         operands = set()
@@ -388,18 +527,25 @@ def prune_columns(plan: L.LogicalPlan, needed=None) -> L.LogicalPlan:
             None if needed is None else ({c for c in needed if c not in produced} | operands)
         )
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, child_needed)])
+        return plan.with_children([_prune(child, child_needed, barrier)])
     if isinstance(plan, L.Sort):
         child_needed = None if needed is None else set(needed) | {c for c, _ in plan.keys}
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, child_needed)])
+        return plan.with_children([_prune(child, child_needed, barrier)])
     if isinstance(plan, L.Limit):
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, needed)])
+        return plan.with_children([_prune(child, needed, barrier)])
     if isinstance(plan, L.Rename):
         inverse = {v: k for k, v in plan.mapping.items()}
         child_needed = None if needed is None else {inverse.get(c, c) for c in needed}
         (child,) = plan.children()
-        return plan.with_children([prune_columns(child, child_needed)])
-    # unknown node: keep children un-pruned (safe)
+        return plan.with_children([_prune(child, child_needed, barrier)])
+    # unknown node (set ops compare WHOLE rows, repartition/bucket-union
+    # pass rows through): children keep all their columns, but still
+    # recurse — nested Projects prune their own subtrees, and shared
+    # sub-plans MUST be noted here or the sharing swap would substitute
+    # replacements pruned for other (narrower) uses of the same object
+    new_children = [_prune(c, None, barrier) for c in plan.children()]
+    if any(n is not o for n, o in zip(new_children, plan.children())):
+        return plan.with_children(new_children)
     return plan

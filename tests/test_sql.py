@@ -361,6 +361,45 @@ class TestCtes:
         ).collect()
         np.testing.assert_array_equal(np.sort(got["amount"]), np.sort(ref["amount"]))
 
+    def test_shared_cte_pruning_reaches_fixpoint(self, session, views):
+        """b's 'amount' is needed ONLY through the twice-referenced CTE a:
+        execution-time pruning prunes shared roots to a fixpoint, so b must
+        regain 'amount' when a's (deferred) pruning records the need —
+        pruning b once in preorder would drop it (KeyError at execution)."""
+        got = session.sql(
+            "WITH b AS (SELECT user, region, amount FROM sales), "
+            "a AS (SELECT user, amount FROM b) "
+            "SELECT b.region, a1.amount, a2.amount AS amount2 "
+            "FROM b JOIN a a1 ON b.user = a1.user JOIN a a2 ON b.user = a2.user"
+        ).collect()
+        ref = session.sql(
+            "SELECT s.region, s2.amount, s3.amount AS amount2 "
+            "FROM sales s JOIN sales s2 ON s.user = s2.user "
+            "JOIN sales s3 ON s.user = s3.user"
+        ).collect()
+        order = np.lexsort((got["amount2"], got["amount"], got["region"].astype("U16")))
+        rorder = np.lexsort((ref["amount2"], ref["amount"], ref["region"].astype("U16")))
+        for c in got:
+            np.testing.assert_array_equal(got[c][order], ref[c][rorder], err_msg=c)
+
+    def test_setop_branch_keeps_columns_under_shared_scan(self, session, views):
+        """A shared scan referenced both under a set-op and under a
+        differently-pruned projection: the sharing-preserving prune must
+        record the set-op branch's needs too, or the swapped replacement
+        loses columns that branch reads (KeyError at execution)."""
+        base = session.sql("SELECT user, region, amount FROM sales").collect()
+        got = session.sql(
+            "SELECT t.user FROM "
+            "(SELECT user FROM sales EXCEPT SELECT user FROM sales WHERE region = 'r2') t "
+            "JOIN (SELECT user, amount FROM sales WHERE amount > 50) b ON t.user = b.user"
+        ).collect()
+        users, region, amount = base["user"], base["region"], base["amount"]
+        keep = sorted(set(users.tolist()) - set(users[region == "r2"].tolist()))
+        b_users = users[amount > 50]
+        expected = sorted(u for u in keep for _ in range(int((b_users == u).sum())))
+        assert expected, "fixture produced a vacuous case"
+        assert sorted(got["user"].tolist()) == expected
+
     def test_index_applies_inside_cte(self, session, hs, views):
         sdf, _ = views
         hs.create_index(sdf, hst.CoveringIndexConfig("cteIdx", ["region"], ["amount"]))
