@@ -93,7 +93,13 @@ def _rows_of_frame(df, ecols_sorted):
 
 
 def check(sess, qname, oracle_df):
-    got = sess.sql(_query_text(qname)).collect()
+    return compare_batch(sess.sql(_query_text(qname)).collect(), oracle_df, qname)
+
+
+def compare_batch(got, oracle_df, qname):
+    """Engine batch vs pandas oracle frame: column-count and (sorted,
+    normalized) row-set equality with float tolerance. Shared by the TPC-DS
+    and TPC-H oracle suites."""
     erows, ecols = _rows_of_batch(got)
     assert len(oracle_df.columns) == len(ecols), (qname, list(oracle_df.columns), ecols)
     orows = _rows_of_frame(oracle_df, ecols)
@@ -102,8 +108,12 @@ def check(sess, qname, oracle_df):
     okey = sorted(orows, key=lambda r: tuple(_norm(v) for v in r))
     for a, b in zip(ekey, okey):
         for x, y in zip(a, b):
-            fx = isinstance(x, float) or isinstance(x, np.floating)
-            fy = isinstance(y, float) or isinstance(y, np.floating)
+            # ints count as numeric too: a pandas oracle Series mixing sums
+            # and counts coerces the counts to float while the engine keeps
+            # int64 — a 12126 vs 12126.0 pair must compare numerically, and
+            # isclose with abs_tol 1e-6 still rejects off-by-one counts
+            fx = isinstance(x, (float, np.floating, int, np.integer)) and not isinstance(x, bool)
+            fy = isinstance(y, (float, np.floating, int, np.integer)) and not isinstance(y, bool)
             if fx and fy:
                 if x != x and y != y:
                     continue

@@ -3,7 +3,9 @@ standard texts). Same harness shape as the TPC-DS suite: every query plans,
 holds an approved plan (regen with HS_GENERATE_GOLDEN=1), and returns
 identical results with hyperspace on vs off over the full 8-table schema
 with covering indexes on the hot keys. The driver's BASELINE configs are
-TPC-H-shaped, so this is the benchmark family's correctness floor."""
+TPC-H-shaped, so this is the benchmark family's correctness floor;
+tests/test_tpch_oracles.py adds absolute-correctness pandas oracles for ten
+of the texts on top of this parity."""
 
 import os
 import zlib
@@ -202,14 +204,18 @@ def _shape_table(name, cols, n, rng):
         cols["c_acctbal"][lo:] = cols["c_acctbal"][lo:] + 1500.0
 
 
-@pytest.fixture(scope="module")
-def tpch(tmp_path_factory):
-    root = str(tmp_path_factory.mktemp("tpch_sql"))
+def build_tpch_env(root):
+    """Shared fixture builder: the gold-standard parity suite and the oracle
+    suite (test_tpch_oracles.py) MUST test the same shaped data and index
+    roster. Returns (session, {table -> pandas frame})."""
+    import pandas as pd
+
     sysp = os.path.join(root, "_indexes")
     os.makedirs(sysp)
     sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sysp, hst.keys.NUM_BUCKETS: 4})
     hst.set_session(sess)
     hs = hst.Hyperspace(sess)
+    frames = {}
     for name, schema in TPCH_SCHEMAS.items():
         rng = np.random.default_rng(zlib.crc32(name.encode()))
         n = _ROWS[name]
@@ -222,11 +228,19 @@ def tpch(tmp_path_factory):
         os.makedirs(d)
         pq.write_table(pa.table(cols), os.path.join(d, "part-00000.parquet"))
         sess.read_parquet(d).create_or_replace_temp_view(name)
+        frames[name] = pd.DataFrame(cols)
     for table, idx_name, indexed, included in INDEXES:
         hs.create_index(
             sess._temp_views[table], hst.CoveringIndexConfig(idx_name, indexed, included)
         )
     sess.enable_hyperspace()
+    return sess, frames
+
+
+@pytest.fixture(scope="module")
+def tpch(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("tpch_sql"))
+    sess, _frames = build_tpch_env(root)
     yield sess, root
     hst.set_session(None)
 
