@@ -61,19 +61,28 @@ def env(tmp_path_factory):
     hst.set_session(None)
 
 
+def strip_limit(text):
+    """Strip a trailing LIMIT so ORDER BY ties cannot make the comparison
+    flaky; oracles compute the full set. Shared with test_tpch_oracles."""
+    return re.sub(r"\bLIMIT\s+\d+\s*$", "", text.strip(), flags=re.I)
+
+
 def _query_text(qname):
     with open(os.path.join(QUERIES_DIR, f"{qname}.sql")) as f:
-        text = f.read()
-    # strip LIMIT so ORDER BY ties cannot make the comparison flaky; oracles
-    # compute the full set
-    return re.sub(r"\bLIMIT\s+\d+\s*$", "", text.strip(), flags=re.I)
+        return strip_limit(f.read())
+
+
+def _is_num(v):
+    return isinstance(v, (float, np.floating, int, np.integer)) and not isinstance(v, bool)
 
 
 def _norm(v):
     if v is None or (isinstance(v, float) and v != v) or v is pd.NaT:
         return "\x00NULL"
-    if isinstance(v, float):
-        return f"{v:.3g}"
+    # ints and floats format IDENTICALLY so the row sort cannot misalign an
+    # engine int64 against its oracle float-coerced counterpart
+    if _is_num(v):
+        return f"{float(v):.3g}"
     return str(v)
 
 
@@ -108,13 +117,17 @@ def compare_batch(got, oracle_df, qname):
     okey = sorted(orows, key=lambda r: tuple(_norm(v) for v in r))
     for a, b in zip(ekey, okey):
         for x, y in zip(a, b):
-            # ints count as numeric too: a pandas oracle Series mixing sums
-            # and counts coerces the counts to float while the engine keeps
-            # int64 — a 12126 vs 12126.0 pair must compare numerically, and
-            # isclose with abs_tol 1e-6 still rejects off-by-one counts
-            fx = isinstance(x, (float, np.floating, int, np.integer)) and not isinstance(x, bool)
-            fy = isinstance(y, (float, np.floating, int, np.integer)) and not isinstance(y, bool)
-            if fx and fy:
+            if _is_num(x) and _is_num(y):
+                xf = isinstance(x, (float, np.floating))
+                yf = isinstance(y, (float, np.floating))
+                if not xf and not yf:
+                    # int vs int compares EXACTLY (tolerance would wave
+                    # through off-by-one counts at >=1e6 magnitudes)
+                    assert int(x) == int(y), f"{qname}: {x!r} != {y!r}"
+                    continue
+                # a pandas oracle Series mixing sums and counts coerces the
+                # counts to float while the engine keeps int64 — numeric
+                # compare with tolerance once ANY side is a float
                 if x != x and y != y:
                     continue
                 assert math.isclose(float(x), float(y), rel_tol=1e-6, abs_tol=1e-6), (
