@@ -426,6 +426,22 @@ class Executor:
             return B.mask_rows(child, mask)
 
         if isinstance(plan, L.Project):
+            # projection pushdown into a directly-scanned source: decode ONLY
+            # the projected columns (the column-pruned plan shape is
+            # Project-over-Scan; reading all 16 lineitem columns to keep 7
+            # doubled TPC-H q1's scan cost). Shared scans are pruned to one
+            # shared Project, so the _exec memo above still deduplicates.
+            if (
+                isinstance(plan.child, L.Scan)
+                and id(plan.child) not in self._shared
+                and set(plan.columns) <= set(plan.child.output_columns)
+            ):
+                got = self._exec_scan(
+                    plan.child, with_file_names, columns=list(plan.columns)
+                )
+                if with_file_names and INPUT_FILE_NAME in got:
+                    return got
+                return B.select(got, list(plan.columns))
             child = self._exec(plan.child, with_file_names)
             cols = list(plan.columns)
             if with_file_names and INPUT_FILE_NAME in child:
@@ -534,7 +550,13 @@ class Executor:
 
         raise NotImplementedError(f"Cannot execute {type(plan).__name__}")
 
-    def _exec_scan(self, plan: L.Scan, with_file_names: bool, files: Optional[List[str]] = None) -> B.Batch:
+    def _exec_scan(
+        self,
+        plan: L.Scan,
+        with_file_names: bool,
+        files: Optional[List[str]] = None,
+        columns: Optional[List[str]] = None,
+    ) -> B.Batch:
         rel = plan.relation
         if files is None:
             files = [fi.name for fi in rel.all_file_infos()]
@@ -545,6 +567,7 @@ class Executor:
             batch: B.Batch = {
                 f.name: np.empty(0, dtype=schema_codec.arrow_to_numpy_dtype(f.type))
                 for f in rel.schema
+                if columns is None or f.name in columns
             }
             if with_file_names:
                 batch[INPUT_FILE_NAME] = np.empty(0, dtype=object)
@@ -558,7 +581,7 @@ class Executor:
         return _read_files(
             files,
             rel.physical_format,
-            None,
+            columns,
             with_file_names,
             pv,
             pd,

@@ -173,6 +173,24 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
         t = ds.to_table(columns=cols)
         return B.table_to_batch(t)
 
+    # a multi-file scan's CONCATENATED batch is itself cacheable (same
+    # immutability argument as the per-file entries): re-concatenating 6M
+    # rows cost ~0.7 s per execution of TPC-H q1 at sf=1. The entry lives in
+    # the same byte-capped LRU; trace events mirror the per-file cached path
+    # so dispatch goldens are insensitive to which cache tier answered.
+    concat_key = None
+    if columns is not None and len(files) > 1:
+        per_file = [_io_cache_key(f, columns) for f in files]
+        # a None per-file key (stat failed) disables caching everywhere
+        # else; embedding it in the tuple would collide unrelated scans
+        if all(k is not None for k in per_file):
+            concat_key = ("concat", tuple(per_file))
+            got = _io_cache_get(concat_key)
+            if got is not None:
+                for _ in files:
+                    trace.record("decode", "cached")
+                return got
+
     # fully-cached scan with an explicit projection: every cached batch holds
     # exactly ``columns``, so concatenation is schema-safe and the pq schema
     # pre-scan can be skipped. With columns=None per-file schemas may differ
@@ -182,7 +200,12 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
     if columns is not None and cached and all(b is not None for b in cached):
         for _ in cached:
             trace.record("decode", "cached")
-        return cached[0] if len(cached) == 1 else B.concat(cached)
+        if len(cached) == 1:
+            return cached[0]
+        out = B.concat(cached)
+        if concat_key is not None:
+            _io_cache_put(concat_key, out)
+        return out
 
     # pre-scan schemas; any inconsistency -> unified dataset read
     try:
@@ -239,4 +262,7 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
         return _dataset_read()
     if len(batches) == 1:
         return batches[0]
-    return B.concat(batches)
+    out = B.concat(batches)
+    if concat_key is not None:
+        _io_cache_put(concat_key, out)
+    return out
