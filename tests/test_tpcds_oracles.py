@@ -830,6 +830,16 @@ def test_q45(env):
     _nonempty(check(sess, "q45", out), "q45")
 
 
+def _lag_buckets(lag):
+    return pd.Series({
+        "30 days ": int((lag <= 30).sum()),
+        "31 - 60 days ": int(((lag > 30) & (lag <= 60)).sum()),
+        "61 - 90 days ": int(((lag > 60) & (lag <= 90)).sum()),
+        "91 - 120 days ": int(((lag > 90) & (lag <= 120)).sum()),
+        ">120 days ": int((lag > 120).sum()),
+    })
+
+
 def test_q62(env):
     sess, t = env
     ws, w, sm, web, d = (t["web_sales"], t["warehouse"], t["ship_mode"],
@@ -841,24 +851,137 @@ def test_q62(env):
         .merge(sm, left_on="ws_ship_mode_sk", right_on="sm_ship_mode_sk")
         .merge(web, left_on="ws_web_site_sk", right_on="web_site_sk")
     )
-    m = m.assign(wname=m.w_warehouse_name.astype(str).str[:20],
-                 lag=m.ws_ship_date_sk - m.ws_sold_date_sk)
-
-    def aggs(g):
-        return pd.Series({
-            "30 days ": int((g.lag <= 30).sum()),
-            "31 - 60 days ": int(((g.lag > 30) & (g.lag <= 60)).sum()),
-            "61 - 90 days ": int(((g.lag > 60) & (g.lag <= 90)).sum()),
-            "91 - 120 days ": int(((g.lag > 90) & (g.lag <= 120)).sum()),
-            ">120 days ": int((g.lag > 120).sum()),
-        })
-
+    m = m.assign(wname=m.w_warehouse_name.astype(str).str[:20])
     out = m.groupby(["wname", "sm_type", "web_name"], as_index=False).apply(
-        aggs, include_groups=False
+        lambda x: _lag_buckets(x.ws_ship_date_sk - x.ws_sold_date_sk),
+        include_groups=False,
     )
     # the engine names unaliased expressions by their token-spaced SQL text
     out = out.rename(columns={"wname": "substr ( w_warehouse_name , 1 , 20 )"})
     _nonempty(check(sess, "q62", out), "q62")
+
+
+def test_q29(env):
+    sess, t = env
+    ss, sr, cs, d, s, i = (t["store_sales"], t["store_returns"], t["catalog_sales"],
+                           t["date_dim"], t["store"], t["item"])
+    d1 = d[(d.d_moy == 9) & (d.d_year == 1999)][["d_date_sk"]]
+    d2 = d[(d.d_moy >= 9) & (d.d_moy <= 12) & (d.d_year == 1999)][["d_date_sk"]]
+    d3 = d[d.d_year.isin([1999, 2000, 2001])][["d_date_sk"]]
+    m = (
+        ss.merge(d1, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(i, left_on="ss_item_sk", right_on="i_item_sk")
+        .merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        .merge(sr, left_on=["ss_customer_sk", "ss_item_sk", "ss_ticket_number"],
+               right_on=["sr_customer_sk", "sr_item_sk", "sr_ticket_number"])
+        .merge(d2, left_on="sr_returned_date_sk", right_on="d_date_sk")
+        .merge(cs, left_on=["sr_customer_sk", "sr_item_sk"],
+               right_on=["cs_bill_customer_sk", "cs_item_sk"])
+        .merge(d3, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    )
+    g = m.groupby(["i_item_id", "i_item_desc", "s_store_id", "s_store_name"],
+                  as_index=False).agg(
+        store_sales_quantity=("ss_quantity", "sum"),
+        store_returns_quantity=("sr_return_quantity", "sum"),
+        catalog_sales_quantity=("cs_quantity", "sum"),
+    )
+    _nonempty(check(sess, "q29", g), "q29")
+
+
+def test_q40(env):
+    sess, t = env
+    cs, cr, w, i, d = (t["catalog_sales"], t["catalog_returns"], t["warehouse"],
+                       t["item"], t["date_dim"])
+    pivot = np.datetime64("2000-03-11")
+    m = (
+        cs.merge(cr[["cr_order_number", "cr_item_sk", "cr_refunded_cash"]],
+                 left_on=["cs_order_number", "cs_item_sk"],
+                 right_on=["cr_order_number", "cr_item_sk"], how="left")
+        .merge(w, left_on="cs_warehouse_sk", right_on="w_warehouse_sk")
+        .merge(i, left_on="cs_item_sk", right_on="i_item_sk")
+        .merge(d, left_on="cs_sold_date_sk", right_on="d_date_sk")
+    )
+    m = m[(m.i_current_price >= 0.99) & (m.i_current_price <= 1.49)
+          & (m.d_date.values >= pivot - np.timedelta64(30, "D"))
+          & (m.d_date.values <= pivot + np.timedelta64(30, "D"))]
+    net = m.cs_sales_price - m.cr_refunded_cash.fillna(0)
+    before = np.where(m.d_date.values < pivot, net, 0.0)
+    after = np.where(m.d_date.values >= pivot, net, 0.0)
+    g = m.assign(_b=before, _a=after).groupby(["w_state", "i_item_id"], as_index=False).agg(
+        sales_before=("_b", "sum"), sales_after=("_a", "sum")
+    )
+    _nonempty(check(sess, "q40", g), "q40")
+
+
+def test_q46(env):
+    sess, t = env
+    ss, d, s, hd, ca, c = (t["store_sales"], t["date_dim"], t["store"],
+                           t["household_demographics"], t["customer_address"],
+                           t["customer"])
+    m = (
+        ss.merge(d, left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+        .merge(hd, left_on="ss_hdemo_sk", right_on="hd_demo_sk")
+        .merge(ca, left_on="ss_addr_sk", right_on="ca_address_sk")
+    )
+    m = m[((m.hd_dep_count == 4) | (m.hd_vehicle_count == 3))
+          & m.d_dow.isin([6, 0]) & m.d_year.isin([1999, 2000, 2001])
+          & (m.s_city.isin(["Fairview", "Midway"]))]
+    dn = m.groupby(["ss_ticket_number", "ss_customer_sk", "ss_addr_sk", "ca_city"],
+                   as_index=False).agg(amt=("ss_coupon_amt", "sum"),
+                                       profit=("ss_net_profit", "sum"))
+    out = (
+        dn.merge(c, left_on="ss_customer_sk", right_on="c_customer_sk")
+        .merge(ca.add_prefix("cur_"), left_on="c_current_addr_sk",
+               right_on="cur_ca_address_sk")
+    )
+    out = out[out.cur_ca_city != out.ca_city]
+    out = out.rename(columns={"ca_city": "bought_city", "cur_ca_city": "ca_city"})
+    _nonempty(check(sess, "q46", out[
+        ["c_last_name", "c_first_name", "ca_city", "bought_city",
+         "ss_ticket_number", "amt", "profit"]
+    ]), "q46")
+
+
+def test_q50(env):
+    sess, t = env
+    ss, sr, s, d = (t["store_sales"], t["store_returns"], t["store"], t["date_dim"])
+    d2 = d[(d.d_year == 2001) & (d.d_moy == 8)][["d_date_sk"]]
+    m = (
+        ss.merge(sr, left_on=["ss_ticket_number", "ss_item_sk", "ss_customer_sk"],
+                 right_on=["sr_ticket_number", "sr_item_sk", "sr_customer_sk"])
+        .merge(d[["d_date_sk"]], left_on="ss_sold_date_sk", right_on="d_date_sk")
+        .merge(d2, left_on="sr_returned_date_sk", right_on="d_date_sk",
+               suffixes=("", "_r"))
+        .merge(s, left_on="ss_store_sk", right_on="s_store_sk")
+    )
+    keys = ["s_store_name", "s_company_id", "s_street_number", "s_street_name",
+            "s_street_type", "s_suite_number", "s_city", "s_county", "s_state", "s_zip"]
+    out = m.groupby(keys, as_index=False, dropna=False).apply(
+        lambda x: _lag_buckets(x.sr_returned_date_sk - x.ss_sold_date_sk),
+        include_groups=False,
+    )
+    _nonempty(check(sess, "q50", out), "q50")
+
+
+def test_q99(env):
+    sess, t = env
+    cs, w, sm, cc, d = (t["catalog_sales"], t["warehouse"], t["ship_mode"],
+                        t["call_center"], t["date_dim"])
+    m = (
+        cs.merge(d[(d.d_month_seq >= 1200) & (d.d_month_seq <= 1211)][["d_date_sk"]],
+                 left_on="cs_ship_date_sk", right_on="d_date_sk")
+        .merge(w, left_on="cs_warehouse_sk", right_on="w_warehouse_sk")
+        .merge(sm, left_on="cs_ship_mode_sk", right_on="sm_ship_mode_sk")
+        .merge(cc, left_on="cs_call_center_sk", right_on="cc_call_center_sk")
+    )
+    m = m.assign(wname=m.w_warehouse_name.astype(str).str[:20])
+    out = m.groupby(["wname", "sm_type", "cc_name"], as_index=False).apply(
+        lambda x: _lag_buckets(x.cs_ship_date_sk - x.cs_sold_date_sk),
+        include_groups=False,
+    )
+    out = out.rename(columns={"wname": "substr ( w_warehouse_name , 1 , 20 )"})
+    _nonempty(check(sess, "q99", out), "q99")
 
 
 def test_q90(env):
