@@ -1505,6 +1505,7 @@ def _plan_from(q: Query, views):
         where_n = _factor_or_common(q.where) if q.where is not None else None
         conjuncts = split_conjunctive(where_n) if where_n is not None else []
         _push_single_frame_conjuncts(built, conjuncts, used)
+        _push_implied_disjunctions(built, conjuncts, used)
         df, alias_cols = built[0]
         pending = built[1:]
         while pending:
@@ -1690,18 +1691,13 @@ def _factor_or_common(e: Expr) -> Expr:
     return _and_all(common) & _or_all([r for r in residuals if r is not None])
 
 
-def _push_single_frame_conjuncts(built, conjuncts, used) -> None:
-    """Filter each FROM frame by the WHERE conjuncts that reference only that
-    frame, BEFORE any join is built (Catalyst's PushDownPredicates role). An
-    upper filter over an N-way self-join (TPC-DS q4/q11/q31: 4 references to
-    one year_total CTE, distinguished only by per-reference year/channel
-    predicates) otherwise materializes the unfiltered cross-growth first —
-    quadratic-to-quartic row explosion that the filter then throws away."""
+def _frame_owner_fn(built):
+    """Resolver shared by the pre-join pushdown passes: name -> (frame
+    index, actual column) when the reference resolves into exactly one
+    frame; None otherwise (unknown alias, or bare name in several)."""
     frame_lowers = [{c.lower(): c for c in fr.plan.output_columns} for fr, _ in built]
 
     def owner(name: str):
-        """(frame index, actual column) when the ref resolves into exactly
-        one frame; None otherwise (unknown alias, or bare name in several)."""
         if "." in name:
             qual, rest = name.split(".", 1)
             ql, rl = qual.lower(), rest.lower()
@@ -1715,29 +1711,90 @@ def _push_single_frame_conjuncts(built, conjuncts, used) -> None:
         hits = [(i, low[ln]) for i, low in enumerate(frame_lowers) if ln in low]
         return hits[0] if len(hits) == 1 else None
 
+    return owner
+
+
+def _owned_rewrite(owner, sub):
+    """(frame index, rewritten term) when every reference of ``sub`` resolves
+    into ONE frame; None otherwise (or for marker terms / no references)."""
+    if _contains_marker(sub):
+        return None
+    refs = sorted(sub.references())
+    if not refs:
+        return None
+    target, mapping = None, {}
+    for r in refs:
+        got = owner(r)
+        if got is None:
+            return None
+        i, cn = got
+        if target is None:
+            target = i
+        elif target != i:
+            return None
+        mapping[r] = cn
+    return target, _rewrite(sub, mapping)
+
+
+def _push_single_frame_conjuncts(built, conjuncts, used) -> None:
+    """Filter each FROM frame by the WHERE conjuncts that reference only that
+    frame, BEFORE any join is built (Catalyst's PushDownPredicates role). An
+    upper filter over an N-way self-join (TPC-DS q4/q11/q31: 4 references to
+    one year_total CTE, distinguished only by per-reference year/channel
+    predicates) otherwise materializes the unfiltered cross-growth first —
+    quadratic-to-quartic row explosion that the filter then throws away."""
+    owner = _frame_owner_fn(built)
+
     for ci, term in enumerate(conjuncts):
-        if ci in used or _contains_marker(term):
+        if ci in used:
             continue
-        refs = sorted(term.references())
-        if not refs:
-            continue
-        target, mapping, ok = None, {}, True
-        for r in refs:
-            got = owner(r)
-            if got is None:
-                ok = False
-                break
-            i, cn = got
-            if target is None:
-                target = i
-            elif target != i:
-                ok = False
-                break
-            mapping[r] = cn
-        if ok and target is not None:
+        got = _owned_rewrite(owner, term)
+        if got is not None:
+            target, rewritten = got
             fr, amap_r = built[target]
-            built[target] = (fr.filter(_rewrite(term, mapping)), amap_r)
+            built[target] = (fr.filter(rewritten), amap_r)
             used.add(ci)
+
+
+def _push_implied_disjunctions(built, conjuncts, used) -> None:
+    """Derive per-frame prefilters implied by a multi-frame disjunction
+    (Catalyst's constraint-inference role for the CNF-conversion class of
+    predicates): for ``(a1 AND ...) OR (a2 AND ...)``, when EVERY branch
+    carries sub-terms referencing only frame F, the whole disjunction
+    implies ``OR(branch F-parts)`` — under Kleene semantics a row whose
+    every branch F-part is FALSE/UNKNOWN cannot make any branch TRUE, so
+    filtering on the implied OR (which keeps only TRUE) drops no surviving
+    row. The implied filter pushes BELOW the joins as a REDUNDANT
+    prefilter; the original predicate still applies after them. TPC-DS/
+    TPC-H q13/q19/q48-style demographic and address OR-blocks shrink
+    their inputs ~10x this way."""
+    from hyperspace_tpu.plan.expr import split_conjunctive
+
+    owner = _frame_owner_fn(built)
+    for ci, term in enumerate(conjuncts):
+        if ci in used:
+            continue
+        branches = _split_disjunctive(term)
+        if len(branches) < 2:
+            continue
+        branch_parts = []  # per branch: {frame index -> [rewritten terms]}
+        eligible = None
+        for b in branches:
+            parts: Dict[int, List[Expr]] = {}
+            for sub in split_conjunctive(b):
+                got = _owned_rewrite(owner, sub)
+                if got is not None:
+                    parts.setdefault(got[0], []).append(got[1])
+            branch_parts.append(parts)
+            eligible = set(parts) if eligible is None else (eligible & set(parts))
+            if not eligible:
+                break
+        if not eligible:
+            continue
+        for f in sorted(eligible):
+            constraint = _or_all([_and_all(bp[f]) for bp in branch_parts])
+            fr, amap_r = built[f]
+            built[f] = (fr.filter(constraint), amap_r)
 
 
 def _classify_two_sided(name: str, left_aliases, right_aliases, left_lower, right_lower):

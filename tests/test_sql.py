@@ -382,6 +382,28 @@ class TestCtes:
         for c in got:
             np.testing.assert_array_equal(got[c][order], ref[c][rorder], err_msg=c)
 
+    def test_implied_disjunction_pushes_per_frame_prefilters(self, session, views):
+        """A disjunction whose every branch constrains a frame implies the
+        OR of those per-frame constraints, which must prefilter BELOW the
+        join (redundantly — the full predicate still applies above)."""
+        sql = (
+            "SELECT amount FROM sales s, users u WHERE s.user = u.user AND "
+            "((u.tier = 'gold' AND s.amount > 50) OR (u.tier = 'std' AND s.amount < 10))"
+        )
+        q = session.sql(sql)
+        plan = q.optimized_plan().pretty()
+        # the users frame gets the implied tier prefilter below the join
+        assert "Join" in plan
+        join_pos = plan.index("Join")
+        below = plan[join_pos:]
+        assert "'gold'" in below and "'std'" in below, plan
+        got = np.sort(q.collect()["amount"])
+        ref = session.sql(
+            "SELECT amount FROM sales s JOIN users u ON s.user = u.user "
+            "WHERE (u.tier = 'gold' AND s.amount > 50) OR (u.tier = 'std' AND s.amount < 10)"
+        ).collect()
+        np.testing.assert_array_equal(got, np.sort(ref["amount"]))
+
     def test_setop_branch_keeps_columns_under_shared_scan(self, session, views):
         """A shared scan referenced both under a set-op and under a
         differently-pruned projection: the sharing-preserving prune must
