@@ -146,27 +146,101 @@ class CoveringIndex(Index):
 
         plan = df.plan
         if isinstance(plan, Scan) and not self.lineage:
+            # STREAMING build: source files are decoded in groups of
+            # ~batchRows rows and fed straight into the pipelined device
+            # build, so host memory is bounded by O(2 chunks + largest
+            # file), never by table size — the discipline that lets a
+            # TPC-H SF100 (600M-row) build run on a bounded-RAM host. The
+            # reference gets this for free from Spark's streaming executors
+            # (ref: CoveringIndex.scala:54-69 repartition+saveWithBuckets);
+            # here the build owns its own out-of-core chunking.
             relation = plan.relation
             resolved = self._resolve_all(ctx, relation.schema)
             columns = [r.normalized_name for r in resolved]
-            ds = relation.arrow_dataset()
-            key_table = ds.to_table(columns=_nested_projection([r for r in resolved if r.normalized_name in self._indexed]))
+            key_res = [r for r in resolved if r.normalized_name in self._indexed]
             payload = [r for r in resolved if r.normalized_name not in self._indexed]
+            batch_rows = ctx.session.conf.build_batch_rows
+            files = [fi.name for fi in relation.all_file_infos()]
+            # per-file reads lose the unified-dataset schema the one-shot
+            # path had (Arrow casts/null-fills fragments against it); conform
+            # every per-file projection to the resolved schema so sources
+            # with per-file schema drift still build one consistent index
+            key_schema = pa.schema([_arrow_field_for(r, relation.schema) for r in key_res])
+            payload_schema = pa.schema(
+                [_arrow_field_for(r, relation.schema) for r in payload]
+            )
 
-            def payload_fn() -> Optional[pa.Table]:
-                return ds.to_table(columns=_nested_projection(payload)) if payload else None
+            def groups():
+                # each file's dataset is constructed ONCE and serves both the
+                # key and payload projections: for materialized formats
+                # (avro/text) construction IS the decode, so reusing it keeps
+                # the build at one decode per file (the group holds its
+                # files' tables until the chunk is written — bounded by
+                # group size, same O(chunk) discipline)
+                pending_ds: List = []
+                pending_keys: List[pa.Table] = []
+                rows = 0
 
-            write_bucketed(
-                key_table,
+                def emit():
+                    kt = (
+                        pa.concat_tables(pending_keys)
+                        if len(pending_keys) > 1
+                        else pending_keys[0]
+                    )
+                    grp_ds = list(pending_ds)
+
+                    def group_payload_fn() -> Optional[pa.Table]:
+                        if not payload:
+                            return None
+                        parts = [
+                            _project_conform(d, payload, payload_schema) for d in grp_ds
+                        ]
+                        return pa.concat_tables(parts) if len(parts) > 1 else parts[0]
+
+                    return kt, group_payload_fn
+
+                for f in files:
+                    ds_f = relation.arrow_dataset([f])
+                    kt = _project_conform(ds_f, key_res, key_schema)
+                    # emit BEFORE a file that would cross batchRows: groups
+                    # stay under the cap (only a single file larger than
+                    # batchRows exceeds it, and that group slices evenly),
+                    # so no group leaves a sliver chunk paying a full
+                    # device launch for a handful of rows
+                    if batch_rows and pending_ds and rows + kt.num_rows > batch_rows:
+                        yield emit()
+                        pending_ds, pending_keys, rows = [], [], 0
+                    pending_ds.append(ds_f)
+                    pending_keys.append(kt)
+                    rows += kt.num_rows
+                    if batch_rows and rows >= batch_rows:
+                        yield emit()
+                        pending_ds, pending_keys, rows = [], [], 0
+                if pending_ds:
+                    yield emit()
+
+            # the distributed-vs-single-device decision needs TOTAL rows
+            # (conf distributedMinRows), which streaming never sees at once;
+            # parquet footers give it for free, other formats fall back to
+            # sizing by the first chunk
+            total_rows = None
+            if relation.physical_format == "parquet":
+                try:
+                    total_rows = sum(pq.read_metadata(f).num_rows for f in files)
+                except Exception:
+                    total_rows = None
+
+            write_bucketed_groups(
+                groups(),
                 self._indexed,
                 self.num_buckets,
                 ctx.index_data_path,
-                payload_fn=payload_fn,
                 column_order=columns,
-                batch_rows=ctx.session.conf.build_batch_rows,
+                batch_rows=batch_rows,
                 session=ctx.session,
+                total_rows=total_rows,
             )
-            schema = pa.schema([_arrow_field_for(r, ds.schema) for r in resolved])
+            schema = pa.schema([_arrow_field_for(r, relation.schema) for r in resolved])
             self.schema_json = schema_codec.schema_to_json(schema)
             return
 
@@ -235,6 +309,40 @@ class CoveringIndex(Index):
         return pa.concat_tables(tables)
 
 
+def _project_conform(ds, resolved, schema: pa.Schema) -> pa.Table:
+    """Project ``resolved`` columns out of one file's dataset and conform the
+    result to the unified ``schema`` (cast drifted dtypes; null-fill columns
+    the file predates). The one-shot build's single dataset did this
+    implicitly via Arrow's unified dataset schema; per-file streaming reads
+    must do it explicitly or schema-evolved sources crash mid-build."""
+    try:
+        t = ds.to_table(columns=_nested_projection(resolved))
+    except (KeyError, pa.ArrowInvalid, pa.ArrowKeyError):
+        # a projected column is missing from this file (schema evolution):
+        # decode what the file has, extract what resolves (nested leaves via
+        # struct_field — the normalized __hs_nested. name never matches a
+        # physical column), and null-fill only what's genuinely absent
+        import pyarrow.compute as pc
+
+        full = ds.to_table()
+        arrays = []
+        for r, f in zip(resolved, schema):
+            parts = r.name.split(".")
+            arr = full.column(parts[0]) if parts[0] in full.column_names else None
+            for seg in parts[1:]:
+                if arr is None:
+                    break
+                try:
+                    arr = pc.struct_field(arr, seg)
+                except (KeyError, pa.ArrowInvalid, pa.ArrowKeyError, TypeError):
+                    arr = None
+            arrays.append(arr if arr is not None else pa.nulls(full.num_rows, f.type))
+        return pa.table(dict(zip(schema.names, arrays))).cast(schema)
+    if t.schema != schema:
+        t = t.cast(schema)
+    return t
+
+
 def _nested_projection(resolved) -> Dict[str, Any]:
     """Arrow dataset projection dict: normalized output name -> field ref
     (nested paths project the struct leaf into a flat column)."""
@@ -265,6 +373,8 @@ def write_bucketed(
     column_order: Optional[List[str]] = None,
     batch_rows: Optional[int] = None,
     session=None,
+    _chunks=None,
+    _total_rows: Optional[int] = None,
 ) -> List[str]:
     """Device-accelerated bucketed + sorted Parquet write.
 
@@ -319,7 +429,12 @@ def write_bucketed(
     capacity_factor = 2.0
     if session is not None:
         m = session.mesh
-        if m.devices.size > 1 and n >= session.conf.distributed_build_min_rows:
+        # streaming callers pass the true total (``table`` is only the first
+        # chunk there); distributedMinRows gates on the BUILD size, not the
+        # chunk size
+        if m.devices.size > 1 and (
+            _total_rows if _total_rows is not None else n
+        ) >= session.conf.distributed_build_min_rows:
             mesh = m
             capacity_factor = session.conf.rebucket_capacity_factor
 
@@ -544,6 +659,12 @@ def write_bucketed(
 
     launch, finish = (_launch_mesh, _finish_mesh) if mesh is not None else (_launch, _finish)
 
+    if _chunks is not None:
+        # write_bucketed_groups' streaming entry: the chunk iterator replaces
+        # the single-table slicing entirely (``table`` only sized the mesh
+        # decision above)
+        return _pipelined_chunks(_chunks, launch, finish)
+
     if batch_rows is not None and batch_rows > 0 and n > batch_rows:
         # chunked build, software-pipelined one chunk deep: chunk k+1's
         # device program (and its d2h transfers) runs while chunk k's host
@@ -552,35 +673,112 @@ def write_bucketed(
         # produces (UpdateMode.Merge); the join path re-sorts lazily and
         # optimize compacts. Peak device footprint is two chunks
         # (~2x batchRows rows); payload decodes lazily per chunk slice.
-        payload_cell: List[Optional[pa.Table]] = []
-
-        def full_payload() -> Optional[pa.Table]:
-            if not payload_cell:
-                payload_cell.append(payload_fn() if payload_fn is not None else None)
-            return payload_cell[0]
-
-        def payload_for(off: int):
-            if payload_fn is None:
-                return None
-
-            def chunk_payload_fn():
-                p = full_payload()
-                return p.slice(off, batch_rows) if p is not None else None
-
-            return chunk_payload_fn
-
-        paths: List[str] = []
-        in_flight: Optional[tuple] = None
-        for off in range(0, n, batch_rows):
-            state = launch(table.slice(off, batch_rows))
-            if in_flight is not None:
-                paths.extend(finish(*in_flight))
-            in_flight = (state, payload_for(off))
-        if in_flight is not None:
-            paths.extend(finish(*in_flight))
-        return paths
+        return _pipelined_chunks(
+            _sliced_chunks(table, payload_fn, batch_rows), launch, finish
+        )
 
     return finish(launch(table), payload_fn)
+
+
+def _sliced_chunks(table: pa.Table, payload_fn, batch_rows: int):
+    """Yield (key_chunk, chunk_payload_fn) slices of one materialized table;
+    the payload (if any) decodes ONCE lazily and is sliced per chunk. Chunks
+    are EQUAL-size (ceil division) rather than batch_rows + remainder, so no
+    sliver chunk pays a full device launch for a handful of rows."""
+    payload_cell: List[Optional[pa.Table]] = []
+
+    def full_payload() -> Optional[pa.Table]:
+        if not payload_cell:
+            payload_cell.append(payload_fn() if payload_fn is not None else None)
+        return payload_cell[0]
+
+    n = table.num_rows
+    n_chunks = max(1, -(-n // batch_rows))
+    size = -(-n // n_chunks)
+    for off in range(0, n, size):
+        chunk_pf = None
+        if payload_fn is not None:
+
+            def chunk_pf(off=off):
+                p = full_payload()
+                return p.slice(off, size) if p is not None else None
+
+        yield table.slice(off, size), chunk_pf
+
+
+def _pipelined_chunks(chunks, launch, finish) -> List[str]:
+    """Drive (key_chunk, payload_fn) pairs through the launch/finish pipeline
+    one chunk deep: chunk k+1's device program runs while chunk k's host side
+    drains and writes parquet."""
+    paths: List[str] = []
+    in_flight: Optional[tuple] = None
+    for key_chunk, chunk_payload_fn in chunks:
+        state = launch(key_chunk)
+        if in_flight is not None:
+            paths.extend(finish(*in_flight))
+        in_flight = (state, chunk_payload_fn)
+    if in_flight is not None:
+        paths.extend(finish(*in_flight))
+    return paths
+
+
+def write_bucketed_groups(
+    groups,
+    bucket_sort_columns: List[str],
+    num_buckets: int,
+    out_dir: str,
+    column_order: Optional[List[str]] = None,
+    batch_rows: Optional[int] = None,
+    session=None,
+    total_rows: Optional[int] = None,
+) -> List[str]:
+    """Out-of-core variant of :func:`write_bucketed`: ``groups`` is an
+    ITERABLE of ``(key_table, payload_fn)`` pairs (each key_table holds the
+    bucket/sort columns for one group of source rows; ``payload_fn()``
+    lazily decodes that group's remaining columns, row-aligned). Groups are
+    consumed strictly in order and sliced to ``batch_rows`` chunks, so peak
+    host memory is O(2 chunks + one group's payload) regardless of total
+    table size. Each chunk writes its own sorted run per bucket — the
+    multi-run state the reference's incremental refresh also produces
+    (ref: actions/RefreshIncrementalAction.scala:115-128); optimize
+    compacts runs.
+
+    The build path streams source FILES through this (indexes/covering.py
+    ``CoveringIndex.write``), which is what lets a TPC-H SF100 build run
+    with bounded RAM; the reference inherits the same property from Spark's
+    streaming executors (ref: CoveringIndex.scala:54-69)."""
+    os.makedirs(out_dir, exist_ok=True)
+
+    def flattened():
+        for key_table, payload_fn in groups:
+            kn = key_table.num_rows
+            if kn == 0:
+                continue
+            if batch_rows is not None and 0 < batch_rows < kn:
+                yield from _sliced_chunks(key_table, payload_fn, batch_rows)
+            else:
+                yield key_table, payload_fn
+
+    flat = flattened()
+    first = next(flat, None)
+    if first is None:
+        return []
+
+    import itertools as _it
+
+    # payload_fn/batch_rows are NOT passed: the _chunks stream already
+    # carries per-chunk payload closures and was sliced above — write_bucketed
+    # reads neither on the _chunks path (and must not re-slice)
+    return write_bucketed(
+        first[0],  # fallback sizer for the mesh decision when total_rows=None
+        bucket_sort_columns,
+        num_buckets,
+        out_dir,
+        column_order=column_order,
+        session=session,
+        _chunks=_it.chain([first], flat),
+        _total_rows=total_rows,
+    )
 
 
 class CoveringIndexConfig(IndexConfig):

@@ -397,16 +397,20 @@ class BinaryOp(Expr):
             mres = _maybe_add_months(l, r, op)
             if mres is not None:
                 return mres
-        if op == "+":
-            return l + r
-        if op == "-":
-            return l - r
-        if op == "*":
-            return l * r
-        if op == "/":
-            return l / r
-        if op == "%":
-            return l % r
+        # NULL semantics make 0/0 and NULL-operand arithmetic legitimate
+        # (the NaN result IS the SQL NULL); numpy's RuntimeWarnings for them
+        # are noise at this boundary, not a signal
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if op == "+":
+                return l + r
+            if op == "-":
+                return l - r
+            if op == "*":
+                return l * r
+            if op == "/":
+                return l / r
+            if op == "%":
+                return l % r
         raise ValueError(f"Unknown op {op!r}")
 
     def __repr__(self) -> str:
@@ -940,19 +944,28 @@ class Func(Expr):
         if f == "sign":
             return np.sign(np.asarray(vals[0], dtype=np.float64))
         if f == "sqrt":
-            return np.sqrt(np.asarray(vals[0], dtype=np.float64))
+            # sqrt(negative) / log(0) / 0^-1 produce NaN/inf under SQL NULL
+            # semantics on purpose; keep numpy's RuntimeWarnings out of user
+            # output at this evaluation boundary
+            with np.errstate(invalid="ignore"):
+                return np.sqrt(np.asarray(vals[0], dtype=np.float64))
         if f == "exp":
             return np.exp(np.asarray(vals[0], dtype=np.float64))
         if f in ("ln", "log"):
-            if f == "log" and len(vals) > 1:  # log(base, expr), Spark-style
-                return np.log(np.asarray(vals[1], dtype=np.float64)) / np.log(
-                    np.asarray(vals[0], dtype=np.float64)
-                )
-            return np.log(np.asarray(vals[0], dtype=np.float64))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                if f == "log" and len(vals) > 1:  # log(base, expr), Spark-style
+                    return np.log(np.asarray(vals[1], dtype=np.float64)) / np.log(
+                        np.asarray(vals[0], dtype=np.float64)
+                    )
+                return np.log(np.asarray(vals[0], dtype=np.float64))
         if f in ("power", "pow"):
-            return np.power(np.asarray(vals[0], dtype=np.float64), vals[1])
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return np.power(np.asarray(vals[0], dtype=np.float64), vals[1])
         if f == "mod":
-            return np.mod(vals[0], vals[1])
+            # same boundary stance as the % operator above: MOD(x, 0) is
+            # SQL NULL (NaN), not a numpy RuntimeWarning
+            with np.errstate(divide="ignore", invalid="ignore"):
+                return np.mod(vals[0], vals[1])
         raise ValueError(f"Unsupported function {self.name!r}")
 
     def __repr__(self) -> str:

@@ -8,30 +8,44 @@ restrictions, HS/index/covering/JoinIndexRule.scala:135-155) — and plans it
 onto DataFrame operations, so every index rewrite, explain, and whyNot
 surface applies to SQL queries unchanged.
 
-Supported grammar (case-insensitive keywords):
+Supported grammar (case-insensitive keywords) — the dialect covers the full
+TPC-H 22 and TPC-DS 103 texts (tests/test_tpch_oracles.py,
+tests/test_tpcds_oracles.py run them against pandas ground truth):
 
     [WITH name AS ( query ) [, name AS ( query )]*]
     SELECT [DISTINCT] <*| item [, item ...]>
-    FROM <view> [AS] [alias]
-    [ [INNER|LEFT|RIGHT|FULL] [OUTER] JOIN <view> [alias] ON a = b [AND ...] ]*
+    FROM <view | ( query )> [AS] [alias] [, <view> [alias]]*
+    [ [INNER|LEFT|RIGHT|FULL] [OUTER] JOIN <view|(query)> [alias]
+      ON <predicate, incl. non-equi residuals> ]*
     [WHERE <predicate>]
-    [GROUP BY col [, col ...]]
-    [HAVING <predicate over aggregate outputs>]
-    [ORDER BY col [ASC|DESC] [, ...]]
+    [GROUP BY expr [, ...] | ROLLUP(...) | CUBE(...) | GROUPING SETS(...)]
+    [HAVING <predicate, incl. subqueries>]
+    [ORDER BY expr [ASC|DESC] [, ...]]      -- may reference non-projected cols
     [LIMIT n]
+    query UNION [ALL] | INTERSECT | EXCEPT query   -- INTERSECT binds tighter
 
-    item      := expr [AS name]      -- full expressions, incl. aggregates
-    expr      := comparisons (=, !=, <>, <, <=, >, >=), IN (...),
-                 IN ( SELECT ... ), ( SELECT ... ) scalar subqueries,
-                 IS [NOT] NULL, BETWEEN x AND y, NOT/AND/OR,
-                 arithmetic (+ - * / %), SUM|MIN|MAX|AVG|COUNT(expr | *),
-                 literals: 123, 1.5, 'text', DATE '2024-01-31'
+    item := expr [AS name]
+    expr := comparisons (=, !=, <>, <, <=, >, >=), IN (...) / NOT IN,
+            IN ( SELECT ... ) (null-aware), EXISTS ( SELECT ... ),
+            ( SELECT ... ) scalar subqueries — correlated or not,
+            IS [NOT] NULL, [NOT] BETWEEN x AND y, [NOT] LIKE 'pat%',
+            NOT/AND/OR, arithmetic (+ - * / %), CASE WHEN ... END,
+            CAST(expr AS type), EXTRACT(field FROM expr), grouping(col),
+            SUM|MIN|MAX|AVG|COUNT([DISTINCT] expr | *), STDDEV[_SAMP],
+            window functions: agg(expr) OVER (PARTITION BY ... ORDER BY ...
+              [ROWS UNBOUNDED PRECEDING .. CURRENT ROW]),
+              RANK() / DENSE_RANK() / ROW_NUMBER() OVER (...),
+            literals: 123, 1.5, 'text', DATE '2024-01-31',
+              INTERVAL 'n' DAY|MONTH|YEAR
 
-Subqueries are uncorrelated (as are the ones the reference's rules ever see;
-golden scenario src/test/resources/expected/spark-3.1/subquery.txt) and plan
-onto the same ScalarSubquery/InSubquery IR the dataframe API builds, so index
-rewrites apply inside them (rules/apply.py recursion). ORDER BY may reference
-non-projected columns (planned below the projection, Spark-style).
+Correlated subqueries (scalar, IN, EXISTS) are decorrelated into joins /
+semi-join marks (plan/decorrelate.py) — the reference's golden scenario
+(src/test/resources/expected/spark-3.1/subquery.txt) only exercises the
+uncorrelated forms, but TPC-DS needs the general case (q1, q6, q30, q32,
+q41, q81, q92 correlated-scalar; q16, q94 null-aware NOT EXISTS). Everything
+plans onto the same ScalarSubquery/InSubquery/Join IR the dataframe API
+builds, so every index rewrite, explain, and whyNot surface applies inside
+subqueries unchanged (rules/apply.py recursion).
 """
 
 from __future__ import annotations
