@@ -1,0 +1,109 @@
+"""TPC-DS index-leverage loop: point whyNot at every non-rewriting query.
+
+The reference built whyNot precisely for this workflow
+(ref: index/plananalysis/CandidateIndexAnalyzer.scala:29-346): run the
+workload, ask "why didn't an index apply HERE", grow the index roster from
+the answers, re-run. This script automates the loop over the reference's own
+103 gold-standard texts (src/test/resources/tpcds/queries):
+
+    python benchmarks/tpcds_whynot.py [--details-dir OUT]
+
+Prints one JSON summary line (rewriting count, per-reason histogram) and
+writes a per-query whyNot report for every non-rewriter. The test suite's
+roster (tests/test_tpcds_queries.py INDEXES) is the roster under test.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+from collections import Counter
+
+import pyarrow.parquet as pq
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests"))
+
+QUERIES_DIR = "/root/reference/src/test/resources/tpcds/queries"
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--details-dir", default=None)
+    ap.add_argument("--queries", nargs="*", default=None)
+    args = ap.parse_args()
+
+    import hyperspace_tpu as hst
+    from tpcds_data import arrow_tables
+    from test_tpcds_queries import INDEXES, _all_query_names, _query_text
+
+    root = tempfile.mkdtemp(prefix="hs_tpcds_whynot_")
+    sysp = os.path.join(root, "_indexes")
+    os.makedirs(sysp)
+    sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sysp, hst.keys.NUM_BUCKETS: 4})
+    hst.set_session(sess)
+    hs = hst.Hyperspace(sess)
+    for name, table in arrow_tables().items():
+        d = os.path.join(root, name)
+        os.makedirs(d)
+        pq.write_table(table, os.path.join(d, "part-00000.parquet"))
+        sess.read_parquet(d).create_or_replace_temp_view(name)
+    for table, idx_name, indexed, included in INDEXES:
+        hs.create_index(
+            sess._temp_views[table], hst.CoveringIndexConfig(idx_name, indexed, included)
+        )
+    sess.enable_hyperspace()
+
+    from hyperspace_tpu.plan import logical as L
+
+    names = args.queries or _all_query_names()
+    rewriting, plain = [], []
+    reasons = Counter()
+    details_dir = args.details_dir
+    if details_dir:
+        os.makedirs(details_dir, exist_ok=True)
+    for qname in names:
+        try:
+            q = sess.sql(_query_text(qname))
+            scans = L.collect(
+                q.optimized_plan(), lambda p: isinstance(p, (L.IndexScan, L.FileScan))
+            )
+            index_hits = [
+                s for s in scans
+                if isinstance(s, L.IndexScan) or getattr(s, "via_index", None)
+            ]
+        except Exception as e:  # a text that fails to plan is its own reason
+            plain.append(qname)
+            reasons[f"plan-error: {type(e).__name__}"] += 1
+            continue
+        if index_hits:
+            rewriting.append(qname)
+            continue
+        plain.append(qname)
+        try:
+            report = hs.why_not(q, extended=True)
+        except Exception as e:
+            report = f"whyNot failed: {e}"
+        # histogram the dominant reason lines
+        for line in report.splitlines():
+            m = re.search(r"reason=\[?([A-Z_]+)", line)
+            if m:
+                reasons[m.group(1)] += 1
+        if details_dir:
+            with open(os.path.join(details_dir, f"{qname}.txt"), "w") as f:
+                f.write(report)
+    print(json.dumps({
+        "total": len(names),
+        "rewriting": len(rewriting),
+        "rewriting_names": rewriting,
+        "non_rewriting": plain,
+        "reason_histogram": dict(reasons.most_common()),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

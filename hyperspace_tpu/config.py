@@ -45,6 +45,13 @@ class keys:
     TPU_JOIN_DEVICE_MATERIALIZE = "hyperspace.tpu.join.deviceMaterialize"
     TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES = "hyperspace.tpu.join.deviceMaterializeMaxBytes"
     TPU_JOIN_DEVICE_SPAN_MAX_BYTES = "hyperspace.tpu.join.deviceSpanMaxBytes"
+    # Out-of-core execution (round-5): thresholds routing large operators
+    # onto the streaming paths so no operator materializes a full table
+    # (the reference inherits this from Spark's streaming executors).
+    EXEC_STREAM_JOIN_MIN_BYTES = "hyperspace.exec.stream.joinMinBytes"
+    EXEC_STREAM_AGG_MIN_BYTES = "hyperspace.exec.stream.aggMinBytes"
+    EXEC_STREAM_CHUNK_BYTES = "hyperspace.exec.stream.chunkBytes"
+    EXEC_JOIN_SPILL_MIN_ROWS = "hyperspace.exec.join.spillMinRows"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -114,6 +121,21 @@ DEFAULTS: Dict[str, Any] = {
     # window EMPTY by default — device SMJ is opt-in: co-located hosts
     # lower deviceMinRows AND raise this budget together.
     keys.TPU_JOIN_DEVICE_SPAN_MAX_BYTES: 256 * 1024 * 1024,
+    # Above this many estimated input bytes (sum of both sides' file sizes)
+    # a compatible bucketed join streams bucket-by-bucket: peak host memory
+    # becomes O(one bucket pair + output) instead of O(both sides + output).
+    keys.EXEC_STREAM_JOIN_MIN_BYTES: 1 << 30,
+    # Above this many estimated source bytes, aggregates over a scan chain
+    # execute in file chunks with partial-aggregate merge (Spark's
+    # partial/final aggregation split), bounding memory by chunk size +
+    # group cardinality.
+    keys.EXEC_STREAM_AGG_MIN_BYTES: 1 << 30,
+    # Target bytes per streamed scan chunk (file groups round up to it).
+    keys.EXEC_STREAM_CHUNK_BYTES: 256 * 1024 * 1024,
+    # Above this many rows on a generic-join side, the hash merge runs
+    # partitioned (grace-join style): both sides split by key hash and each
+    # partition merges independently, bounding the merge intermediate.
+    keys.EXEC_JOIN_SPILL_MIN_ROWS: 1 << 26,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -269,6 +291,22 @@ class HyperspaceConf:
     @property
     def join_device_span_max_bytes(self) -> int:
         return int(self.get(keys.TPU_JOIN_DEVICE_SPAN_MAX_BYTES))
+
+    @property
+    def stream_join_min_bytes(self) -> int:
+        return int(self.get(keys.EXEC_STREAM_JOIN_MIN_BYTES))
+
+    @property
+    def stream_agg_min_bytes(self) -> int:
+        return int(self.get(keys.EXEC_STREAM_AGG_MIN_BYTES))
+
+    @property
+    def stream_chunk_bytes(self) -> int:
+        return int(self.get(keys.EXEC_STREAM_CHUNK_BYTES))
+
+    @property
+    def join_spill_min_rows(self) -> int:
+        return int(self.get(keys.EXEC_JOIN_SPILL_MIN_ROWS))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

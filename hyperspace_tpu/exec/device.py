@@ -942,6 +942,242 @@ def _side_buckets(
     raise DeviceUnsupported(f"join side {type(node).__name__} is not a bucketed shape")
 
 
+def _side_bucket_readers(session, node: L.LogicalPlan, columns: List[str], sort_keys: List[str]):
+    """Lazy per-bucket readers for one join side: ``{bucket -> thunk}`` where
+    each thunk decodes (and sorts/filters) ONLY that bucket when called. The
+    streaming join walks buckets one at a time through these, so peak memory
+    is one bucket pair instead of both whole sides (``_side_buckets``
+    materializes everything — fine below the streaming threshold).
+
+    Shapes mirror ``_side_buckets``: IndexScan leaves, layout-preserving
+    Filters, Repartition of appended files (appends are small by the hybrid
+    scan ratio caps, so that child materializes once, lazily), BucketUnion.
+    """
+    node, _proj = _strip_projects(node)
+    if isinstance(node, L.IndexScan):
+        from hyperspace_tpu.indexes.covering import bucket_of_file
+        from hyperspace_tpu.exec.io import read_parquet_batch
+
+        per_bucket: Dict[int, List[str]] = {}
+        for f in node.files:
+            b = bucket_of_file(f)
+            if b is None:
+                raise DeviceUnsupported(f"index file {f!r} has no bucket id")
+            per_bucket.setdefault(b, []).append(f)
+        file_cols = [node.file_column_of(c) for c in columns]
+        rename = file_cols != list(columns)
+
+        def make(files):
+            def read() -> B.Batch:
+                batch = read_parquet_batch(files, file_cols)
+                if rename:
+                    batch = {o: batch[fc] for o, fc in zip(columns, file_cols)}
+                if sort_keys and len(files) > 1:
+                    batch = _sort_bucket(batch, sort_keys)
+                return batch
+
+            return read
+
+        return {b: make(fs) for b, fs in per_bucket.items()}
+    if isinstance(node, L.Filter):
+        from hyperspace_tpu.plan.expr import as_bool_mask, contains_input_file_name
+
+        if contains_input_file_name(node.condition):
+            raise DeviceUnsupported("input_file_name() predicate on a join side")
+        refs = [c for c in node.condition.references()]
+        inner_cols = list(dict.fromkeys(list(columns) + refs))
+        child = _side_bucket_readers(session, node.child, inner_cols, sort_keys)
+
+        def wrap(thunk):
+            def read() -> Optional[B.Batch]:
+                batch = thunk()
+                if batch is None:  # empty bucket from a Repartition/BucketUnion child
+                    return None
+                mask = as_bool_mask(node.condition.eval(batch))
+                kept = B.mask_rows(batch, mask)  # order-preserving: stays sorted
+                return {c: kept[c] for c in columns}
+
+            return read
+
+        return {b: wrap(t) for b, t in child.items()}
+    if isinstance(node, L.Repartition):
+        # appended-files side: bounded small by hybridScan.maxAppendedRatio,
+        # so materializing it once (on first bucket access) keeps the
+        # streaming walk's memory profile intact
+        cell: Dict[str, Dict[int, B.Batch]] = {}
+
+        def load() -> Dict[int, B.Batch]:
+            if "b" not in cell:
+                cell["b"] = _side_buckets(session, node, columns, sort_keys)
+            return cell["b"]
+
+        nb = node.bucket_spec.num_buckets
+
+        def make_r(b):
+            def read() -> Optional[B.Batch]:
+                return load().get(b)
+
+            return read
+
+        return {b: make_r(b) for b in range(nb)}
+    if isinstance(node, L.BucketUnion):
+        parts = [_side_bucket_readers(session, c, columns, sort_keys) for c in node.children()]
+        keys = set()
+        for p in parts:
+            keys |= set(p)
+
+        def make_u(b):
+            def read() -> Optional[B.Batch]:
+                batches = []
+                for p in parts:
+                    t = p.get(b)
+                    if t is None:
+                        continue
+                    got = t()
+                    if got is not None and B.num_rows(got):
+                        batches.append(got)
+                if not batches:
+                    return None
+                if len(batches) == 1:
+                    return batches[0]
+                return _sort_bucket(B.concat(batches), sort_keys)
+
+            return read
+
+        return {b: make_u(b) for b in keys}
+    raise DeviceUnsupported(f"join side {type(node).__name__} is not a bucketed shape")
+
+
+def _stream_join_dtype_hints(
+    plan: L.Join, lside, rside, lcols_needed, rcols_needed
+) -> Dict[str, np.dtype]:
+    """Footer-derived dtypes for the join's output columns: a bucket where
+    one side is absent still needs that side's columns typed (the whole-side
+    path reads them from other buckets; per-bucket streaming can't), and an
+    EMPTY streamed result is constructed entirely from these."""
+    import pyarrow.parquet as pq
+    from hyperspace_tpu.sources import schema as schema_codec
+
+    def side_dtypes(side, cols) -> Dict[str, np.dtype]:
+        scans = L.collect(side, lambda x: isinstance(x, L.IndexScan))
+        if not scans or not scans[0].files:
+            return {}
+        try:
+            sch = pq.read_schema(scans[0].files[0])
+        except OSError:
+            return {}
+        out: Dict[str, np.dtype] = {}
+        for c in cols:
+            fc = scans[0].file_column_of(c)
+            if fc in sch.names:
+                try:
+                    out[c] = schema_codec.arrow_to_numpy_dtype(sch.field(fc).type)
+                except Exception:
+                    pass
+        return out
+
+    lmap = side_dtypes(lside, lcols_needed)
+    rmap = side_dtypes(rside, rcols_needed)
+    hints: Dict[str, np.dtype] = {}
+    for name in plan.output_columns:
+        try:
+            is_left, col = _join_column_source(name, lcols_needed, rcols_needed)
+        except DeviceUnsupported:
+            continue
+        dt = (lmap if is_left else rmap).get(col)
+        if dt is not None:
+            hints[name] = dt
+    return hints
+
+
+def stream_bucketed_join(session, plan: L.Join, _compat=None):
+    """Yield the bucketed SMJ's output ONE BUCKET AT A TIME: per bucket, both
+    sides decode, spans compute (native merge walk / searchsorted), pairs
+    expand, and the chunk is yielded before the next bucket is touched. No
+    operator state spans buckets, so memory stays O(bucket pair + one output
+    chunk) at any scale — the out-of-core discipline Spark's streaming
+    executors give the reference for free (ref:
+    HS/index/covering/JoinIndexRule.scala:604-705, valid at any SF).
+
+    Used above conf ``hyperspace.exec.stream.joinMinBytes`` (estimated from
+    file sizes) by ``dispatch_bucketed_join``, and by
+    ``DataFrame.to_local_iterator`` for callers that drain results
+    incrementally. Chunk dtypes may differ across buckets (a nullable int
+    column is float64 only in chunks holding nulls); ``B.concat`` promotes.
+    """
+    ensure_x64()
+    from hyperspace_tpu import native
+
+    compat = _compat if _compat is not None else join_sides_compatible(plan)
+    if compat is None:
+        raise DeviceUnsupported("join sides are not compatible bucketed index scans")
+    lside, rside, lkeys, rkeys = compat
+    if plan.how not in ("inner", "left", "right", "outer"):
+        raise DeviceUnsupported(f"unsupported join type {plan.how!r}")
+    needed = set(plan.output_columns) | {
+        n[:-2] for n in plan.output_columns if n.endswith("#r")
+    }
+    lcols_needed = [c for c in lside.output_columns if c in needed or c in lkeys]
+    rcols_needed = [c for c in rside.output_columns if c in needed or c in rkeys]
+    lread = _side_bucket_readers(session, lside, lcols_needed, lkeys)
+    rread = _side_bucket_readers(session, rside, rcols_needed, rkeys)
+    nb = _side_bucket_spec(lside).num_buckets
+    keep_left = plan.how in ("left", "outer")
+    keep_right = plan.how in ("right", "outer")
+
+    hints = _stream_join_dtype_hints(plan, lside, rside, lcols_needed, rcols_needed)
+
+    for b in range(nb):
+        lt, rt = lread.get(b), rread.get(b)
+        lb = lt() if lt is not None else None
+        rb = rt() if rt is not None else None
+        if lb is not None and B.num_rows(lb) == 0:
+            lb = None
+        if rb is not None and B.num_rows(rb) == 0:
+            rb = None
+        if lb is None and rb is None:
+            continue
+        if lb is None and not keep_right:
+            continue
+        if rb is None and not keep_left:
+            continue
+        span_of = None
+        if lb is not None and rb is not None:
+            lk = rk = None
+            if len(lkeys) == 1:
+                try:
+                    lk = _join_key_of(lb, lkeys[0])
+                    rk = _join_key_of(rb, rkeys[0])
+                except DeviceUnsupported:
+                    lk = rk = None
+            if lk is None:
+                lk, rk = _composite_ranks(
+                    [lb[k] for k in lkeys], [rb[k] for k in rkeys]
+                )
+
+            def span_of(_b, lk=lk, rk=rk):
+                try:
+                    return native.merge_spans(lk, rk)
+                except native.NativeUnsupported:
+                    return (
+                        np.searchsorted(rk, lk, side="left"),
+                        np.searchsorted(rk, lk, side="right"),
+                    )
+
+        chunk = _expand_join_pairs(
+            plan,
+            {0: lb} if lb is not None else {},
+            {0: rb} if rb is not None else {},
+            1,
+            lcols_needed,
+            rcols_needed,
+            span_of,
+            dtype_fallback=hints,
+        )
+        if B.num_rows(chunk):
+            yield chunk
+
+
 @lru_cache(maxsize=32)
 def _bucketed_span_program(mesh, axis: str):
     """Jitted per-bucket match-span program, cached per mesh so repeated joins
@@ -1046,6 +1282,38 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
         )
     except OSError:
         total = 0  # unreadable footer -> stay on host
+    # out-of-core gate: above the streaming threshold (estimated from file
+    # sizes — no decode), walk buckets one at a time instead of decoding
+    # both whole sides; peak memory drops to O(bucket pair + output)
+    stream_min = session.conf.stream_join_min_bytes
+    if stream_min and stream_min > 0:
+        try:
+            input_bytes = sum(
+                os.stat(f).st_size for side in (lside, rside) for f in _side_files(side)
+            )
+        except OSError:
+            input_bytes = 0
+        if input_bytes >= stream_min:
+            chunks = list(stream_bucketed_join(session, plan, _compat=compat))
+            if not chunks:
+                # an empty streamed result must NOT fall back to the generic
+                # merge — that materializes both multi-GiB sides, the OOM
+                # this path exists to prevent; type the empty batch from the
+                # index footers instead
+                needed = set(plan.output_columns) | {
+                    n[:-2] for n in plan.output_columns if n.endswith("#r")
+                }
+                lc = [c for c in lside.output_columns if c in needed or c in lkeys]
+                rc = [c for c in rside.output_columns if c in needed or c in rkeys]
+                hints = _stream_join_dtype_hints(plan, lside, rside, lc, rc)
+                if all(n in hints for n in plan.output_columns):
+                    trace.record("join", "host-span-smj-stream")
+                    return {n: np.empty(0, dtype=hints[n]) for n in plan.output_columns}
+                raise DeviceUnsupported("streamed join produced no rows")
+            trace.record("join", "host-span-smj-stream")
+            out = B.concat(chunks)
+            del chunks
+            return out
     setup = _bucketed_join_setup(session, plan, compat)
     # the device span program's round trip is EXACTLY computable here: the
     # buckets are already decoded, and the key matrices are rectangles of
@@ -1119,6 +1387,7 @@ def _expand_join_pairs(
     lcols_needed: List[str],
     rcols_needed: List[str],
     span_of,
+    dtype_fallback=None,
 ) -> B.Batch:
     """Pair expansion (variable-size output) + column gather, shared by the
     device and host span backends. ``span_of(b)`` returns (lo, hi) arrays of
@@ -1234,7 +1503,9 @@ def _expand_join_pairs(
         # as float64 only in buckets whose files hold nulls), matching what
         # np.concatenate of per-bucket results used to do
         part = participating or sorted(src)
-        dt = _join_column_dtype(name, sources[name], lbuckets, rbuckets, part)
+        dt = _join_column_dtype(
+            name, sources[name], lbuckets, rbuckets, part, fallback=dtype_fallback
+        )
         nullable = (is_left and has_null_left) or (not is_left and has_null_right)
         if nullable and dt.kind == "b":
             return np.dtype(object)  # pandas merge: bool + NaN -> object
@@ -1439,13 +1710,20 @@ def _join_column_source(name: str, lout, rout) -> Tuple[bool, str]:
     raise DeviceUnsupported(f"join output column {name!r} not found on either side")
 
 
-def _join_column_dtype(name: str, source, lbuckets, rbuckets, participating) -> np.dtype:
+def _join_column_dtype(
+    name: str, source, lbuckets, rbuckets, participating, fallback=None
+) -> np.dtype:
     """Column dtype promoted across the participating buckets (a nullable int
-    column decodes as float64 only in buckets whose files hold nulls)."""
+    column decodes as float64 only in buckets whose files hold nulls).
+    ``fallback`` maps column name -> dtype for columns with no decoded data
+    in scope — the per-bucket streaming join types a missing side's columns
+    from the index footers (the whole-side path always has other buckets)."""
     is_left, col = source
     src = lbuckets if is_left else rbuckets
     dtypes = [src[b][col].dtype for b in participating if col in src.get(b, {})]
     if not dtypes:
+        if fallback is not None and name in fallback:
+            return fallback[name]
         raise DeviceUnsupported(f"cannot determine dtype of empty join column {name!r}")
     if any(dt == object for dt in dtypes):
         return np.dtype(object)

@@ -11,7 +11,7 @@ here the framework owns it (SURVEY.md §7 design stance).
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import pyarrow.dataset as pads
@@ -319,6 +319,122 @@ def _window_column(child: B.Batch, spec, caches=None) -> np.ndarray:
     return vals.groupby(part).transform(pd_fn).to_numpy()
 
 
+def _chain_to_scan(plan: L.LogicalPlan):
+    """(wrappers, leaf) when ``plan`` is a chain of row-wise nodes
+    (Project/Compute/Filter/Rename) over a single Scan/FileScan/IndexScan
+    leaf — the shape the streaming executor can partition by files; (None,
+    None) otherwise."""
+    chain = []
+    node = plan
+    while isinstance(node, (L.Project, L.Compute, L.Filter, L.Rename)):
+        chain.append(node)
+        node = node.child
+    if isinstance(node, (L.Scan, L.FileScan, L.IndexScan)):
+        return chain, node
+    return None, None
+
+
+def _chain_needed_columns(chain, aggs=None, keys=None):
+    """Source columns a scan chain references (roots of dotted paths
+    included), for pruning the per-chunk scan."""
+    needed = set()
+    for node in chain:
+        if isinstance(node, L.Project):
+            needed |= set(node.columns)
+        elif isinstance(node, L.Compute):
+            for _, e in node.exprs:
+                needed |= set(e.references())
+        elif isinstance(node, L.Filter):
+            needed |= set(node.condition.references())
+        elif isinstance(node, L.Rename):
+            needed |= set(node.mapping.keys())
+    if aggs:
+        needed |= {c for _, _, c in aggs if c is not None}
+    if keys:
+        needed |= set(keys)
+    needed |= {n.split(".")[0] for n in needed if "." in n}
+    return needed
+
+
+def _rebuild_chain(chain, leaf: L.LogicalPlan) -> L.LogicalPlan:
+    """Clone the row-wise wrappers over a replacement leaf (bottom-up)."""
+    node = leaf
+    for wrapper in reversed(chain):
+        node = wrapper.with_children([node])
+    return node
+
+
+def _leaf_files(leaf: L.LogicalPlan) -> List[str]:
+    if isinstance(leaf, L.Scan):
+        return [fi.name for fi in leaf.relation.all_file_infos()]
+    return list(leaf.files)
+
+
+def _leaf_subset(leaf: L.LogicalPlan, files: List[str], needed=None) -> L.LogicalPlan:
+    """A scan leaf over only ``files``; a relation-backed Scan becomes a
+    FileScan carrying the relation's format/partition metadata (and pruned
+    to ``needed`` columns — chunked decode pays per chunk, so decoding
+    unreferenced columns would multiply the waste)."""
+    import copy
+
+    if isinstance(leaf, (L.FileScan, L.IndexScan)):
+        clone = copy.copy(leaf)
+        clone.files = list(files)
+        return clone
+    rel = leaf.relation
+    cols = list(leaf.output_columns)
+    if needed is not None:
+        lowered = {n.lower() for n in needed}
+        kept = [c for c in cols if c.lower() in lowered]
+        cols = kept or cols
+    pv = pd_ = None
+    part_cols = list(getattr(rel, "partition_columns", []) or [])
+    if part_cols:
+        pv = {f: rel.partition_values_for(f) for f in files}
+        dts = getattr(rel, "partition_dtypes", None)
+        pd_ = dict(dts) if dts else None
+    return L.FileScan(
+        files,
+        rel.physical_format,
+        cols,
+        partition_values=pv,
+        partition_dtypes=pd_,
+        format_options=getattr(rel, "options", None) or None,
+    )
+
+
+def _chunk_files_by_bytes(files: List[str], target_bytes: int) -> List[List[str]]:
+    """Greedy size-bounded file groups (a single file above the target forms
+    its own group)."""
+    import os
+
+    groups: List[List[str]] = []
+    cur: List[str] = []
+    cur_bytes = 0
+    for f in files:
+        try:
+            sz = os.stat(f).st_size
+        except OSError:
+            sz = target_bytes  # unknown -> isolate conservatively
+        if cur and cur_bytes + sz > target_bytes:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(f)
+        cur_bytes += sz
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+#: aggregate functions with a decomposable partial state (Spark's
+#: partial/final split); distinct forms accumulate uniques (bounded by
+#: distinct cardinality, not row count)
+_STREAMABLE_AGGS = {
+    "count", "sum", "min", "max", "avg", "stddev_samp",
+    "count_distinct", "sum_distinct", "avg_distinct",
+}
+
+
 class Executor:
     def __init__(self, session):
         self.session = session
@@ -366,6 +482,70 @@ class Executor:
         elif INPUT_FILE_NAME in batch:
             batch = {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
         return batch
+
+    def execute_stream(self, plan: L.LogicalPlan):
+        """Yield result batches incrementally (DataFrame.to_local_iterator).
+
+        Streamed shapes: a (Project over) compatible bucketed Join yields
+        per-bucket chunks via the streaming SMJ; a row-wise chain over one
+        scan yields per-file-group chunks. Everything else yields the one
+        materialized batch — streaming is an execution strategy, never an
+        API restriction (Spark's toLocalIterator contract)."""
+        from hyperspace_tpu.plan.expr import subquery_scope
+        from hyperspace_tpu.rules.utils import prune_columns, shared_subplan_ids
+
+        try:
+            plan = prune_columns(plan)
+        except Exception:
+            trace.record("prune", "fallback-unpruned")
+        self._shared = shared_subplan_ids(plan)
+        self._memo = {}
+        try:
+            with subquery_scope():
+                if _plan_needs_file_names(plan):
+                    batch = self._exec(plan, True)
+                    yield {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
+                    return
+                node = plan
+                proj = None
+                if isinstance(node, L.Project):
+                    proj, node = list(node.columns), node.child
+                if isinstance(node, L.Join) and self.session.conf.device_execution_enabled:
+                    try:
+                        from hyperspace_tpu.exec import device as D
+                    except ImportError:
+                        D = None
+                    if D is not None and D.join_sides_compatible(node) is not None:
+                        gen = D.stream_bucketed_join(self.session, node)
+                        try:
+                            first = next(gen)
+                        except StopIteration:
+                            return
+                        except D.DeviceUnsupported:
+                            gen = None
+                        if gen is not None:
+                            trace.record("join", "host-span-smj-stream")
+                            yield B.select(first, proj) if proj else first
+                            for chunk in gen:
+                                yield B.select(chunk, proj) if proj else chunk
+                            return
+                chain, leaf = _chain_to_scan(plan)
+                if leaf is not None:
+                    files = _leaf_files(leaf)
+                    groups = _chunk_files_by_bytes(
+                        files, max(1, self.session.conf.stream_chunk_bytes)
+                    )
+                    if len(groups) > 1:
+                        needed = _chain_needed_columns(chain) | set(plan.output_columns)
+                        for g in groups:
+                            sub = _rebuild_chain(chain, _leaf_subset(leaf, g, needed))
+                            yield self._exec(sub, False)
+                        return
+                batch = self._exec(plan, False)
+                yield {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
+        finally:
+            self._memo = {}
+            self._shared = set()
 
     def _exec(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
         # hits hand out shallow copies so callers may add derived keys
@@ -633,6 +813,14 @@ class Executor:
                     return got
                 except D.DeviceUnsupported:
                     pass
+        # streaming check BEFORE the device-scan gate: _try_device_aggregate
+        # materializes the whole scan to size its decision, which is exactly
+        # what the out-of-core path exists to avoid
+        if not with_file_names:
+            got = self._try_streaming_aggregate(plan)
+            if got is not None:
+                trace.record("agg", "streamed-partial")
+                return got
         if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
             got, scan_batch, filter_node = self._try_device_aggregate(plan)
             if got is not None:
@@ -740,6 +928,228 @@ class Executor:
         for name, _, _ in plan.aggs:
             out[name] = result[name].to_numpy()
         return out
+
+    def _try_streaming_aggregate(self, plan: L.Aggregate) -> Optional[B.Batch]:
+        """Out-of-core aggregate: when the child is a scan chain over more
+        source bytes than conf ``exec.stream.aggMinBytes``, execute it in
+        file chunks and merge decomposable partial states — Spark's
+        partial/final aggregation split, which is what lets the reference
+        aggregate over tables far larger than executor memory. Returns None
+        (caller materializes) when the shape, size, or aggregate set doesn't
+        stream."""
+        conf = self.session.conf
+        min_bytes = conf.stream_agg_min_bytes
+        if not min_bytes or min_bytes <= 0:
+            return None
+        if any(fn not in _STREAMABLE_AGGS for _, fn, _ in plan.aggs):
+            return None
+        chain, leaf = _chain_to_scan(plan.child)
+        if leaf is None:
+            return None
+        files = _leaf_files(leaf)
+        if len(files) < 2:
+            return None
+        import os
+
+        try:
+            total_bytes = sum(os.stat(f).st_size for f in files)
+        except OSError:
+            return None
+        if total_bytes < min_bytes:
+            return None
+        groups = _chunk_files_by_bytes(files, max(1, conf.stream_chunk_bytes))
+        if len(groups) < 2:
+            return None
+        needed = _chain_needed_columns(chain, plan.aggs, plan.keys)
+        try:
+            return self._streaming_aggregate(plan, chain, leaf, groups, needed)
+        except Exception:
+            # the streamed path must never break a query the materialized
+            # path can answer; visible in dispatch traces
+            trace.record("agg", "stream-fallback")
+            return None
+
+    def _streaming_aggregate(self, plan, chain, leaf, groups, needed) -> B.Batch:
+        import pandas as pd
+
+        grouped = bool(plan.keys)
+        # distinct-form aggregates accumulate (group keys +) unique values;
+        # everything else carries closed-form partial states
+        plain = [(i, n, fn, c) for i, (n, fn, c) in enumerate(plan.aggs)
+                 if not fn.endswith("_distinct")]
+        distinct = [(i, n, fn, c) for i, (n, fn, c) in enumerate(plan.aggs)
+                    if fn.endswith("_distinct")]
+
+        partial_frames: List = []          # grouped plain partials
+        distinct_frames = {i: [] for i, *_ in distinct}  # per-agg pair frames
+        g_state: Dict[int, Any] = {}       # global plain partials
+
+        for group in groups:
+            sub = _rebuild_chain(chain, _leaf_subset(leaf, group, needed))
+            wfn = _plan_needs_file_names(sub)
+            batch = self._exec(sub, wfn)
+            batch = {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
+            n = B.num_rows(batch)
+
+            def series(col):
+                from hyperspace_tpu.plan.expr import get_column
+
+                got = batch.get(col)
+                if got is None:
+                    got = get_column(batch, col)
+                if got is None:
+                    raise KeyError(f"Aggregate input column {col!r} not found")
+                return got
+
+            if grouped:
+                frame_cols = {k: series(k) for k in plan.keys}
+                for _i, _n, _fn, c in plain:
+                    if c is not None and c not in frame_cols:
+                        frame_cols[c] = series(c)
+                df = pd.DataFrame(frame_cols)
+                gb = df.groupby(list(plan.keys), dropna=False, sort=False)
+                pieces = {}
+                for i, name, fn, c in plain:
+                    p = f"__p{i}"
+                    if fn == "count":
+                        pieces[p] = gb.size() if c is None else gb[c].count()
+                    elif fn == "sum":
+                        pieces[p] = gb[c].sum(min_count=1)
+                    elif fn == "min":
+                        pieces[p] = gb[c].min()
+                    elif fn == "max":
+                        pieces[p] = gb[c].max()
+                    elif fn == "avg":
+                        pieces[p + "_s"] = gb[c].sum(min_count=1)
+                        pieces[p + "_c"] = gb[c].count()
+                    elif fn == "stddev_samp":
+                        pieces[p + "_n"] = gb[c].count()
+                        pieces[p + "_s"] = gb[c].sum(min_count=1)
+                        # float64 BEFORE squaring: int64 values near 2^32
+                        # would wrap the sum-of-squares negative
+                        pieces[p + "_ss"] = gb[c].apply(
+                            lambda s: float((s.dropna().astype(np.float64) ** 2).sum())
+                        )
+                if pieces:
+                    partial_frames.append(pd.DataFrame(pieces).reset_index())
+                elif distinct:
+                    # keys-only partial so groups with only-distinct aggs
+                    # still materialize every group
+                    partial_frames.append(
+                        pd.DataFrame({k: frame_cols[k] for k in plan.keys})
+                        .drop_duplicates()
+                    )
+                for i, name, fn, c in distinct:
+                    pair = pd.DataFrame(
+                        {**{k: series(k) for k in plan.keys}, "__v": series(c)}
+                    ).drop_duplicates()
+                    distinct_frames[i].append(pair)
+            else:
+                for i, name, fn, c in plain:
+                    s = pd.Series(series(c)) if c is not None else None
+                    st = g_state.get(i)
+                    if fn == "count":
+                        v = n if c is None else int(s.count())
+                        g_state[i] = (st or 0) + v
+                    elif fn in ("sum", "min", "max"):
+                        part = getattr(s, {"sum": "sum", "min": "min", "max": "max"}[fn])(
+                            **({"min_count": 1} if fn == "sum" else {})
+                        )
+                        g_state.setdefault(i, []).append(part)
+                    elif fn == "avg":
+                        sc = g_state.setdefault(i, [0.0, 0])
+                        cnt = int(s.count())
+                        if cnt:
+                            sc[0] += float(s.sum())
+                            sc[1] += cnt
+                    elif fn == "stddev_samp":
+                        sc = g_state.setdefault(i, [0, 0.0, 0.0])
+                        d = s.dropna().astype(np.float64)
+                        sc[0] += int(d.shape[0])
+                        sc[1] += float(d.sum())
+                        sc[2] += float((d**2).sum())
+                for i, name, fn, c in distinct:
+                    u = pd.Series(series(c)).dropna().drop_duplicates()
+                    distinct_frames[i].append(u.to_frame("__v"))
+
+        if grouped:
+            merged = pd.concat(partial_frames, ignore_index=True)
+            gb = merged.groupby(list(plan.keys), dropna=False, sort=False)
+            final = {}
+            for i, name, fn, c in plain:
+                p = f"__p{i}"
+                if fn == "count":
+                    final[name] = gb[p].sum().astype(np.int64)
+                elif fn == "sum":
+                    final[name] = gb[p].sum(min_count=1)
+                elif fn == "min":
+                    final[name] = gb[p].min()
+                elif fn == "max":
+                    final[name] = gb[p].max()
+                elif fn == "avg":
+                    s_, c_ = gb[p + "_s"].sum(min_count=1), gb[p + "_c"].sum()
+                    final[name] = s_ / c_.where(c_ > 0)
+                elif fn == "stddev_samp":
+                    n_ = gb[p + "_n"].sum()
+                    s_ = gb[p + "_s"].sum(min_count=1)
+                    ss_ = gb[p + "_ss"].sum()
+                    var = (ss_ - (s_**2) / n_.where(n_ > 0)) / (n_ - 1).where(n_ > 1)
+                    final[name] = np.sqrt(var.clip(lower=0))
+            result = pd.DataFrame(final).reset_index() if final else (
+                merged[list(plan.keys)].drop_duplicates().reset_index(drop=True)
+            )
+            for i, name, fn, c in distinct:
+                pairs = pd.concat(distinct_frames[i], ignore_index=True).drop_duplicates()
+                pairs = pairs[pairs["__v"].notna()]
+                pgb = pairs.groupby(list(plan.keys), dropna=False, sort=False)["__v"]
+                if fn == "count_distinct":
+                    dser = pgb.nunique(dropna=True)
+                elif fn == "sum_distinct":
+                    dser = pgb.sum(min_count=1)
+                else:  # avg_distinct
+                    dser = pgb.mean()
+                dser.name = name
+                result = result.merge(dser.reset_index(), on=list(plan.keys), how="left")
+                if fn == "count_distinct":
+                    result[name] = result[name].fillna(0).astype(np.int64)
+            out: B.Batch = {}
+            for k in plan.keys:
+                out[k] = result[k].to_numpy()
+            for name, _, _ in plan.aggs:
+                out[name] = result[name].to_numpy()
+            return out
+
+        out = {}
+        for i, name, fn, c in plain:
+            st = g_state.get(i)
+            if fn == "count":
+                out[name] = np.asarray([st or 0])
+            elif fn in ("sum", "min", "max"):
+                s = pd.Series(st or [])
+                v = getattr(s, {"sum": "sum", "min": "min", "max": "max"}[fn])(
+                    **({"min_count": 1} if fn == "sum" else {})
+                )
+                out[name] = np.asarray([v])
+            elif fn == "avg":
+                s_, c_ = st or (0.0, 0)
+                out[name] = np.asarray([s_ / c_ if c_ else np.nan])
+            elif fn == "stddev_samp":
+                n_, s_, ss_ = st or (0, 0.0, 0.0)
+                if n_ > 1:
+                    var = max(0.0, (ss_ - s_ * s_ / n_) / (n_ - 1))
+                    out[name] = np.asarray([np.sqrt(var)])
+                else:
+                    out[name] = np.asarray([np.nan])
+        for i, name, fn, c in distinct:
+            u = pd.concat(distinct_frames[i], ignore_index=True)["__v"].drop_duplicates()
+            u = u[u.notna()]
+            if fn == "count_distinct":
+                out[name] = np.asarray([int(u.shape[0])])
+            elif fn == "sum_distinct":
+                out[name] = np.asarray([u.sum(min_count=1) if len(u) else np.nan])
+            else:
+                out[name] = np.asarray([u.mean() if len(u) else np.nan])
+        return {name: out[name] for name, _, _ in plan.aggs}
 
     def _try_device_aggregate(self, plan: L.Aggregate):
         """Returns (result, scan_batch, filter_node): result=None means the
@@ -849,7 +1259,13 @@ class Executor:
             }
         )
         if plan.residual is None:
-            merged = ldf.merge(rdf, left_on=lkeys, right_on=rkeys_renamed, how=plan.how)
+            spill = self.session.conf.join_spill_min_rows
+            if spill and spill > 0 and max(len(ldf), len(rdf)) > spill:
+                merged = self._partitioned_merge(
+                    ldf, rdf, lkeys, rkeys_renamed, plan.how, spill
+                )
+            else:
+                merged = ldf.merge(rdf, left_on=lkeys, right_on=rkeys_renamed, how=plan.how)
         else:
             merged = self._residual_join(
                 plan, ldf, rdf, lkeys, rkeys_renamed, left, right_named
@@ -878,6 +1294,68 @@ class Executor:
                     if mask.any():
                         out[lk] = np.where(mask, merged[rkr].to_numpy(), lv)
         return out
+
+    @staticmethod
+    def _partitioned_merge(ldf, rdf, lkeys, rkeys, how: str, spill_rows: int):
+        """Grace-style partitioned hash merge: both slim key frames split by
+        a shared key hash and each partition merges independently, bounding
+        the merge intermediate (hash table + indexers) to ~1/P of the
+        unpartitioned spike. Correct for every join type because hash
+        partitions are disjoint by key: each row joins (or null-extends)
+        entirely within its partition — the same argument Spark's shuffled
+        hash join rests on. Equal values hash equally across the two sides'
+        dtypes (keys coerce to a common type before hashing), and NaN keys
+        hash deterministically, so pandas' NaN-matches-NaN merge semantics
+        are preserved partition-locally."""
+        import pandas as pd
+
+        from hyperspace_tpu.ops.encode import hash_input_uint32
+        from hyperspace_tpu.ops.hashing import bucket_ids_np
+
+        n_parts = max(2, -(-max(len(ldf), len(rdf)) // spill_rows))
+
+        # partitioning is only sound when equal-under-pandas keys hash
+        # equally on both sides: coerce numeric pairs to a common dtype and
+        # normalize -0.0 to +0.0 (pandas merges them equal; their IEEE bit
+        # patterns hash apart); any key pair outside that guarantee (object
+        # vs numeric, mismatched datetime units) falls back to the single
+        # merge rather than silently dropping matches
+        def keyed(df, keys, other_df, other_keys):
+            planes = []
+            for k, ok in zip(keys, other_keys):
+                a = df[k].to_numpy()
+                b = other_df[ok].to_numpy()
+                if a.dtype != b.dtype:
+                    if a.dtype.kind in "iuf" and b.dtype.kind in "iuf":
+                        a = a.astype(np.result_type(a.dtype, b.dtype), copy=False)
+                    else:
+                        return None
+                if a.dtype.kind == "f":
+                    a = a + 0.0  # -0.0 -> +0.0; NaN unchanged
+                planes.append(hash_input_uint32(a))
+            return bucket_ids_np(planes, n_parts)
+
+        lids = keyed(ldf, lkeys, rdf, rkeys)
+        rids = keyed(rdf, rkeys, ldf, lkeys)
+        if lids is None or rids is None:
+            return ldf.merge(rdf, left_on=lkeys, right_on=rkeys, how=how)
+        trace.record("join", f"generic-merge-partitioned({n_parts})")
+        parts = []
+        for p in range(n_parts):
+            lp = ldf[lids == p]
+            rp = rdf[rids == p]
+            if len(lp) == 0 and len(rp) == 0:
+                continue
+            if how == "inner" and (len(lp) == 0 or len(rp) == 0):
+                continue
+            if how == "left" and len(lp) == 0:
+                continue
+            if how == "right" and len(rp) == 0:
+                continue
+            parts.append(lp.merge(rp, left_on=lkeys, right_on=rkeys, how=how))
+        if not parts:
+            return ldf.iloc[:0].merge(rdf.iloc[:0], left_on=lkeys, right_on=rkeys, how=how)
+        return pd.concat(parts, ignore_index=True, sort=False)
 
     @staticmethod
     def _residual_join(plan: L.Join, ldf, rdf, lkeys, rkeys, left, right_named):

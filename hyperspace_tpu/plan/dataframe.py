@@ -151,6 +151,25 @@ class DataFrame:
         plan = self.optimized_plan()
         return Executor(self.session).execute(plan, required_columns=plan.output_columns)
 
+    def to_local_iterator(self):
+        """Yield the result as a stream of column batches (dict of numpy
+        arrays) without materializing the whole result — Spark's
+        ``Dataset.toLocalIterator`` role. Plans whose root is a compatible
+        bucketed join stream bucket-by-bucket; scan chains stream
+        file-group-by-file-group; anything else yields one batch. Chunk
+        dtypes may vary (a nullable int column is float64 only in chunks
+        holding nulls)."""
+        from hyperspace_tpu.exec.executor import Executor
+
+        plan = self.optimized_plan()
+        cols = plan.output_columns
+        for chunk in Executor(self.session).execute_stream(plan):
+            from hyperspace_tpu.exec import batch as B
+
+            yield B.select(chunk, cols)
+
+    toLocalIterator = to_local_iterator  # reference-API casing
+
     def to_arrow(self):
         from hyperspace_tpu.exec.batch import batch_to_table
 

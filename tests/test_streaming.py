@@ -180,3 +180,255 @@ class TestStreamingBuild:
         sess.disable_hyperspace()
         want = np.sort(q.collect()["v"])
         np.testing.assert_allclose(got, want)
+
+
+def _join_fixture(tmp_path, how_many_left=4000, seed=11, skew_side=False):
+    """Two parquet dirs with overlapping int keys; the right side's keys are
+    restricted to a sub-range so some buckets are one-sided (exercising the
+    streaming join's dtype hints on absent-side buckets)."""
+    rng = np.random.default_rng(seed)
+    ld = str(tmp_path / "left")
+    rd = str(tmp_path / "right")
+    os.makedirs(ld), os.makedirs(rd)
+    for i in range(4):
+        t = pa.table(
+            {
+                "lk": rng.integers(0, 400, how_many_left // 4).astype(np.int64),
+                "lv": np.round(rng.uniform(0, 10, how_many_left // 4), 3),
+                "ls": np.array([f"L{j % 13}" for j in range(how_many_left // 4)]),
+            }
+        )
+        pq.write_table(t, os.path.join(ld, f"part-{i:05d}.parquet"))
+    for i in range(2):
+        hi = 60 if skew_side else 400  # narrow key range -> one-sided buckets
+        t = pa.table(
+            {
+                "rk": rng.integers(0, hi, 900).astype(np.int64),
+                "rv": np.round(rng.uniform(0, 5, 900), 3),
+            }
+        )
+        pq.write_table(t, os.path.join(rd, f"part-{i:05d}.parquet"))
+    return ld, rd
+
+
+def _sorted_rows(batch):
+    cols = sorted(batch)
+    return sorted(
+        zip(*[["\0N" if v != v else v for v in batch[c].tolist()] for c in cols])
+    ), cols
+
+
+class TestStreamingJoin:
+    @pytest.mark.parametrize("how", ["inner", "left", "outer"])
+    def test_streamed_equals_materialized(self, tmp_path, how):
+        ld, rd = _join_fixture(tmp_path, skew_side=(how != "inner"))
+        sess = _mk_session(tmp_path)
+        hs = hst.Hyperspace(sess)
+        left = sess.read_parquet(ld)
+        right = sess.read_parquet(rd)
+        hs.create_index(left, hst.CoveringIndexConfig("l_idx", ["lk"], ["lv", "ls"]))
+        hs.create_index(right, hst.CoveringIndexConfig("r_idx", ["rk"], ["rv"]))
+        sess.enable_hyperspace()
+        q = left.join(right, on=hst.col("lk") == hst.col("rk"), how=how).select(
+            "lk", "lv", "ls", "rv"
+        )
+        want = q.collect()
+        from hyperspace_tpu.exec import trace
+
+        sess.conf.set(hst.keys.EXEC_STREAM_JOIN_MIN_BYTES, 1)
+        with trace.recording() as rec:
+            got = q.collect()
+        assert any("stream" in v for _, v in rec), rec
+        grows, gcols = _sorted_rows(got)
+        wrows, wcols = _sorted_rows(want)
+        assert gcols == wcols
+        assert grows == wrows
+
+    def test_streamed_join_bounded_reads(self, tmp_path):
+        """Memory-bound proxy: while streaming, no single parquet read spans
+        more than one bucket's files of one side."""
+        ld, rd = _join_fixture(tmp_path)
+        sess = _mk_session(tmp_path)
+        hs = hst.Hyperspace(sess)
+        left = sess.read_parquet(ld)
+        right = sess.read_parquet(rd)
+        hs.create_index(left, hst.CoveringIndexConfig("lb_idx", ["lk"], ["lv"]))
+        hs.create_index(right, hst.CoveringIndexConfig("rb_idx", ["rk"], ["rv"]))
+        sess.enable_hyperspace()
+        sess.conf.set(hst.keys.EXEC_STREAM_JOIN_MIN_BYTES, 1)
+        q = left.join(right, on=hst.col("lk") == hst.col("rk")).select("lv", "rv")
+
+        import hyperspace_tpu.exec.io as io_mod
+        from hyperspace_tpu.indexes.covering import bucket_of_file
+
+        spans = []
+        orig = io_mod.read_parquet_batch
+
+        def spy(files, columns=None):
+            spans.append({bucket_of_file(f) for f in files})
+            return orig(files, columns)
+
+        io_mod.read_parquet_batch = spy
+        try:
+            q.collect()
+        finally:
+            io_mod.read_parquet_batch = orig
+        multi = [s for s in spans if len(s - {None}) > 1]
+        assert not multi, f"a read spanned several buckets: {multi}"
+
+
+class TestStreamingAggregate:
+    def _fixture(self, tmp_path, with_nulls=True):
+        d = str(tmp_path / "agg")
+        os.makedirs(d, exist_ok=True)
+        rng = np.random.default_rng(3)
+        for i in range(6):
+            v = rng.uniform(0, 100, 800)
+            if with_nulls:
+                v[rng.integers(0, 800, 60)] = np.nan
+            t = pa.table(
+                {
+                    "g": np.array([f"grp_{x}" for x in rng.integers(0, 7, 800)]),
+                    "k": rng.integers(0, 50, 800).astype(np.int64),
+                    "v": v,
+                }
+            )
+            pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+        sess = _mk_session(
+            tmp_path,
+            **{
+                hst.keys.EXEC_STREAM_AGG_MIN_BYTES: 1,
+                hst.keys.EXEC_STREAM_CHUNK_BYTES: 1,  # every file its own chunk
+            },
+        )
+        return sess, sess.read_parquet(d)
+
+    def _ab(self, sess, q):
+        from hyperspace_tpu.exec import trace
+
+        with trace.recording() as rec:
+            got = q.collect()
+        assert ("agg", "streamed-partial") in rec, trace.summarize(rec)
+        sess.conf.set(hst.keys.EXEC_STREAM_AGG_MIN_BYTES, 1 << 60)
+        want = q.collect()
+        sess.conf.set(hst.keys.EXEC_STREAM_AGG_MIN_BYTES, 1)
+        return got, want
+
+    def test_global_aggregates(self, tmp_path):
+        sess, df = self._fixture(tmp_path)
+        q = df.agg(
+            n=("*", "count"),
+            s=("v", "sum"),
+            mn=("v", "min"),
+            mx=("v", "max"),
+            a=("v", "avg"),
+            cd=("k", "count_distinct"),
+            sd=("v", "stddev_samp"),
+        )
+        got, want = self._ab(sess, q)
+        for c in got:
+            np.testing.assert_allclose(
+                np.asarray(got[c], dtype=np.float64),
+                np.asarray(want[c], dtype=np.float64),
+                rtol=1e-9,
+            )
+
+    def test_grouped_aggregates(self, tmp_path):
+        sess, df = self._fixture(tmp_path)
+        q = df.group_by("g").agg(
+            n=("*", "count"),
+            s=("v", "sum"),
+            a=("v", "avg"),
+            mn=("v", "min"),
+            mx=("v", "max"),
+            cd=("k", "count_distinct"),
+        )
+        got, want = self._ab(sess, q)
+
+        def keyed(b):
+            cols = [c for c in b if c != "g"]
+            return {
+                g: tuple(round(float(b[c][i]), 6) for c in cols)
+                for i, g in enumerate(b["g"])
+            }
+
+        assert keyed(got) == keyed(want)
+
+    def test_filtered_grouped_sum_with_all_null_group(self, tmp_path):
+        d = str(tmp_path / "agg2")
+        os.makedirs(d)
+        for i in range(3):
+            t = pa.table(
+                {
+                    "g": np.array(["a", "b", "b"]),
+                    "v": np.array(
+                        [np.nan, np.nan, np.nan] if i < 2 else [np.nan, 2.0, 3.0]
+                    ),
+                }
+            )
+            pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"))
+        sess = _mk_session(
+            tmp_path,
+            **{hst.keys.EXEC_STREAM_AGG_MIN_BYTES: 1, hst.keys.EXEC_STREAM_CHUNK_BYTES: 1},
+        )
+        df = sess.read_parquet(d)
+        q = df.group_by("g").agg(s=("v", "sum"))
+        got, want = self._ab(sess, q)
+        gm = dict(zip(got["g"], got["s"]))
+        wm = dict(zip(want["g"], want["s"]))
+        assert set(gm) == set(wm)
+        for g in gm:  # all-NULL groups must stay NULL (SQL), not 0
+            assert (gm[g] != gm[g]) == (wm[g] != wm[g])
+            if gm[g] == gm[g]:
+                assert round(float(gm[g]), 9) == round(float(wm[g]), 9)
+
+
+class TestLocalIterator:
+    def test_scan_chain_streams_chunks(self, tmp_path):
+        data = _write_files(str(tmp_path / "data"))
+        sess = _mk_session(tmp_path, **{hst.keys.EXEC_STREAM_CHUNK_BYTES: 1})
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 100).select("k", "v")
+        chunks = list(q.to_local_iterator())
+        assert len(chunks) > 1  # one per file group
+        got = np.sort(np.concatenate([c["v"] for c in chunks]))
+        want = np.sort(q.collect()["v"])
+        np.testing.assert_allclose(got, want)
+
+    def test_bucketed_join_streams_per_bucket(self, tmp_path):
+        ld, rd = _join_fixture(tmp_path)
+        sess = _mk_session(tmp_path)
+        hs = hst.Hyperspace(sess)
+        left = sess.read_parquet(ld)
+        right = sess.read_parquet(rd)
+        hs.create_index(left, hst.CoveringIndexConfig("li_idx", ["lk"], ["lv"]))
+        hs.create_index(right, hst.CoveringIndexConfig("ri_idx", ["rk"], ["rv"]))
+        sess.enable_hyperspace()
+        q = left.join(right, on=hst.col("lk") == hst.col("rk")).select("lv", "rv")
+        chunks = list(q.to_local_iterator())
+        assert len(chunks) > 1  # per participating bucket
+        got = np.sort(np.concatenate([c["rv"] for c in chunks]))
+        want = np.sort(q.collect()["rv"])
+        np.testing.assert_allclose(got, want)
+
+
+class TestPartitionedGenericJoin:
+    @pytest.mark.parametrize("how", ["inner", "left", "outer"])
+    def test_matches_unpartitioned(self, tmp_path, how):
+        ld, rd = _join_fixture(tmp_path, skew_side=(how == "outer"))
+        sess = _mk_session(tmp_path)  # no indexes -> generic merge path
+        left = sess.read_parquet(ld)
+        right = sess.read_parquet(rd)
+        q = left.join(right, on=hst.col("lk") == hst.col("rk"), how=how).select(
+            "lk", "lv", "rv"
+        )
+        want = q.collect()
+        from hyperspace_tpu.exec import trace
+
+        sess.conf.set(hst.keys.EXEC_JOIN_SPILL_MIN_ROWS, 500)
+        with trace.recording() as rec:
+            got = q.collect()
+        assert any("partitioned" in v for _, v in rec), trace.summarize(rec)
+        grows, _ = _sorted_rows(got)
+        wrows, _ = _sorted_rows(want)
+        assert grows == wrows
