@@ -111,6 +111,69 @@ INDEXES = [
 ]
 
 
+def _wide(table, keyed):
+    """Every non-key column as an included column — the covering-index
+    shape the reference's own suites build for star joins (an index must
+    cover every column its side contributes, JoinIndexRule.scala:419-448)."""
+    return [c for c in TPCDS_SCHEMAS[table] if c not in keyed]
+
+
+# Round-5 leverage expansion, driven by the whyNot sweep over the 103 texts
+# (benchmarks/tpcds_whynot.py — the CandidateIndexAnalyzer.scala:29-346
+# workflow): every fact-table FK used as a join key gets a bucketed slice,
+# the returns tables join their sales counterparts on composite
+# (item, ticket/order) keys, and every dimension is covered on its
+# surrogate key.
+_KEYED = [
+    # store_sales FK slices + the returns composite
+    ("store_sales", "ss_item_ticket", ["ss_item_sk", "ss_ticket_number"]),
+    ("store_sales", "ss_cdemo", ["ss_cdemo_sk"]),
+    ("store_sales", "ss_hdemo", ["ss_hdemo_sk"]),
+    ("store_sales", "ss_addr", ["ss_addr_sk"]),
+    ("store_sales", "ss_store", ["ss_store_sk"]),
+    ("store_sales", "ss_promo", ["ss_promo_sk"]),
+    # catalog_sales
+    ("catalog_sales", "cs_item", ["cs_item_sk"]),
+    ("catalog_sales", "cs_customer", ["cs_bill_customer_sk"]),
+    ("catalog_sales", "cs_item_order", ["cs_item_sk", "cs_order_number"]),
+    # web_sales
+    ("web_sales", "ws_item", ["ws_item_sk"]),
+    ("web_sales", "ws_customer", ["ws_bill_customer_sk"]),
+    ("web_sales", "ws_item_order", ["ws_item_sk", "ws_order_number"]),
+    ("web_sales", "ws_order", ["ws_order_number"]),
+    # returns tables
+    ("store_returns", "sr_date", ["sr_returned_date_sk"]),
+    ("store_returns", "sr_item_ticket", ["sr_item_sk", "sr_ticket_number"]),
+    ("store_returns", "sr_item", ["sr_item_sk"]),
+    ("store_returns", "sr_customer", ["sr_customer_sk"]),
+    ("catalog_returns", "cr_date", ["cr_returned_date_sk"]),
+    ("catalog_returns", "cr_item_order", ["cr_item_sk", "cr_order_number"]),
+    ("catalog_returns", "cr_item", ["cr_item_sk"]),
+    ("web_returns", "wr_date", ["wr_returned_date_sk"]),
+    ("web_returns", "wr_item_order", ["wr_item_sk", "wr_order_number"]),
+    ("web_returns", "wr_order", ["wr_order_number"]),
+    # inventory
+    ("inventory", "inv_date", ["inv_date_sk"]),
+    ("inventory", "inv_item", ["inv_item_sk"]),
+    # dimensions on their surrogate keys
+    ("customer_address", "ca_sk", ["ca_address_sk"]),
+    ("customer_demographics", "cd_sk", ["cd_demo_sk"]),
+    ("household_demographics", "hd_sk", ["hd_demo_sk"]),
+    ("store", "s_sk", ["s_store_sk"]),
+    ("promotion", "p_sk", ["p_promo_sk"]),
+    ("warehouse", "w_sk", ["w_warehouse_sk"]),
+    ("time_dim", "t_sk", ["t_time_sk"]),
+    ("ship_mode", "sm_sk", ["sm_ship_mode_sk"]),
+    ("reason", "r_sk", ["r_reason_sk"]),
+    ("income_band", "ib_sk", ["ib_income_band_sk"]),
+    ("web_site", "web_sk", ["web_site_sk"]),
+    ("web_page", "wp_sk", ["wp_web_page_sk"]),
+    ("call_center", "cc_sk", ["cc_call_center_sk"]),
+    ("catalog_page", "cp_sk", ["cp_catalog_page_sk"]),
+]
+INDEXES = INDEXES + [(t, n, k, _wide(t, k)) for t, n, k in _KEYED]
+
+
 # Queries whose predicate conjunctions the small shaped fixture cannot
 # populate (multi-channel revenue-band/self-intersection shapes); tracked so
 # they can only shrink. Everything else MUST return rows — an empty result
