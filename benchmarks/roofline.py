@@ -69,14 +69,14 @@ def main() -> None:
     rng = np.random.default_rng(1)
     np2 = padded_size(n)
     keys = [jax.device_put(np.pad(rng.integers(0, 10**9, n), (0, np2 - n)))]
-    hashes = [jax.device_put(np.pad(
-        rng.integers(0, 2**32, n, dtype=np.uint64).astype(np.uint32), (0, np2 - n)))]
-    perm, counts = bucket_sort_build(keys, hashes, ("i",), 64, n)  # compile
+    # int builds reconstruct their hash plane ON device (_device_hash32) —
+    # host_hashes is only consumed for string columns
+    perm, counts = bucket_sort_build(keys, (), ("i",), 64, n)  # compile
     perm.block_until_ready()
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
-        perm, counts = bucket_sort_build(keys, hashes, ("i",), 64, n)
+        perm, counts = bucket_sort_build(keys, (), ("i",), 64, n)
         perm.block_until_ready()
         times.append(time.perf_counter() - t0)
     out["device_sort_rows_per_s"] = round(n / min(times), 1)

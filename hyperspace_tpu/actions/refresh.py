@@ -208,6 +208,14 @@ class RefreshIncrementalAction(_RefreshActionBase):
                 else kept
             )
             write_bucketed(combined, index.indexed_columns, index.num_buckets, ctx.index_data_path, batch_rows=ctx.session.conf.build_batch_rows, session=ctx.session)
+            # Overwrite mode re-buckets EVERY row with the current hash:
+            # stamp the index consistent (covering.BUCKET_HASH_VERSION)
+            from hyperspace_tpu.indexes.covering import (
+                _BUCKET_HASH_VERSION_PROP,
+                BUCKET_HASH_VERSION,
+            )
+
+            index._extra[_BUCKET_HASH_VERSION_PROP] = str(BUCKET_HASH_VERSION)
             self._overwrite = True
         else:
             # appended-only: write just the delta, merge content trees

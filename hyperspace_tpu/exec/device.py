@@ -499,6 +499,7 @@ def clear_device_cache() -> None:
     # the join rank cache short-circuits per-bucket key decodes, so it must
     # clear too or decode-count dispatch traces depend on run history
     _RANK_CACHE.clear()
+    _REBUCKET_CACHE.clear()
 
 
 def _cached_predicate_jit(skeleton: str, fn):
@@ -911,6 +912,34 @@ def _side_buckets(
         from hyperspace_tpu.ops.hashing import bucket_ids_np
 
         spec = node.bucket_spec
+        # hybrid scan re-buckets the SAME appended files on every query
+        # against the index (ref: CoveringIndexRuleUtils.scala:357-417 —
+        # on-the-fly re-bucketing is supposed to be the cheap path); cache
+        # the per-bucket result on the appended files' identity so repeat
+        # executions skip the decode + hash + sort entirely. A new append
+        # changes the file list/mtimes and naturally misses.
+        cache_key = None
+        files = []
+        for p in L.collect(node.child, lambda x: isinstance(x, (L.FileScan, L.Scan))):
+            files.extend(_side_files(p) if not isinstance(p, L.Scan)
+                         else [fi.name for fi in p.relation.all_file_infos()])
+        if files:
+            try:
+                ident = tuple(
+                    (f, os.stat(f).st_mtime_ns, os.stat(f).st_size) for f in files
+                )
+                cache_key = (
+                    "rebucket", ident, spec.num_buckets,
+                    tuple(spec.bucket_columns), tuple(columns), tuple(sort_keys),
+                    node.child.pretty(),
+                )
+            except OSError:
+                cache_key = None
+        if cache_key is not None:
+            hit = _REBUCKET_CACHE.get(cache_key)
+            if hit is not None:
+                trace.record("rebucket", "cached")
+                return {b: dict(v) for b, v in hit.items()}
         batch = Executor(session).execute(node.child, required_columns=list(columns))
         try:
             key_cols = [batch[c] for c in spec.bucket_columns]
@@ -927,6 +956,14 @@ def _side_buckets(
             if hi > lo:
                 idx = order[lo:hi]
                 out[b] = _sort_bucket({c: batch[c][idx] for c in columns}, sort_keys)
+        if cache_key is not None:
+            nbytes = sum(a.nbytes for v in out.values() for a in v.values()
+                         if hasattr(a, "nbytes"))
+            # retain COPIES of the per-bucket dicts: the caller gets `out`
+            # and may add derived keys; both hit and miss paths must hand
+            # out equivalently isolated objects
+            _REBUCKET_CACHE.put(cache_key, {b: dict(v) for b, v in out.items()}, nbytes)
+            trace.record("rebucket", "computed")
         return out
     if isinstance(node, L.BucketUnion):
         parts = [_side_buckets(session, c, columns, sort_keys) for c in node.children()]
@@ -1242,6 +1279,10 @@ def _side_files(node: L.LogicalPlan) -> List[str]:
 from hyperspace_tpu.utils.lru import BytesLRU
 
 _RANK_CACHE = BytesLRU(int(os.environ.get("HS_RANK_CACHE_BYTES", 1 << 29)))
+
+# re-bucketed hybrid-scan appends, keyed on the appended files' identity
+# (see the Repartition branch of _side_buckets)
+_REBUCKET_CACHE = BytesLRU(int(os.environ.get("HS_REBUCKET_CACHE_BYTES", 1 << 28)))
 
 
 def _rank_cache_key(lside, rside, lkeys: List[str], rkeys: List[str]):

@@ -45,6 +45,16 @@ from hyperspace_tpu.sources import schema as schema_codec
 
 _BUCKET_FILE_RE = re.compile(r"part-(\d+)-")
 
+#: Version of the bucket hash function the index's data files were
+#: partitioned with. Bumped whenever ops/hashing changes bucket placement
+#: (v2 = round-5 value-consistent int/float normalization). An index
+#: stamped with an older version still serves correct index-only scans,
+#: but the optimizer must not trust its bucket LAYOUT (no bucket pruning,
+#: no shuffle-free joins) until a full refresh/optimize re-buckets it —
+#: see rules/utils.transform_plan_to_use_index.
+BUCKET_HASH_VERSION = 2
+_BUCKET_HASH_VERSION_PROP = "bucketHashVersion"
+
 
 def bucket_of_file(path: str) -> Optional[int]:
     m = _BUCKET_FILE_RE.match(os.path.basename(path))
@@ -124,6 +134,12 @@ class CoveringIndex(Index):
         """(ref: HS/index/covering/CoveringIndex.scala:173-177)"""
         return BucketSpec(self.num_buckets, tuple(self._indexed), tuple(self._indexed))
 
+    @property
+    def bucket_hash_version(self) -> int:
+        """Hash-function version the data files were bucketed with; entries
+        predating the property default to 1 (the pre-normalization hash)."""
+        return int(self._extra.get(_BUCKET_HASH_VERSION_PROP, 1))
+
     def can_handle_deleted_files(self) -> bool:
         return self.lineage
 
@@ -143,6 +159,10 @@ class CoveringIndex(Index):
         decoded before the device program launches; the payload columns decode
         while the permutation rides back from the device."""
         from hyperspace_tpu.plan.logical import Scan
+
+        # write() re-buckets ALL data (create, full refresh, overwrite-mode
+        # incremental): the index is now consistent with the current hash
+        self._extra[_BUCKET_HASH_VERSION_PROP] = str(BUCKET_HASH_VERSION)
 
         plan = df.plan
         if isinstance(plan, Scan) and not self.lineage:

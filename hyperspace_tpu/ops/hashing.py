@@ -94,9 +94,29 @@ def string_hash32_array(values: np.ndarray) -> np.ndarray:
 
 def numeric_hash32(arr: np.ndarray) -> np.ndarray:
     """uint32 hash input for numeric/datetime columns: fold the int64 bit
-    pattern to 32 bits."""
+    pattern to 32 bits.
+
+    VALUE-consistent across integer and float representations: a float that
+    holds an integral value hashes as that int64 (3.0 hashes like 3), -0.0
+    normalizes to +0.0, and NaN hashes via the canonical NaN pattern. This
+    matters because a nullable int64 parquet column decodes as float64 —
+    without normalization the SAME key value lands in different buckets on
+    the two sides of a join (or between an int literal and the stored
+    column), silently dropping matches. Mirrored bit-exactly on device in
+    ops/sort._device_hash32."""
     if arr.dtype.kind == "f":
-        bits = arr.astype(np.float64).view(np.uint64)
+        with np.errstate(invalid="ignore"):
+            v = arr.astype(np.float64) + 0.0  # -0.0 -> +0.0
+            # < 2^63 strictly: every such integral float casts to int64
+            # exactly (float64 granularity near 2^63 is 1024). Above 2^53
+            # the FLOAT side has already rounded the value at decode, so
+            # cross-representation consistency is inherently bounded by
+            # float64 exactness — the guarantee here covers every integral
+            # value float64 can represent.
+            isint = np.isfinite(v) & (np.abs(v) < 2.0**63) & (v == np.floor(v))
+            int_bits = np.where(isint, v, 0).astype(np.int64).view(np.uint64)
+            f_norm = np.where(np.isnan(v), np.float64("nan"), v)
+            bits = np.where(isint, int_bits, f_norm.view(np.uint64))
     elif arr.dtype.kind == "M":
         bits = arr.view("int64").astype(np.uint64)
     elif arr.dtype.kind == "b":

@@ -80,11 +80,20 @@ def bucket_sort_perm(hash_inputs, sort_keys, num_buckets: int):
 
 def _device_hash32(kind: str, key):
     """Reconstruct the column's uint32 hash input from its order key —
-    bit-exact vs the host ``hashing.numeric_hash32`` on the original values."""
+    bit-exact vs the host ``hashing.numeric_hash32`` on the original values,
+    INCLUDING its int/float value normalization (an integral float hashes
+    as its int64 value; -0.0 as +0.0; NaN canonically): a nullable int64
+    column decodes as float64, and the un-normalized bit-pattern hash once
+    bucketed it apart from the int64 side of the same join."""
     v64 = key.astype(jnp.int64)
     if kind == "f":
         # invert the order-preserving transform back to the raw f64 bits
-        bits_i = jnp.where(v64 < 0, v64 ^ jnp.int64(_I64_SIGN), ~v64)
+        raw = jnp.where(v64 < 0, v64 ^ jnp.int64(_I64_SIGN), ~v64)
+        f = lax.bitcast_convert_type(raw, jnp.float64) + 0.0  # -0.0 -> +0.0
+        isint = jnp.isfinite(f) & (jnp.abs(f) < 2.0**63) & (f == jnp.floor(f))
+        int_bits = jnp.where(isint, f, 0).astype(jnp.int64)
+        f_norm = jnp.where(jnp.isnan(f), jnp.float64(jnp.nan), f)
+        bits_i = jnp.where(isint, int_bits, lax.bitcast_convert_type(f_norm, jnp.int64))
     else:  # i / u / b / M — the key IS the value (or its int64 view)
         bits_i = v64
     bits = lax.bitcast_convert_type(bits_i, jnp.uint64)

@@ -141,6 +141,14 @@ def transform_plan_to_use_index(
 
     index = CoveringIndex.from_derived_dataset(entry.derived_dataset)
     bucket_spec = index.bucket_spec()
+    # an index whose data files were bucketed under an OLDER hash function
+    # still serves correct index-only scans, but its bucket PLACEMENT can't
+    # be trusted: no bucket pruning, no shuffle-free join layout (the
+    # value-consistent-hash fix of round 5 is exactly such a version bump)
+    from hyperspace_tpu.indexes.covering import BUCKET_HASH_VERSION
+
+    trusted_layout = index.bucket_hash_version == BUCKET_HASH_VERSION
+    use_bucket_spec = use_bucket_spec and trusted_layout
     hybrid = bool(entry.get_tag(L.plan_key(scan), R.HYBRIDSCAN_REQUIRED))
     file_cols = index_file_columns(entry, required_all)
 
@@ -159,7 +167,9 @@ def transform_plan_to_use_index(
             file_columns=file_cols,
         )
     else:
-        new_scan = _hybrid_scan_plan(ctx, entry, scan, required_all, bucket_spec)
+        new_scan = _hybrid_scan_plan(
+            ctx, entry, scan, required_all, bucket_spec, trusted_layout=trusted_layout
+        )
 
     # canonical rebuild: every Filter sinks DIRECTLY above the scan (the
     # executor's device fast paths match that shape); Project and Compute
@@ -196,6 +206,7 @@ def _hybrid_scan_plan(
     scan: L.Scan,
     required: List[str],
     bucket_spec: L.BucketSpec,
+    trusted_layout: bool = True,
 ) -> L.LogicalPlan:
     """Hybrid Scan: BucketUnion(index-minus-deleted, rebucketed-appended)
     (ref: CoveringIndexRuleUtils.scala:146-288)."""
@@ -210,7 +221,7 @@ def _hybrid_scan_plan(
     index_side: L.LogicalPlan = L.IndexScan(
         entry,
         columns=index_cols,
-        bucket_spec=bucket_spec,
+        bucket_spec=bucket_spec if trusted_layout else None,
         file_columns=index_file_columns(entry, index_cols),
     )
     if deleted:
@@ -242,6 +253,13 @@ def _hybrid_scan_plan(
         appended, rel.physical_format, list(required), partition_values=pv,
         partition_dtypes=pd, format_options=getattr(rel, "options", None),
     )
+    if not trusted_layout:
+        # stale bucket-hash version: the files still hold the right ROWS
+        # (scan/filter correctness is untouched), but their bucket
+        # placement predates the current hash function, so the plan must
+        # not advertise a bucketed layout (no shuffle-free joins, no
+        # bucket pruning) — a plain Union keeps results correct
+        return L.Union([index_side, appended_scan])
     rebucketed = L.Repartition(bucket_spec, appended_scan)
     branches = [index_side, rebucketed]
     return L.BucketUnion(branches, bucket_spec)

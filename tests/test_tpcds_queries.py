@@ -170,6 +170,42 @@ _KEYED = [
     ("web_page", "wp_sk", ["wp_web_page_sk"]),
     ("call_center", "cc_sk", ["cc_call_center_sk"]),
     ("catalog_page", "cp_sk", ["cp_catalog_page_sk"]),
+    # second sweep iteration: the 26 remaining non-rewriters' actual join
+    # keys (3-col store/returns composites q17/q25/q29/q50, the
+    # sr<->cs customer+item bridge, ship/warehouse/time FKs q62/q66/q99,
+    # customer-side current_*_sk chains q84/q85, cs demographics q18/q26)
+    ("store_sales", "ss_cust_item_ticket",
+     ["ss_customer_sk", "ss_item_sk", "ss_ticket_number"]),
+    ("store_sales", "ss_time", ["ss_sold_time_sk"]),
+    ("store_returns", "sr_cust_item_ticket",
+     ["sr_customer_sk", "sr_item_sk", "sr_ticket_number"]),
+    ("store_returns", "sr_cust_item", ["sr_customer_sk", "sr_item_sk"]),
+    ("store_returns", "sr_cdemo", ["sr_cdemo_sk"]),
+    ("store_returns", "sr_reason", ["sr_reason_sk"]),
+    ("catalog_sales", "cs_cdemo", ["cs_bill_cdemo_sk"]),
+    ("catalog_sales", "cs_cust_item", ["cs_bill_customer_sk", "cs_item_sk"]),
+    ("catalog_sales", "cs_warehouse", ["cs_warehouse_sk"]),
+    ("catalog_sales", "cs_shipmode", ["cs_ship_mode_sk"]),
+    ("catalog_sales", "cs_time", ["cs_sold_time_sk"]),
+    ("catalog_sales", "cs_shipdate", ["cs_ship_date_sk"]),
+    ("catalog_sales", "cs_callcenter", ["cs_call_center_sk"]),
+    ("web_sales", "ws_warehouse", ["ws_warehouse_sk"]),
+    ("web_sales", "ws_shipmode", ["ws_ship_mode_sk"]),
+    ("web_sales", "ws_website", ["ws_web_site_sk"]),
+    ("web_sales", "ws_shipdate", ["ws_ship_date_sk"]),
+    ("web_sales", "ws_time", ["ws_sold_time_sk"]),
+    ("web_sales", "ws_shipaddr", ["ws_ship_addr_sk"]),
+    ("web_sales", "ws_webpage", ["ws_web_page_sk"]),
+    ("inventory", "inv_wh", ["inv_warehouse_sk"]),
+    ("customer", "c_addr", ["c_current_addr_sk"]),
+    ("customer", "c_cdemo", ["c_current_cdemo_sk"]),
+    ("customer", "c_hdemo", ["c_current_hdemo_sk"]),
+    ("household_demographics", "hd_ib", ["hd_income_band_sk"]),
+    # third iteration: q90 (ws ship-demographics/time/page legs) and q91
+    # (cr call-center + returning-customer legs)
+    ("web_sales", "ws_shiphdemo", ["ws_ship_hdemo_sk"]),
+    ("catalog_returns", "cr_callcenter", ["cr_call_center_sk"]),
+    ("catalog_returns", "cr_ret_customer", ["cr_returning_customer_sk"]),
 ]
 INDEXES = INDEXES + [(t, n, k, _wide(t, k)) for t, n, k in _KEYED]
 
