@@ -2081,23 +2081,30 @@ def _check_agg_input_dtypes(lside, rside, need_l, need_r) -> None:
                 raise DeviceUnsupported(f"aggregate input {c!r} type {t} -> materialize")
 
 
-def _group_key_canonical(lcols, rcols, lkeys, rkeys, name: str) -> str:
-    """Resolve a group-by name to the LEFT join-key column holding its values
-    (matched rows carry equal keys on both sides). Resolves the column the
-    name actually denotes first (mirroring _agg_side_of, so a non-key column
-    sharing a join key's name cannot be mistaken for the key), then requires
-    it to BE a join key; raises DeviceUnsupported otherwise."""
-    side, src = _agg_side_of(lcols, rcols, name)
-    if side == "left":
-        if src not in lkeys:
-            raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
-        return src
-    if src not in rkeys:
-        raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
-    return lkeys[rkeys.index(src)]
+def _computed_map(computes, lcols, rcols):
+    """name -> (side, expr) for Compute nodes between Aggregate and Join:
+    an expression whose references live wholly on one side evaluates per
+    bucket on that side's decoded batch (anything cross-side
+    materializes)."""
+    out = {}
+    for name, expr in computes or ():
+        refs = set(expr.references())
+        if not refs:
+            out[name] = ("left", expr)  # constant: broadcasts on either side
+        elif refs <= lcols:
+            out[name] = ("left", expr)
+        elif refs <= rcols:
+            out[name] = ("right", expr)
+        else:
+            raise DeviceUnsupported(
+                f"computed aggregate input {name!r} references both sides -> materialize"
+            )
+    return out
 
 
-def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.Batch:
+def aggregate_over_bucketed_join(
+    session, agg: L.Aggregate, join: L.Join, computes=()
+) -> B.Batch:
     """Global aggregates over a compatible bucketed inner join WITHOUT
     materializing the pair expansion: per bucket, the [lo, hi) match spans
     give each left row's multiplicity, so sums become weighted sums and
@@ -2119,36 +2126,33 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
         raise DeviceUnsupported("join sides are not compatible bucketed scans")
     lside, rside, lkeys, rkeys = compat
     if agg.keys:
-        # grouped by exactly the join keys: the left runs ARE the groups
-        # (sorted per bucket), so per-group values come from segment
-        # reductions — still no pair materialization. Every join key must be
-        # covered exactly once (grouping by l.a and r.a of a composite join
-        # would silently group by the wrong granularity).
-        lc = set(lside.output_columns)
-        rc = set(rside.output_columns)
-        canonical = [_group_key_canonical(lc, rc, lkeys, rkeys, k) for k in agg.keys]
-        if sorted(canonical) != sorted(lkeys):
-            raise DeviceUnsupported("fused grouped aggregate requires grouping by the join keys")
-        return _grouped_aggregate_over_join(session, agg, join, compat)
+        return _grouped_aggregate_over_join(session, agg, join, compat, computes=computes)
 
     # which side does each aggregate input column come from?
     lcols = set(lside.output_columns)
     rcols = set(rside.output_columns)
+    computed = _computed_map(computes, lcols, rcols)
 
-    plans = []
+    plans = []  # (name, fn, side, src, expr|None)
     need_l, need_r = set(), set()
     for name, fn, col_name in agg.aggs:
         if fn not in _AGG_FNS:
             raise DeviceUnsupported(f"unsupported aggregate fn {fn!r} -> materialize")
         if fn == "count" and col_name is None:
-            plans.append((name, "count*", None, None))
+            plans.append((name, "count*", None, None, None))
             continue
-        side, src = _agg_side_of(lcols, rcols, col_name)
+        if col_name in computed:
+            side, expr = computed[col_name]
+            src = col_name
+            refs = set(expr.references())
+        else:
+            side, src = _agg_side_of(lcols, rcols, col_name)
+            expr, refs = None, {src}
         if fn in ("min", "max") and side == "right":
             # would need segment min over covered spans; not worth it here
             raise DeviceUnsupported("min/max of a right-side column -> materialize")
-        plans.append((name, fn, side, src))
-        (need_l if side == "left" else need_r).add(src)
+        plans.append((name, fn, side, src, expr))
+        (need_l if side == "left" else need_r).update(refs)
 
     # cheap footer-level dtype check BEFORE any decode: a string/binary
     # aggregate input must not cost a full read of both sides only to fall
@@ -2164,11 +2168,14 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
 
     INT_GUARD = 2 ** 62
 
-    def declared_is_int(side: str, src: str) -> bool:
+    def declared_is_int(side: str, src: str, expr=None) -> bool:
         # dtype from ANY decoded bucket, so the output dtype is right even
         # when no bucket has matches (empty-join sum must stay float for
         # float inputs, matching the materialized path)
         for batch in (lbuckets if side == "left" else rbuckets).values():
+            if expr is not None:
+                _v, _ok, is_int = _agg_column_stats(np.asarray(expr.eval(batch)))
+                return is_int
             if src in batch:
                 _v, _ok, is_int = _agg_column_stats(batch[src])
                 return is_int
@@ -2177,8 +2184,8 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
     total_pairs = 0
     acc = {name: {"sum": 0, "cnt": 0, "min": None, "max": None} for name, *_ in plans}
     is_int_out = {
-        name: (declared_is_int(side, src) if side is not None else True)
-        for name, fn, side, src in plans
+        name: (declared_is_int(side, src, expr) if side is not None else True)
+        for name, fn, side, src, expr in plans
     }
     for b in range(nb):
         lb, rb = lbuckets.get(b), rbuckets.get(b)
@@ -2200,11 +2207,17 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
         # aggregate reading that column in this bucket
         col_cache: Dict[Tuple[str, str], tuple] = {}
 
-        def col_info(side: str, src: str):
+        def col_info(side: str, src: str, expr=None):
             got = col_cache.get((side, src))
             if got is not None:
                 return got
-            arr = (lb if side == "left" else rb)[src]
+            batch_ = lb if side == "left" else rb
+            if expr is not None:
+                arr = np.asarray(expr.eval(batch_))
+                if arr.ndim == 0:  # constant expression broadcasts per row
+                    arr = np.broadcast_to(arr, (B.num_rows(batch_),))
+            else:
+                arr = batch_[src]
             vals, ok, is_int = _agg_column_stats(arr)
             pref = prefn = None
             if side == "right":
@@ -2220,11 +2233,11 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
             col_cache[(side, src)] = got
             return got
 
-        for name, fn, side, src in plans:
+        for name, fn, side, src, expr in plans:
             a = acc[name]
             if fn == "count*":
                 continue
-            vals, ok, is_int, pref, prefn = col_info(side, src)
+            vals, ok, is_int, pref, prefn = col_info(side, src, expr)
             if side == "left":
                 w = counts if ok is None else counts * ok
                 if fn in ("sum", "avg"):
@@ -2252,7 +2265,7 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
                     a["cnt"] += int((prefn[hi_i] - prefn[lo_i]).sum())
 
     out: B.Batch = {}
-    for name, fn, side, src in plans:
+    for name, fn, side, src, expr in plans:
         a = acc[name]
         if fn == "count*":
             out[name] = np.asarray([total_pairs])
@@ -2279,31 +2292,86 @@ def aggregate_over_bucketed_join(session, agg: L.Aggregate, join: L.Join) -> B.B
     return out
 
 
-def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat) -> B.Batch:
-    """Per-join-key aggregates from segment reductions over each bucket's
-    sorted left run: run boundaries are key changes, per-run pair totals are
-    reduceat sums of span counts, and sums reduce count-weighted values
-    (left) or span prefix-sum differences (right). Inner-join semantics:
-    keys with no matches produce no output row."""
+def _grouped_aggregate_over_join(
+    session, agg: L.Aggregate, join: L.Join, compat, computes=()
+) -> B.Batch:
+    """Grouped aggregates over a compatible bucketed inner join WITHOUT
+    materializing the pair expansion.
+
+    Groups are discovered as SUB-SEGMENTS of each bucket's sorted left run:
+    boundaries fall wherever any join key, any left-side group key, or any
+    (per-left-row gathered) right-side group key changes. Per-segment pair
+    totals are reduceat sums of span counts; sums reduce count-weighted
+    left values or span prefix-sum differences (right). Because equal group
+    tuples can recur non-contiguously (group keys need not include every
+    join key, and extra keys are unsorted within runs), per-segment
+    partials FINAL-MERGE through one output-sized pandas groupby — the
+    partial/final split, applied to segments instead of chunks.
+
+    Right-side group keys additionally require the right side to be UNIQUE
+    per join key in every bucket (spans of width <= 1, checked per bucket):
+    that is what makes the gathered per-left-row value well defined. This
+    covers the TPC-H q3 class — GROUP BY l_orderkey, o_orderdate,
+    o_shippriority over lineitem JOIN orders (o_orderkey is unique) with a
+    computed revenue input — end to end without pair expansion.
+
+    Raises DeviceUnsupported for shapes it can't fuse (outer joins,
+    min/max, cross-side computed inputs, non-unique right side under
+    right-side group keys); the caller then materializes."""
     lside, rside, lkeys, rkeys = compat
     lcols = set(lside.output_columns)
     rcols = set(rside.output_columns)
+    computed = _computed_map(computes, lcols, rcols)
 
-    plans = []
-    need_l, need_r = set(lkeys), set()
+    def resolve(col):
+        if col in computed:
+            side, expr = computed[col]
+            return side, col, expr, set(expr.references())
+        side, src = _agg_side_of(lcols, rcols, col)
+        return side, src, None, {src}
+
     for _, fn, _c in agg.aggs:
         if fn not in _AGG_FNS:
             raise DeviceUnsupported(f"unsupported aggregate fn {fn!r} -> materialize")
+
+    # group-key plan: join keys canonicalize to the LEFT key column
+    # (matched rows carry equal values); anything else is an "extra"
+    key_plan = []  # (out_name, kind, src, expr) kind in jk/lx/rx
+    need_l, need_r = set(lkeys), set(rkeys)
+    has_right_extra = False
+    for k in agg.keys:
+        side, src, expr, refs = resolve(k)
+        if expr is None and side == "left" and src in lkeys:
+            key_plan.append((k, "jk", src, None))
+        elif expr is None and side == "right" and src in rkeys:
+            key_plan.append((k, "jk", lkeys[rkeys.index(src)], None))
+        elif side == "left":
+            key_plan.append((k, "lx", src, expr))
+            need_l |= refs
+        else:
+            key_plan.append((k, "rx", src, expr))
+            need_r |= refs
+            has_right_extra = True
+
+    plans = []  # (name, fn, side, src, expr)
     for name, fn, col_name in agg.aggs:
         if fn == "count" and col_name is None:
-            plans.append((name, "count*", None, None))
+            plans.append((name, "count*", None, None, None))
             continue
-        side, src = _agg_side_of(lcols, rcols, col_name)
+        side, src, expr, refs = resolve(col_name)
         if fn in ("min", "max"):
             raise DeviceUnsupported("grouped min/max -> materialize")
-        plans.append((name, fn, side, src))
-        (need_l if side == "left" else need_r).add(src)
+        plans.append((name, fn, side, src, expr))
+        (need_l if side == "left" else need_r).update(refs)
 
+    # footer pre-check covers computed inputs via their REFERENCES, so a
+    # string-referencing expression bails before decoding both whole sides
+    check_l = {s for _, fn, sd, s, e in plans if sd == "left" and e is None}
+    check_r = {s for _, fn, sd, s, e in plans if sd == "right" and e is None}
+    for _, fn, sd, _s, e in plans:
+        if e is not None:
+            (check_l if sd == "left" else check_r).update(e.references())
+    _check_agg_input_dtypes(lside, rside, check_l, check_r)
     setup = _bucketed_join_setup(
         session, join, compat, needed_override=(sorted(need_l), sorted(need_r))
     )
@@ -2312,13 +2380,11 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
 
     INT_GUARD = 2 ** 62
 
-    # output key columns: requested name -> the left key column holding its
-    # values (right key values equal left's on matched rows)
-    key_source = {k: _group_key_canonical(lcols, rcols, lkeys, rkeys, k) for k in agg.keys}
-
-    out_keys: Dict[str, List[np.ndarray]] = {k: [] for k in agg.keys}
-    out_vals: Dict[str, List[np.ndarray]] = {name: [] for name, *_ in plans}
-    int_sum = {name: fn in ("sum",) for name, fn, *_ in plans}  # refined below
+    key_parts: Dict[str, List[np.ndarray]] = {k: [] for k, *_ in key_plan}
+    # per-aggregate partial columns: sum+cnt for sum/avg, cnt for counts
+    sum_parts: Dict[str, List[np.ndarray]] = {name: [] for name, *_ in plans}
+    cnt_parts: Dict[str, List[np.ndarray]] = {name: [] for name, *_ in plans}
+    int_sum = {name: True for name, *_ in plans}
 
     for b in range(nb):
         lb, rb = lbuckets.get(b), rbuckets.get(b)
@@ -2331,30 +2397,65 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
         lo_i = np.asarray(lo, dtype=np.int64)
         hi_i = np.asarray(hi, dtype=np.int64)
         counts = hi_i - lo_i
+        if has_right_extra and counts.size and int(counts.max()) > 1:
+            raise DeviceUnsupported(
+                "right-side group key over a non-unique join side -> materialize"
+            )
 
-        # run boundaries over the (sorted) left key columns
+        def left_col(src, expr):
+            if expr is not None:
+                arr = np.asarray(expr.eval(lb))
+                return (
+                    np.broadcast_to(arr, (ll,)) if arr.ndim == 0 else arr
+                )
+            return lb[src]
+
+        def right_gathered(src, expr):
+            arr = np.asarray(expr.eval(rb)) if expr is not None else rb[src]
+            if arr.ndim == 0:
+                arr = np.broadcast_to(arr, (rr,))
+            # valid where counts == 1; count-0 rows carry a neighbor's
+            # value, which either forms an empty segment (dropped) or
+            # harmlessly extends an equal-valued one
+            return arr[np.clip(lo_i, 0, rr - 1)]
+
+        # sub-segment boundaries: change in ANY join key or group extra
+        key_arrays = {}  # out_name -> per-left-row values for output
         change = np.zeros(ll, dtype=bool)
-        change[0] = True
+        if ll:
+            change[0] = True
         for kc in lkeys:
             kv = _order_key_array(lb[kc])
             change[1:] |= kv[1:] != kv[:-1]
+        for k, kind, src, expr in key_plan:
+            if kind == "jk":
+                key_arrays[k] = lb[src]
+                continue
+            arr = left_col(src, expr) if kind == "lx" else right_gathered(src, expr)
+            key_arrays[k] = arr
+            kv = _order_key_array(arr)
+            change[1:] |= kv[1:] != kv[:-1]
         starts = np.flatnonzero(change)
-        run_pairs = np.add.reduceat(counts, starts)
-        keep = run_pairs > 0  # inner join: unmatched keys drop out
-
+        run_pairs = np.add.reduceat(counts, starts) if starts.size else np.empty(0, np.int64)
+        keep = run_pairs > 0  # inner join: unmatched segments drop out
         if not keep.any():
             continue
 
-        for k in agg.keys:
-            out_keys[k].append(lb[key_source[k]][starts][keep])
+        for k, kind, src, expr in key_plan:
+            key_parts[k].append(key_arrays[k][starts][keep])
 
         col_cache: Dict[Tuple[str, str], tuple] = {}
 
-        def col_info(side, src):
+        def col_info(side, src, expr):
             got = col_cache.get((side, src))
             if got is not None:
                 return got
-            arr = (lb if side == "left" else rb)[src]
+            if side == "left":
+                arr = left_col(src, expr)
+            else:
+                arr = np.asarray(expr.eval(rb)) if expr is not None else rb[src]
+                if arr.ndim == 0:
+                    arr = np.broadcast_to(arr, (rr,))
             vals, ok, is_int = _agg_column_stats(arr)
             if is_int and vals.size and _int_magnitude(vals) * max(int(counts.sum()), 1) >= INT_GUARD:
                 raise DeviceUnsupported("int sum overflow risk -> materialize")
@@ -2371,45 +2472,26 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
             col_cache[(side, src)] = got
             return got
 
-        for name, fn, side, src in plans:
+        for name, fn, side, src, expr in plans:
             if fn == "count*":
-                out_vals[name].append(run_pairs[keep])
+                cnt_parts[name].append(run_pairs[keep])
                 continue
-            vals, ok, is_int, pref, prefn = col_info(side, src)
+            vals, ok, is_int, pref, prefn = col_info(side, src, expr)
             if not is_int:
                 int_sum[name] = False
             if side == "left":
                 w = counts if ok is None else counts * ok
-                if fn == "count":
-                    out_vals[name].append(np.add.reduceat(w, starts)[keep])
-                else:  # sum / avg
+                cnts = np.add.reduceat(w, starts)[keep]
+                cnt_parts[name].append(cnts)
+                if fn in ("sum", "avg"):
                     contrib = vals * counts if ok is None else np.where(ok, vals, 0) * counts
-                    sums = np.add.reduceat(contrib, starts)[keep]
-                    if fn == "sum":
-                        out_vals[name].append(sums)
-                    else:
-                        cnts = np.add.reduceat(w, starts)[keep]
-                        out_vals[name].append(
-                            np.divide(sums, cnts, out=np.full(sums.shape, np.nan), where=cnts > 0)
-                        )
+                    sum_parts[name].append(np.add.reduceat(contrib, starts)[keep])
             else:
-                row_sums = pref[hi_i] - pref[lo_i]
                 row_cnts = prefn[hi_i] - prefn[lo_i]
-                sums = np.add.reduceat(row_sums, starts)[keep]
-                cnts = np.add.reduceat(row_cnts, starts)[keep]
-                if fn == "sum":
-                    out_vals[name].append(sums)
-                elif fn == "count":
-                    out_vals[name].append(cnts)
-                else:
-                    out_vals[name].append(
-                        np.divide(
-                            sums.astype(np.float64),
-                            cnts,
-                            out=np.full(sums.shape, np.nan),
-                            where=cnts > 0,
-                        )
-                    )
+                cnt_parts[name].append(np.add.reduceat(row_cnts, starts)[keep])
+                if fn in ("sum", "avg"):
+                    row_sums = pref[hi_i] - pref[lo_i]
+                    sum_parts[name].append(np.add.reduceat(row_sums, starts)[keep])
 
     def declared_dtype(side, src) -> np.dtype:
         for batch in (lbuckets if side == "left" else rbuckets).values():
@@ -2417,32 +2499,96 @@ def _grouped_aggregate_over_join(session, agg: L.Aggregate, join: L.Join, compat
                 return batch[src].dtype
         raise DeviceUnsupported(f"aggregate input {src!r} has no decoded bucket")
 
+    def declared_expr_dtype(side, expr) -> np.dtype:
+        # a computed column's dtype comes from evaluating it over any
+        # decoded bucket (empty-result outputs must still type like the
+        # materialized path's)
+        for batch in (lbuckets if side == "left" else rbuckets).values():
+            arr = np.asarray(expr.eval(batch))
+            return arr.dtype
+        return np.dtype(np.float64)
+
     out: B.Batch = {}
-    for k in agg.keys:
-        parts = out_keys[k]
-        out[k] = (
-            np.concatenate(parts)
-            if parts
-            else np.empty(0, dtype=declared_dtype("left", key_source[k]))
-        )
-    for name, fn, side, src in plans:
-        parts = out_vals[name]
-        if not parts:
+    any_parts = any(key_parts[k] for k, *_ in key_plan) if key_plan else False
+    if not any_parts:
+        for k, kind, src, expr in key_plan:
+            if expr is not None:
+                out[k] = np.empty(
+                    0, dtype=declared_expr_dtype("left" if kind != "rx" else "right", expr)
+                )
+            else:
+                out[k] = np.empty(
+                    0, dtype=declared_dtype("left" if kind != "rx" else "right", src)
+                )
+        for name, fn, side, src, expr in plans:
             if fn in ("count", "count*"):
                 dt = np.dtype(np.int64)
-            elif fn == "sum":
+            elif fn == "sum" and side is not None:
                 _v, _ok, is_int = _agg_column_stats(
                     np.empty(0, dtype=declared_dtype(side, src))
+                    if expr is None
+                    else np.empty(0, dtype=declared_expr_dtype(side, expr))
                 )
                 dt = np.dtype(np.int64) if is_int else np.dtype(np.float64)
             else:
                 dt = np.dtype(np.float64)
             out[name] = np.empty(0, dtype=dt)
-            continue
-        merged = np.concatenate(parts)
+        return out
+
+    # FINAL MERGE: equal group tuples recur across segments (and, when the
+    # group keys don't pin the join key, across buckets) — one
+    # segment-count-sized pandas groupby folds the partials. Keys enter as
+    # null-safe int64 ORDER CODES, never as raw values: strings would pay
+    # pandas' Arrow conversion (the round-4 lesson) and datetimes would
+    # round-trip to ns; a representative row index maps each group back to
+    # its exact original values/dtypes.
+    import pandas as pd
+
+    key_arrays_out = {k: np.concatenate(key_parts[k]) for k, *_ in key_plan}
+    frame = {
+        f"__k{i}": _order_key_array(key_arrays_out[k])
+        for i, (k, *_rest) in enumerate(key_plan)
+    }
+    gcols = list(frame)
+    n_seg = len(next(iter(key_arrays_out.values()))) if key_arrays_out else 0
+    frame["__pos"] = np.arange(n_seg, dtype=np.int64)
+    for name, fn, side, src, expr in plans:
+        frame[f"__c_{name}"] = np.concatenate(cnt_parts[name])
+        if sum_parts[name]:
+            s_part = np.concatenate(sum_parts[name])
+            if int_sum[name] and s_part.dtype.kind != "f":
+                # pandas sums int64 with wrapping arithmetic; cross-bucket
+                # merges could exceed int64 even when every per-bucket
+                # partial passed its own guard
+                if float(np.abs(s_part.astype(np.float64)).sum()) >= float(INT_GUARD):
+                    raise DeviceUnsupported("int sum overflow risk at merge -> materialize")
+            frame[f"__s_{name}"] = s_part
+    df = pd.DataFrame(frame)
+    gb = df.groupby(gcols, dropna=False, sort=False)
+    agg_spec = {c: "sum" for c in df.columns if c not in gcols and c != "__pos"}
+    agg_spec["__pos"] = "first"
+    res = gb.agg(agg_spec).reset_index()
+
+    rep = res["__pos"].to_numpy()
+    for k, *_rest in key_plan:
+        out[k] = key_arrays_out[k][rep]
+    for name, fn, side, src, expr in plans:
+        c = res[f"__c_{name}"].to_numpy()
         if fn in ("count", "count*"):
-            merged = merged.astype(np.int64)
-        elif fn == "sum" and int_sum[name] and merged.dtype.kind != "f":
-            merged = merged.astype(np.int64)
-        out[name] = merged
+            out[name] = c.astype(np.int64)
+            continue
+        s = res[f"__s_{name}"].to_numpy()
+        if fn == "avg":
+            out[name] = np.divide(
+                s.astype(np.float64), c, out=np.full(s.shape, np.nan), where=c > 0
+            )
+            continue
+        # sum: SQL NULL (NaN) for all-null groups; int sums stay int when
+        # no group needs a NULL hole
+        if (c > 0).all():
+            out[name] = s.astype(np.int64) if int_sum[name] and s.dtype.kind != "f" else s
+        else:
+            sf = s.astype(np.float64)
+            sf[c == 0] = np.nan
+            out[name] = sf
     return out

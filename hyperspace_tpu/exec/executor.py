@@ -802,13 +802,21 @@ class Executor:
             # multiplicity, so no join output is ever materialized (global
             # aggregates, or grouped by the join keys)
             join_node = plan.child
-            while isinstance(join_node, L.Project):
+            computes = []
+            while isinstance(join_node, (L.Project, L.Compute)):
+                if isinstance(join_node, L.Compute):
+                    # computed aggregate inputs / group keys (q3's
+                    # sum(l_extendedprice * (1 - l_discount))): single-side
+                    # expressions evaluate per bucket inside the fusion
+                    computes.extend(join_node.exprs)
                 join_node = join_node.child
             if isinstance(join_node, L.Join):
                 from hyperspace_tpu.exec import device as D
 
                 try:
-                    got = D.aggregate_over_bucketed_join(self.session, plan, join_node)
+                    got = D.aggregate_over_bucketed_join(
+                        self.session, plan, join_node, computes=computes
+                    )
                     trace.record("agg", "fused-bucketed-join")
                     return got
                 except D.DeviceUnsupported:

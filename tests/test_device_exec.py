@@ -1264,9 +1264,9 @@ def test_executor_routes_aggregate_through_fused_path(session, tmp_path, monkeyp
     calls = {"n": 0}
     real = D.aggregate_over_bucketed_join
 
-    def counting(sess_, agg_, join_):
+    def counting(sess_, agg_, join_, **kw):
         calls["n"] += 1
-        return real(sess_, agg_, join_)
+        return real(sess_, agg_, join_, **kw)
 
     monkeypatch.setattr(D, "aggregate_over_bucketed_join", counting)
     got = ldf.join(rdf, on="k").agg(s=("v", "sum")).collect()
@@ -1377,9 +1377,146 @@ class TestGroupedFusedJoinAggregate:
         assert self._maps(fused, keys=("qty",)) == self._maps(plain, keys=("qty",))
 
 
-def test_grouped_fused_rejects_repeated_key(session, tmp_path):
-    """Grouping by l.a and r.a of a composite (a,b) join must NOT take the
-    fused path (wrong granularity); results equal the materialized path."""
+class TestQ3ShapeFusion:
+    """Round-5 generalization: GROUP BY join key + right-side payload keys
+    with a computed aggregate input — TPC-H q3's exact shape — fuses
+    without pair materialization when the right side is unique per key."""
+
+    @pytest.fixture
+    def q3env(self, session, hs, tmp_path):
+        session.conf.set(hst.keys.NUM_BUCKETS, 8)
+        rng = np.random.default_rng(3)
+        lroot, rroot = tmp_path / "li3", tmp_path / "o3"
+        lroot.mkdir(), rroot.mkdir()
+        n = 4000
+        base = np.datetime64("1994-01-01")
+        pq.write_table(
+            pa.table(
+                {
+                    "l_ok": rng.integers(0, 500, n).astype(np.int64),
+                    "l_price": np.round(rng.uniform(10, 1000, n), 2),
+                    "l_disc": np.round(rng.uniform(0, 0.1, n), 2),
+                }
+            ),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table(
+                {
+                    "o_ok": np.arange(500, dtype=np.int64),  # UNIQUE per key
+                    "o_date": base + rng.integers(0, 300, 500).astype("timedelta64[D]"),
+                    "o_prio": rng.integers(0, 3, 500).astype(np.int64),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("q3L", ["l_ok"], ["l_price", "l_disc"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("q3R", ["o_ok"], ["o_date", "o_prio"]))
+        session.enable_hyperspace()
+        return ldf, rdf
+
+    def _rows(self, batch):
+        import datetime
+
+        def norm(v):
+            if isinstance(v, float):
+                return f"{v:.5f}"
+            if isinstance(v, (datetime.date, datetime.datetime)):
+                # the fused path preserves the decoded datetime64 unit ([D]);
+                # the pandas roundtrip of the materialized path yields ns —
+                # same instant, different repr
+                import pandas as pd
+
+                return pd.Timestamp(v).isoformat()
+            return str(v)
+
+        cols = sorted(batch)
+        return sorted(zip(*[[norm(v) for v in batch[c].tolist()] for c in cols]))
+
+    def test_q3_group_keys_and_computed_input_fuse(self, session, q3env):
+        ldf, rdf = q3env
+        ldf.create_or_replace_temp_view("li3")
+        rdf.create_or_replace_temp_view("o3")
+        q = session.sql(
+            """
+            select l_ok, sum(l_price * (1 - l_disc)) as rev, o_date, o_prio,
+                   count(*) as n
+            from li3 join o3 on l_ok = o_ok
+            group by l_ok, o_date, o_prio
+            """
+        )
+        from hyperspace_tpu.exec import trace
+
+        with trace.recording() as rec:
+            fused = q.collect()
+        assert ("agg", "fused-bucketed-join") in rec, trace.summarize(rec)
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        plain = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        assert self._rows(fused) == self._rows(plain)
+
+    def test_right_extra_over_non_unique_right_falls_back(self, session, hs, tmp_path):
+        session.conf.set(hst.keys.NUM_BUCKETS, 8)
+        rng = np.random.default_rng(5)
+        lroot, rroot = tmp_path / "nl", tmp_path / "nr"
+        lroot.mkdir(), rroot.mkdir()
+        pq.write_table(
+            pa.table(
+                {
+                    "a": rng.integers(0, 30, 2000).astype(np.int64),
+                    "v": rng.uniform(0, 10, 2000),
+                }
+            ),
+            lroot / "p.parquet",
+        )
+        pq.write_table(
+            pa.table(
+                {
+                    "b": rng.integers(0, 30, 300).astype(np.int64),  # dupes
+                    "tag": rng.integers(0, 4, 300).astype(np.int64),
+                }
+            ),
+            rroot / "p.parquet",
+        )
+        ldf, rdf = session.read_parquet(str(lroot)), session.read_parquet(str(rroot))
+        hs.create_index(ldf, hst.CoveringIndexConfig("nL", ["a"], ["v"]))
+        hs.create_index(rdf, hst.CoveringIndexConfig("nR", ["b"], ["tag"]))
+        session.enable_hyperspace()
+        q = (
+            ldf.join(rdf, on=hst.col("a") == hst.col("b"))
+            .group_by("a", "tag")
+            .agg(s=("v", "sum"), n=("*", "count"))
+        )
+        fused = q.collect()  # falls back to materialization internally
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        plain = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        assert self._rows(fused) == self._rows(plain)
+
+    def test_group_without_join_key_merges_across_buckets(self, session, q3env):
+        """Group keys that don't pin the join key recur across buckets;
+        the final merge must fold them into one row per group."""
+        ldf, rdf = q3env
+        q = (
+            ldf.join(rdf, on=hst.col("l_ok") == hst.col("o_ok"))
+            .group_by("o_prio")
+            .agg(s=("l_price", "sum"), n=("*", "count"))
+        )
+        fused = q.collect()
+        assert len(fused["o_prio"]) == len(set(fused["o_prio"].tolist()))
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, False)
+        plain = q.collect()
+        session.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, True)
+        assert self._rows(fused) == self._rows(plain)
+
+
+def test_grouped_fused_repeated_key_granularity(session, tmp_path):
+    """Grouping by l.a and r.a of a composite (a,b) join groups COARSER
+    than the join-key runs; round 5's final-merge generalization fuses it
+    correctly (pre-round-5 this shape was rejected to the materialized
+    path). Results must equal the materialized path at the right
+    granularity."""
     hs = hst.Hyperspace(session)
     session.conf.set(hst.keys.NUM_BUCKETS, 2)
     rng = np.random.default_rng(71)
