@@ -8,6 +8,9 @@ Baseline (BASELINE.md): >= 1,000,000 rows/sec/chip; ``vs_baseline`` is
 value / 1e6.
 
 Prints exactly ONE JSON line.
+
+``--serve`` runs the serving-runtime benchmark instead (plan-cache-on vs off
+throughput through a QueryServer) and also writes BENCH_serving.json.
 """
 
 from __future__ import annotations
@@ -99,6 +102,100 @@ def _backend_watchdog(timeout_s: float = 75.0, retries: int = 3, emit=None) -> N
     sys.exit(1)
 
 
+def serve_main() -> None:
+    """``python bench.py --serve``: serving-runtime benchmark.
+
+    Repeated same-structure queries (16 literal variants of an indexed filter)
+    through a QueryServer with the plan cache on vs off; reports throughput,
+    speedup, hit rates, and latency percentiles to stdout AND
+    BENCH_serving.json (one schema, both places).
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    num_rows = int(os.environ.get("BENCH_SERVE_ROWS", 8_000))
+    reps = max(1, int(os.environ.get("BENCH_SERVE_REPS", 30)))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_serve_")
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.serving import QueryServer
+
+        data_dir = os.path.join(tmp, "sales")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        names = list("abcdefgh")
+        cols = {
+            c: (np.arange(num_rows, dtype=np.int64) * (3 + i)) % (997 + 131 * i)
+            for i, c in enumerate(names)
+        }
+        cols["v"] = (np.arange(num_rows, dtype=np.int64) * 31) % 10_000
+        pq.write_table(pa.table(cols), os.path.join(data_dir, "part-0.parquet"))
+
+        sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sys_dir, hst.keys.NUM_BUCKETS: 8})
+        hst.set_session(sess)
+        hs = hst.Hyperspace(sess)
+        df = sess.read_parquet(data_dir)
+        df.create_or_replace_temp_view("sales")
+        k = 0
+        for i in range(8):
+            for j in range(3):
+                indexed = [names[i]] if j == 0 else [names[i], names[(i + j) % 8]]
+                hs.create_index(df, hst.CoveringIndexConfig(f"ix{k}", indexed, ["v"]))
+                k += 1
+        sess.enable_hyperspace()
+
+        plans = [
+            sess.sql(f"SELECT a, v FROM sales WHERE b > {300 + i} AND c > 5 AND d < 900").plan
+            for i in range(16)
+        ]
+
+        def run(enabled: bool):
+            srv = QueryServer(
+                sess, workers=2, plan_cache_enabled=enabled, queue_depth=65536
+            ).start()
+            try:
+                for p in plans:  # warm: compile + io cache
+                    srv.submit(p)
+                srv.stats()
+                futs = []
+                t0 = time.perf_counter()
+                for _ in range(reps):
+                    for p in plans:
+                        futs.append(srv.submit(p))
+                for f in futs:
+                    f.result(timeout=300)
+                dt = time.perf_counter() - t0
+                return len(futs) / dt, srv.stats()
+            finally:
+                srv.shutdown()
+
+        qps_off, stats_off = run(False)
+        qps_on, stats_on = run(True)
+        out = {
+            "metric": "serving_cached_queries_per_sec",
+            "value": round(qps_on, 1),
+            "unit": "queries/s",
+            "vs_baseline": round(qps_on / qps_off / 3.0, 4),  # baseline: 3x uncached
+            "uncached_qps": round(qps_off, 1),
+            "speedup": round(qps_on / qps_off, 2),
+            "plan_cache": stats_on["planCache"],
+            "bucket_cache_hit_rate": stats_on["bucketCache"]["hitRate"],
+            "micro_batches": stats_on["batches"],
+            "batched_requests": stats_on["batchedRequests"],
+            "latency_seconds": stats_on["latencySeconds"],
+            "uncached_latency_seconds": stats_off["latencySeconds"],
+        }
+        line = json.dumps(out)
+        with open("BENCH_serving.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     _honor_cpu_request()
     _backend_watchdog()
@@ -177,4 +274,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--serve" in sys.argv[1:]:
+        serve_main()
+    else:
+        main()

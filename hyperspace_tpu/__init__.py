@@ -57,6 +57,7 @@ from hyperspace_tpu.indexes.dataskipping import (
     ValueListSketch,
 )
 from hyperspace_tpu.hyperspace import Hyperspace
+from hyperspace_tpu.serving import AdmissionRejected, QueryServer, RequestTimeout
 
 __all__ = [
     "__version__",
@@ -75,4 +76,7 @@ __all__ = [
     "BloomFilterSketch",
     "ValueListSketch",
     "Hyperspace",
+    "QueryServer",
+    "AdmissionRejected",
+    "RequestTimeout",
 ]

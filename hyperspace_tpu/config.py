@@ -52,6 +52,19 @@ class keys:
     EXEC_STREAM_AGG_MIN_BYTES = "hyperspace.exec.stream.aggMinBytes"
     EXEC_STREAM_CHUNK_BYTES = "hyperspace.exec.stream.chunkBytes"
     EXEC_JOIN_SPILL_MIN_ROWS = "hyperspace.exec.join.spillMinRows"
+    # Query-serving runtime (hyperspace_tpu/serving/): concurrent request
+    # admission, compiled-plan caching, micro-batching, bucket prefetch.
+    SERVING_QUEUE_DEPTH = "hyperspace.serving.queueDepth"
+    SERVING_WORKERS = "hyperspace.serving.workers"
+    SERVING_DEFAULT_TIMEOUT_SECONDS = "hyperspace.serving.defaultTimeoutSeconds"
+    SERVING_PLAN_CACHE_ENABLED = "hyperspace.serving.planCache.enabled"
+    SERVING_PLAN_CACHE_MAX_ENTRIES = "hyperspace.serving.planCache.maxEntries"
+    SERVING_MICRO_BATCH_ENABLED = "hyperspace.serving.microBatch.enabled"
+    SERVING_MICRO_BATCH_MAX_REQUESTS = "hyperspace.serving.microBatch.maxRequests"
+    SERVING_MICRO_BATCH_MAX_WAIT_MS = "hyperspace.serving.microBatch.maxWaitMs"
+    SERVING_BUCKET_CACHE_BYTES = "hyperspace.serving.bucketCache.bytes"
+    SERVING_PREFETCH_ENABLED = "hyperspace.serving.prefetch.enabled"
+    SERVING_PREFETCH_WORKERS = "hyperspace.serving.prefetch.workers"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -136,6 +149,22 @@ DEFAULTS: Dict[str, Any] = {
     # partitioned (grace-join style): both sides split by key hash and each
     # partition merges independently, bounding the merge intermediate.
     keys.EXEC_JOIN_SPILL_MIN_ROWS: 1 << 26,
+    # Serving runtime. Queue depth bounds memory under overload: submits
+    # beyond it are REJECTED (AdmissionRejected), never silently queued.
+    keys.SERVING_QUEUE_DEPTH: 64,
+    keys.SERVING_WORKERS: 4,
+    # None = no deadline; floats are seconds from submit to result.
+    keys.SERVING_DEFAULT_TIMEOUT_SECONDS: 30.0,
+    keys.SERVING_PLAN_CACHE_ENABLED: True,
+    keys.SERVING_PLAN_CACHE_MAX_ENTRIES: 256,
+    keys.SERVING_MICRO_BATCH_ENABLED: True,
+    keys.SERVING_MICRO_BATCH_MAX_REQUESTS: 16,
+    # How long a worker lingers draining the queue to fill a batch; the
+    # latency cost of coalescing is bounded by this.
+    keys.SERVING_MICRO_BATCH_MAX_WAIT_MS: 2.0,
+    keys.SERVING_BUCKET_CACHE_BYTES: 1 << 30,
+    keys.SERVING_PREFETCH_ENABLED: True,
+    keys.SERVING_PREFETCH_WORKERS: 2,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -307,6 +336,52 @@ class HyperspaceConf:
     @property
     def join_spill_min_rows(self) -> int:
         return int(self.get(keys.EXEC_JOIN_SPILL_MIN_ROWS))
+
+    # Serving runtime --------------------------------------------------------
+    @property
+    def serving_queue_depth(self) -> int:
+        return int(self.get(keys.SERVING_QUEUE_DEPTH))
+
+    @property
+    def serving_workers(self) -> int:
+        return int(self.get(keys.SERVING_WORKERS))
+
+    @property
+    def serving_default_timeout_seconds(self) -> Optional[float]:
+        v = self.get(keys.SERVING_DEFAULT_TIMEOUT_SECONDS)
+        return None if v is None else float(v)
+
+    @property
+    def serving_plan_cache_enabled(self) -> bool:
+        return bool(self.get(keys.SERVING_PLAN_CACHE_ENABLED))
+
+    @property
+    def serving_plan_cache_max_entries(self) -> int:
+        return int(self.get(keys.SERVING_PLAN_CACHE_MAX_ENTRIES))
+
+    @property
+    def serving_micro_batch_enabled(self) -> bool:
+        return bool(self.get(keys.SERVING_MICRO_BATCH_ENABLED))
+
+    @property
+    def serving_micro_batch_max_requests(self) -> int:
+        return int(self.get(keys.SERVING_MICRO_BATCH_MAX_REQUESTS))
+
+    @property
+    def serving_micro_batch_max_wait_ms(self) -> float:
+        return float(self.get(keys.SERVING_MICRO_BATCH_MAX_WAIT_MS))
+
+    @property
+    def serving_bucket_cache_bytes(self) -> int:
+        return int(self.get(keys.SERVING_BUCKET_CACHE_BYTES))
+
+    @property
+    def serving_prefetch_enabled(self) -> bool:
+        return bool(self.get(keys.SERVING_PREFETCH_ENABLED))
+
+    @property
+    def serving_prefetch_workers(self) -> int:
+        return int(self.get(keys.SERVING_PREFETCH_WORKERS))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

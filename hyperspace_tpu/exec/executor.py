@@ -439,7 +439,12 @@ class Executor:
     def __init__(self, session):
         self.session = session
 
-    def execute(self, plan: L.LogicalPlan, required_columns: Optional[List[str]] = None) -> B.Batch:
+    def execute(
+        self,
+        plan: L.LogicalPlan,
+        required_columns: Optional[List[str]] = None,
+        prepruned: bool = False,
+    ) -> B.Batch:
         from hyperspace_tpu.plan.expr import subquery_scope
 
         # execution-time column pruning for EVERY plan (Catalyst runs
@@ -450,16 +455,18 @@ class Executor:
         # optimized plan, like the reference's NORMALIZED approvals, so the
         # mechanical Project-over-scan layer stays out of them; the
         # dispatch trace still records what actually runs. Fallback keeps
-        # the never-break-a-query contract.
-        try:
-            from hyperspace_tpu.rules.utils import prune_columns
+        # the never-break-a-query contract. ``prepruned`` lets the serving
+        # plan cache skip this walk for templates pruned once at compile.
+        if not prepruned:
+            try:
+                from hyperspace_tpu.rules.utils import prune_columns
 
-            plan = prune_columns(plan)
-        except Exception:  # pruning must never kill a query
-            # visible in recorded dispatch traces (and so in the goldens):
-            # a silent fallback here once hid a RecursionError that cost
-            # 3x on every view-sharing query
-            trace.record("prune", "fallback-unpruned")
+                plan = prune_columns(plan)
+            except Exception:  # pruning must never kill a query
+                # visible in recorded dispatch traces (and so in the goldens):
+                # a silent fallback here once hid a RecursionError that cost
+                # 3x on every view-sharing query
+                trace.record("prune", "fallback-unpruned")
 
         # sub-plans referenced more than once (a CTE used N times holds ONE
         # plan object) execute once per collect; only those roots memoize.
@@ -565,6 +572,17 @@ class Executor:
             return self._exec_scan(plan, with_file_names)
 
         if isinstance(plan, L.FileScan):
+            bucket_cache = getattr(self.session, "bucket_cache", None)
+            if (
+                bucket_cache is not None
+                and not with_file_names
+                and plan.files
+                and plan.file_format == "parquet"
+                and not plan.partition_values
+                and not plan.format_options
+            ):
+                trace.record("scan", "bucket-cache-filescan")
+                return bucket_cache.read(list(plan.files), list(plan.columns))
             return _read_files(
                 list(plan.files),
                 plan.file_format,
@@ -581,7 +599,11 @@ class Executor:
             else:
                 trace.record("scan", "index")
             fcols = plan.file_columns if plan.file_columns is not None else list(plan.columns)
-            batch = _read_files(list(plan.files), "parquet", list(fcols), with_file_names)
+            bucket_cache = getattr(self.session, "bucket_cache", None)
+            if bucket_cache is not None and not with_file_names and plan.files:
+                batch = bucket_cache.read(list(plan.files), list(fcols))
+            else:
+                batch = _read_files(list(plan.files), "parquet", list(fcols), with_file_names)
             if plan.file_columns is not None:
                 # nested index columns are stored under their flat
                 # __hs_nested. name; present them under the output name

@@ -11,6 +11,7 @@ buffers, no JVM and no pyarrow table materialization in the hot loop
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -81,16 +82,20 @@ def clear_io_cache() -> None:
 
 
 _DECODE_POOL = None
+_DECODE_POOL_LOCK = threading.Lock()
 
 
 def _decode_pool():
     """Shared decode thread pool — per-call pools would pay thread spin-up on
-    every scan."""
+    every scan. Init is locked: serving workers scan concurrently, and a
+    double-create here leaked a whole thread pool."""
     global _DECODE_POOL
     if _DECODE_POOL is None:
-        from concurrent.futures import ThreadPoolExecutor
+        with _DECODE_POOL_LOCK:
+            if _DECODE_POOL is None:
+                from concurrent.futures import ThreadPoolExecutor
 
-        _DECODE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="hs-decode")
+                _DECODE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="hs-decode")
     return _DECODE_POOL
 
 

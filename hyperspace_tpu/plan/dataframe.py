@@ -133,11 +133,9 @@ class DataFrame:
 
     # --- actions -----------------------------------------------------------
     def optimized_plan(self) -> L.LogicalPlan:
-        if self.session.hyperspace_enabled:
-            from hyperspace_tpu.rules.apply import ApplyHyperspace
+        from hyperspace_tpu.rules.apply import optimize_plan
 
-            return ApplyHyperspace(self.session).apply(self.plan)
-        return self.plan
+        return optimize_plan(self.plan, self.session)
 
     def collect(self) -> Dict[str, np.ndarray]:
         """Execute and return columns as numpy arrays.

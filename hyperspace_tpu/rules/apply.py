@@ -65,6 +65,18 @@ def _collect_subqueries(e: Expr) -> List[SubqueryExpr]:
     return out
 
 
+def optimize_plan(plan: L.LogicalPlan, session, enabled: Optional[bool] = None) -> L.LogicalPlan:
+    """The one optimizer entry point shared by ad-hoc execution
+    (``DataFrame.optimized_plan``) and the serving plan cache: apply the
+    hyperspace rewrite when the toggle (or the explicit ``enabled`` override
+    captured at request-submit time) says so, else hand the plan back."""
+    if enabled is None:
+        enabled = session.hyperspace_enabled
+    if not enabled:
+        return plan
+    return ApplyHyperspace(session).apply(plan)
+
+
 class ApplyHyperspace:
     def __init__(self, session, analysis_enabled: bool = False):
         self.session = session

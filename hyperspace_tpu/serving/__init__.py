@@ -1,0 +1,51 @@
+"""Query-serving runtime: concurrent request admission, compiled-plan
+caching, micro-batched execution, and hot-bucket prefetch over one Session.
+
+Entry point::
+
+    from hyperspace_tpu.serving import QueryServer
+
+    with QueryServer(session) as server:
+        fut = server.submit("SELECT name FROM t WHERE price > 5")
+        rows = fut.result()
+        print(server.stats())
+
+See docs/serving.md for the architecture and ``hyperspace.serving.*``
+configuration keys.
+"""
+
+from hyperspace_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    RequestTimeout,
+    ServerClosed,
+)
+from hyperspace_tpu.serving.bucket_cache import BucketCache
+from hyperspace_tpu.serving.fingerprint import (
+    Fingerprint,
+    Unparameterizable,
+    bind_literals,
+    canonical_form,
+    plan_fingerprint,
+)
+from hyperspace_tpu.serving.metrics import ServingMetrics
+from hyperspace_tpu.serving.plan_cache import CompiledPlan, PlanCache, session_token
+from hyperspace_tpu.serving.server import QueryServer
+
+__all__ = [
+    "QueryServer",
+    "AdmissionController",
+    "AdmissionRejected",
+    "RequestTimeout",
+    "ServerClosed",
+    "BucketCache",
+    "PlanCache",
+    "CompiledPlan",
+    "ServingMetrics",
+    "Fingerprint",
+    "plan_fingerprint",
+    "canonical_form",
+    "bind_literals",
+    "Unparameterizable",
+    "session_token",
+]

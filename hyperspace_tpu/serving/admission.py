@@ -1,0 +1,90 @@
+"""Admission control: a bounded request queue with explicit backpressure.
+
+A request that cannot be queued is *rejected immediately* with
+``AdmissionRejected`` — the caller learns the system is saturated instead of
+piling work onto an unbounded queue. Each request carries a deadline; workers
+drop a request whose deadline passed while it sat in the queue (the client
+already gave up) and resolve its future with ``RequestTimeout``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class AdmissionRejected(RuntimeError):
+    """Queue full at submit time — back off and retry."""
+
+
+class RequestTimeout(TimeoutError):
+    """The request's deadline expired before a result was produced."""
+
+
+class ServerClosed(RuntimeError):
+    """Submit after shutdown."""
+
+
+class AdmissionController:
+    """Thread-safe bounded queue + rejection/timeout accounting."""
+
+    def __init__(self, depth: int, default_timeout: Optional[float]):
+        if depth <= 0:
+            raise ValueError("queue depth must be positive")
+        self.depth = int(depth)
+        self.default_timeout = default_timeout
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._lock = threading.Lock()
+        self.submitted = 0
+        self.rejected = 0
+        self.timeouts = 0
+
+    def deadline_for(self, timeout: Optional[float]) -> Optional[float]:
+        t = self.default_timeout if timeout is None else timeout
+        return None if t is None else time.monotonic() + float(t)
+
+    def submit(self, item) -> None:
+        """Enqueue or reject — never blocks."""
+        try:
+            self._q.put_nowait(item)
+        except queue.Full:
+            with self._lock:
+                self.rejected += 1
+            raise AdmissionRejected(
+                f"serving queue full (depth={self.depth}); retry later"
+            ) from None
+        with self._lock:
+            self.submitted += 1
+
+    def take(self, timeout: float = 0.1):
+        """Dequeue one item for a worker; None on idle timeout."""
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def take_nowait(self):
+        try:
+            return self._q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def record_timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    @property
+    def queued(self) -> int:
+        return self._q.qsize()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "depth": self.depth,
+                "queued": self.queued,
+                "submitted": self.submitted,
+                "rejected": self.rejected,
+                "timeouts": self.timeouts,
+            }

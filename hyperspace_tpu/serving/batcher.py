@@ -1,0 +1,81 @@
+"""Micro-batching: coalesce compatible filter-scan requests into one scan.
+
+Requests sharing a parameterized template whose shape is a linear
+Project/Filter chain over one scan leaf (Scan / FileScan / IndexScan — the
+canonical index-filter-scan shape) execute as ONE batch: the leaf is decoded
+once, then each request applies its own bound predicates as masks over the
+shared in-memory batch. N concurrent point-lookups against the same covering
+index cost one bucket decode instead of N.
+
+Requests that don't fit the shape (joins, aggregates, subqueries,
+``input_file_name()`` predicates) simply execute individually — batching is
+an optimization, never a semantic gate.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from hyperspace_tpu.exec import batch as B
+from hyperspace_tpu.plan import logical as L
+from hyperspace_tpu.plan.expr import as_bool_mask
+
+
+def shared_scan_ops(template: L.LogicalPlan) -> Optional[Tuple[List[tuple], L.LogicalPlan]]:
+    """Decompose ``template`` into (root->leaf op list, scan leaf) when it is
+    a batchable linear chain with at least one Filter; None otherwise."""
+    ops: List[tuple] = []
+    p = template
+    n_filters = 0
+    while True:
+        if isinstance(p, (L.Scan, L.FileScan, L.IndexScan)):
+            if n_filters == 0:
+                return None  # nothing literal-varying to share
+            return ops, p
+        if isinstance(p, L.Project):
+            ops.append(("project", list(p.columns)))
+            p = p.child
+        elif isinstance(p, L.Filter):
+            ops.append(("filter", None))
+            n_filters += 1
+            p = p.child
+        else:
+            return None
+
+
+def _bound_conditions(bound_plan: L.LogicalPlan) -> List:
+    """Filter conditions of a bound chain, root->leaf order (mirrors the op
+    list from ``shared_scan_ops``)."""
+    out = []
+    p = bound_plan
+    while not isinstance(p, (L.Scan, L.FileScan, L.IndexScan)):
+        if isinstance(p, L.Filter):
+            out.append(p.condition)
+        p = p.child
+    return out
+
+
+def execute_shared_scan(
+    session,
+    ops: List[tuple],
+    leaf: L.LogicalPlan,
+    bound_plans: List[L.LogicalPlan],
+) -> List[B.Batch]:
+    """One leaf decode, then per-request mask/project over the shared batch.
+    Returns one result batch per bound plan, in order."""
+    from hyperspace_tpu.exec.executor import Executor
+
+    base = Executor(session).execute(leaf, prepruned=True)
+    results = []
+    for bound in bound_plans:
+        conds = _bound_conditions(bound)
+        ci = len(conds)
+        batch = base
+        for kind, payload in reversed(ops):  # leaf -> root
+            if kind == "filter":
+                ci -= 1
+                batch = B.mask_rows(batch, as_bool_mask(conds[ci].eval(batch)))
+            else:
+                batch = B.select(batch, payload)
+        results.append(batch)
+    return results

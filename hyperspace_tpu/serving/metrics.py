@@ -1,0 +1,64 @@
+"""Serving metrics: counters + latency percentiles, exported to telemetry.
+
+Latencies keep a bounded reservoir (most recent N) so long-running servers
+report *current* tail behavior without unbounded memory. ``snapshot`` merges
+in the queue / plan-cache / bucket-cache stats so one call yields the whole
+serving picture; ``QueryServer.stats(emit=True)`` wraps it in a
+``ServingStatsEvent`` on the session's telemetry sink.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Dict, Optional
+
+import numpy as np
+
+
+class ServingMetrics:
+    def __init__(self, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=int(latency_window))
+        self.completed = 0
+        self.errors = 0
+        self.batches = 0
+        self.batched_requests = 0
+
+    def observe(self, latency_s: float, error: bool = False) -> None:
+        with self._lock:
+            self._lat.append(float(latency_s))
+            if error:
+                self.errors += 1
+            else:
+                self.completed += 1
+
+    def observe_batch(self, n_requests: int) -> None:
+        with self._lock:
+            self.batches += 1
+            self.batched_requests += int(n_requests)
+
+    def latency_percentiles(self) -> Dict[str, Optional[float]]:
+        with self._lock:
+            lat = list(self._lat)
+        if not lat:
+            return {"p50": None, "p95": None, "p99": None}
+        p50, p95, p99 = np.percentile(np.asarray(lat), [50, 95, 99])
+        return {"p50": float(p50), "p95": float(p95), "p99": float(p99)}
+
+    def snapshot(self, admission=None, plan_cache=None, bucket_cache=None) -> dict:
+        with self._lock:
+            out = {
+                "completed": self.completed,
+                "errors": self.errors,
+                "batches": self.batches,
+                "batchedRequests": self.batched_requests,
+            }
+        out["latencySeconds"] = self.latency_percentiles()
+        if admission is not None:
+            out["queue"] = admission.stats()
+        if plan_cache is not None:
+            out["planCache"] = plan_cache.stats()
+        if bucket_cache is not None:
+            out["bucketCache"] = bucket_cache.stats()
+        return out

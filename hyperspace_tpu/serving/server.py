@@ -1,0 +1,385 @@
+"""QueryServer: the concurrent serving front-end over one Session.
+
+Request life cycle::
+
+    submit(sql or DataFrame)          caller thread
+      parse (text-memoized) -> fingerprint -> admission (bounded queue,
+      reject on overflow) -> prefetch hint for a known template's buckets
+    worker thread
+      drain a micro-batch -> plan-cache lookup (exact / parameterized bind)
+      or compile+insert -> execute (shared-scan batch when compatible)
+      -> relabel to the request's aliases -> resolve the Future
+
+Results are identical to ``session.sql(q).collect()`` — the cache and the
+batcher are throughput optimizations, never semantic changes. Each request
+captures the session's hyperspace flag at submit time and workers pin it via
+``session.hyperspace_scope`` so a toggle racing the queue can't leak into
+requests admitted before it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional
+
+from hyperspace_tpu.serving.admission import (
+    AdmissionController,
+    AdmissionRejected,
+    RequestTimeout,
+    ServerClosed,
+)
+from hyperspace_tpu.serving.batcher import execute_shared_scan, shared_scan_ops
+from hyperspace_tpu.serving.bucket_cache import BucketCache
+from hyperspace_tpu.serving.fingerprint import Fingerprint, plan_fingerprint
+from hyperspace_tpu.serving.metrics import ServingMetrics
+from hyperspace_tpu.serving.plan_cache import CompiledPlan, PlanCache, session_token
+
+__all__ = ["QueryServer", "AdmissionRejected", "RequestTimeout", "ServerClosed"]
+
+
+class _Request:
+    __slots__ = (
+        "plan", "fp", "token", "enabled", "future", "deadline", "submitted_at",
+    )
+
+    def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline):
+        self.plan = plan
+        self.fp = fp
+        self.token = token
+        self.enabled = enabled
+        self.future: "Future" = Future()
+        self.deadline = deadline
+        self.submitted_at = time.monotonic()
+
+    def expired(self) -> bool:
+        return self.deadline is not None and time.monotonic() > self.deadline
+
+    @property
+    def group_key(self):
+        return (self.token, self.fp.structure)
+
+
+class QueryServer:
+    """Concurrent query-serving runtime over a :class:`Session`.
+
+    Constructor keyword overrides (each defaulting to its
+    ``hyperspace.serving.*`` conf key): ``queue_depth``, ``workers``,
+    ``default_timeout``, ``plan_cache_enabled``, ``plan_cache_max_entries``,
+    ``micro_batch_enabled``, ``micro_batch_max_requests``,
+    ``micro_batch_max_wait_ms``, ``bucket_cache_bytes``,
+    ``prefetch_enabled``, ``prefetch_workers``.
+    """
+
+    def __init__(self, session, **overrides):
+        conf = session.conf
+        self.session = session
+
+        def opt(name, conf_value):
+            v = overrides.pop(name, None)
+            return conf_value if v is None else v
+
+        self.workers_n = int(opt("workers", conf.serving_workers))
+        self.plan_cache_enabled = bool(opt("plan_cache_enabled", conf.serving_plan_cache_enabled))
+        self.micro_batch_enabled = bool(opt("micro_batch_enabled", conf.serving_micro_batch_enabled))
+        self.micro_batch_max = int(opt("micro_batch_max_requests", conf.serving_micro_batch_max_requests))
+        self.micro_batch_wait_s = float(opt("micro_batch_max_wait_ms", conf.serving_micro_batch_max_wait_ms)) / 1000.0
+        self.prefetch_enabled = bool(opt("prefetch_enabled", conf.serving_prefetch_enabled))
+
+        self.admission = AdmissionController(
+            depth=int(opt("queue_depth", conf.serving_queue_depth)),
+            default_timeout=opt("default_timeout", conf.serving_default_timeout_seconds),
+        )
+        self.plan_cache = PlanCache(int(opt("plan_cache_max_entries", conf.serving_plan_cache_max_entries)))
+        self.bucket_cache = BucketCache(
+            int(opt("bucket_cache_bytes", conf.serving_bucket_cache_bytes)),
+            prefetch_workers=int(opt("prefetch_workers", conf.serving_prefetch_workers)),
+        )
+        self.metrics = ServingMetrics()
+        if overrides:
+            raise TypeError(f"Unknown QueryServer options: {sorted(overrides)}")
+
+        self._sql_memo_lock = threading.Lock()
+        self._sql_memo: Dict[str, tuple] = {}
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._started = False
+        self._closed = False
+        self._prev_bucket_cache = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "QueryServer":
+        if self._started:
+            return self
+        self._started = True
+        # executor-side scans consult session.bucket_cache when present
+        self._prev_bucket_cache = getattr(self.session, "bucket_cache", None)
+        self.session.bucket_cache = self.bucket_cache
+        for i in range(self.workers_n):
+            t = threading.Thread(target=self._worker, name=f"hs-serve-{i}", daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def shutdown(self, wait: bool = True) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        if wait:
+            for t in self._threads:
+                t.join(timeout=10)
+        # drain anything still queued so no future is left dangling
+        while True:
+            req = self.admission.take_nowait()
+            if req is None:
+                break
+            if not req.future.done():
+                req.future.set_exception(ServerClosed("server shut down"))
+        self.bucket_cache.shutdown()
+        self.session.bucket_cache = self._prev_bucket_cache
+
+    def __enter__(self) -> "QueryServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, query: Any, timeout: Optional[float] = None) -> "Future":
+        """Admit a query (SQL text or DataFrame) and return a Future yielding
+        the collected batch (dict of numpy arrays, like ``collect()``).
+        Raises :class:`AdmissionRejected` immediately when the queue is full
+        and :class:`ServerClosed` after shutdown."""
+        if self._closed or not self._started:
+            raise ServerClosed("server is not running (call start() or use as a context manager)")
+        enabled = bool(self.session.hyperspace_enabled)
+        plan, fp = self._parse(query)
+        token = session_token(self.session, enabled)
+        req = _Request(plan, fp, token, enabled, self.admission.deadline_for(timeout))
+        try:
+            self.admission.submit(req)  # raises AdmissionRejected on overflow
+        except AdmissionRejected:
+            from hyperspace_tpu.telemetry.events import ServingRejectionEvent, get_event_logger
+
+            get_event_logger(self.session).log_event(
+                ServingRejectionEvent(
+                    queue_depth=self.admission.depth, queued=self.admission.queued
+                )
+            )
+            raise
+        if self.prefetch_enabled:
+            self._prefetch_hint(token, fp)
+        return req.future
+
+    def query(self, query: Any, timeout: Optional[float] = None) -> Dict[str, Any]:
+        """Blocking convenience wrapper around :meth:`submit`."""
+        fut = self.submit(query, timeout=timeout)
+        t = self.admission.default_timeout if timeout is None else timeout
+        # Future.result timeout is a backstop; the worker resolves the future
+        # with RequestTimeout at the deadline itself
+        return fut.result(timeout=None if t is None else t + 5.0)
+
+    def _parse(self, query: Any):
+        if isinstance(query, str):
+            with self._sql_memo_lock:
+                hit = self._sql_memo.get(query)
+            if hit is not None:
+                return hit
+            df = self.session.sql(query)
+            plan = df.plan
+            fp = plan_fingerprint(plan)
+            with self._sql_memo_lock:
+                if len(self._sql_memo) >= 1024:  # text memo is a bounded side-table
+                    self._sql_memo.clear()
+                self._sql_memo[query] = (plan, fp)
+            return plan, fp
+        plan = getattr(query, "plan", query)
+        return plan, plan_fingerprint(plan)
+
+    def _prefetch_hint(self, token, fp: Fingerprint) -> None:
+        entry = self.plan_cache_entry(token, fp)
+        if entry is None:
+            return
+        from hyperspace_tpu.plan import logical as L
+
+        for leaf in L.collect(
+            entry.template, lambda p: isinstance(p, (L.IndexScan, L.FileScan))
+        ):
+            if leaf.files:
+                cols = (
+                    leaf.file_columns
+                    if getattr(leaf, "file_columns", None) is not None
+                    else list(leaf.columns)
+                )
+                self.bucket_cache.prefetch(list(leaf.files), list(cols))
+
+    def plan_cache_entry(self, token, fp: Fingerprint) -> Optional[CompiledPlan]:
+        """Peek (no hit/miss accounting) at the template a request would use."""
+        with self.plan_cache._lock:
+            got = self.plan_cache._entries.get(("exact", token, fp.exact))
+            if got is None:
+                got = self.plan_cache._entries.get(("param", token, fp.structure))
+        return got
+
+    # -- worker loop ---------------------------------------------------------
+    def _worker(self) -> None:
+        while not self._stop.is_set():
+            first = self.admission.take(timeout=0.05)
+            if first is None:
+                continue
+            group = [first]
+            if self.micro_batch_enabled and self.micro_batch_max > 1:
+                waited = 0.0
+                while len(group) < self.micro_batch_max:
+                    nxt = self.admission.take_nowait()
+                    if nxt is None:
+                        if waited >= self.micro_batch_wait_s or self.admission.queued == 0:
+                            break
+                        time.sleep(min(0.001, self.micro_batch_wait_s - waited))
+                        waited += 0.001
+                        continue
+                    group.append(nxt)
+            self._process_group(group)
+
+    def _process_group(self, group: List[_Request]) -> None:
+        # coalesce by (token, structure); order within a key is preserved
+        by_key: Dict[tuple, List[_Request]] = {}
+        for r in group:
+            by_key.setdefault(r.group_key, []).append(r)
+        for reqs in by_key.values():
+            self._process_same_key(reqs)
+
+    def _process_same_key(self, reqs: List[_Request]) -> None:
+        live = []
+        for r in reqs:
+            if r.expired():
+                self.admission.record_timeout()
+                if not r.future.done():
+                    r.future.set_exception(RequestTimeout("deadline expired in queue"))
+            else:
+                live.append(r)
+        if not live:
+            return
+        try:
+            self._execute_requests(live)
+        except Exception as exc:  # defensive: never kill a worker thread
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+                    self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+
+    def _execute_requests(self, reqs: List[_Request]) -> None:
+        from hyperspace_tpu.exec.executor import Executor
+
+        resolved = []  # (req, bound_plan, entry or None)
+        for r in reqs:
+            try:
+                resolved.append((r, *self._resolve(r)))
+            except Exception as exc:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+                    self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+
+        # shared-scan micro-batch: >1 request on the same parameterized
+        # template whose shape is a filter chain over one scan
+        if len(resolved) > 1:
+            entry = resolved[0][2]
+            if (
+                entry is not None
+                and entry.parameterizable
+                and all(e is entry for _, _, e in resolved)
+            ):
+                ops_leaf = shared_scan_ops(entry.template)
+                if ops_leaf is not None:
+                    ops, leaf = ops_leaf
+                    with self.session.hyperspace_scope(resolved[0][0].enabled):
+                        batches = execute_shared_scan(
+                            self.session, ops, leaf, [b for _, b, _ in resolved]
+                        )
+                    self.metrics.observe_batch(len(resolved))
+                    for (r, _, e), batch in zip(resolved, batches):
+                        self._finish(r, batch, e)
+                    return
+
+        for r, bound, entry in resolved:
+            if r.expired():
+                self.admission.record_timeout()
+                if not r.future.done():
+                    r.future.set_exception(RequestTimeout("deadline expired before execution"))
+                continue
+            try:
+                with self.session.hyperspace_scope(r.enabled):
+                    out_cols = list(entry.output_columns) if entry is not None else list(bound.output_columns)
+                    batch = Executor(self.session).execute(
+                        bound, required_columns=out_cols, prepruned=entry is not None
+                    )
+                self._finish(r, batch, entry)
+            except Exception as exc:
+                if not r.future.done():
+                    r.future.set_exception(exc)
+                    self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+
+    def _resolve(self, r: _Request):
+        """(bound plan, cache entry or None). A None entry means the plan was
+        compiled ad hoc (cache disabled) and carries the request's own
+        literals and aliases."""
+        if not self.plan_cache_enabled:
+            return self._compile(r), None
+        hit = self.plan_cache.lookup(r.token, r.fp)
+        if hit is not None:
+            return hit[0], hit[1]
+        template = self._compile(r)
+        entry = self.plan_cache.insert(r.token, r.fp, template)
+        return template, entry
+
+    def _compile(self, r: _Request):
+        """Optimize + prune once — the expensive work the cache amortizes."""
+        from hyperspace_tpu.rules.apply import optimize_plan
+        from hyperspace_tpu.rules.utils import prune_columns
+
+        with self.session.hyperspace_scope(r.enabled):
+            plan = optimize_plan(r.plan, self.session, enabled=r.enabled)
+        try:
+            return prune_columns(plan)
+        except Exception:
+            return plan
+
+    def _finish(self, r: _Request, batch, entry: Optional[CompiledPlan]) -> None:
+        if entry is not None and tuple(entry.output_columns) != tuple(r.fp.output_columns):
+            # template carries the FIRST request's aliases; relabel
+            # positionally to this request's output names
+            batch = {
+                want: batch[have]
+                for want, have in zip(r.fp.output_columns, entry.output_columns)
+            }
+        else:
+            batch = {c: batch[c] for c in r.fp.output_columns}
+        if not r.future.done():
+            r.future.set_result(batch)
+            self.metrics.observe(time.monotonic() - r.submitted_at)
+
+    # -- observability -------------------------------------------------------
+    def stats(self, emit: bool = False) -> dict:
+        snap = self.metrics.snapshot(
+            admission=self.admission,
+            plan_cache=self.plan_cache if self.plan_cache_enabled else None,
+            bucket_cache=self.bucket_cache,
+        )
+        if emit:
+            from hyperspace_tpu.telemetry.events import ServingStatsEvent, get_event_logger
+
+            get_event_logger(self.session).log_event(
+                ServingStatsEvent(
+                    queue_depth=snap["queue"]["queued"],
+                    rejected=snap["queue"]["rejected"],
+                    plan_cache_hit_rate=snap.get("planCache", {}).get("hitRate", 0.0),
+                    bucket_cache_hit_rate=snap["bucketCache"]["hitRate"],
+                    latency_p50=snap["latencySeconds"]["p50"],
+                    latency_p95=snap["latencySeconds"]["p95"],
+                    latency_p99=snap["latencySeconds"]["p99"],
+                    completed=snap["completed"],
+                )
+            )
+        return snap

@@ -12,6 +12,7 @@ shard (SURVEY.md §5.8).
 from __future__ import annotations
 
 import contextlib
+import contextvars
 from typing import Any, Dict, List, Optional
 
 from hyperspace_tpu.config import HyperspaceConf
@@ -35,6 +36,12 @@ class Session:
         ensure_x64()
         self.conf = HyperspaceConf(conf)
         self.provider_manager = FileBasedSourceProviderManager(self)
+        # context-local override beats the session-wide default, so a scoped
+        # toggle (with_hyperspace_disabled, a serving worker pinning the flag
+        # captured at submit) never leaks into queries racing on other threads
+        self._hyperspace_override: contextvars.ContextVar = contextvars.ContextVar(
+            "hyperspace_enabled_override", default=None
+        )
         self.hyperspace_enabled = False
         self._index_manager = None
         self._mesh = None
@@ -95,6 +102,15 @@ class Session:
         self._temp_views.pop(name, None)
 
     # --- hyperspace toggle (ref: HS/package.scala:36-43) -------------------
+    @property
+    def hyperspace_enabled(self) -> bool:
+        override = self._hyperspace_override.get()
+        return self._hyperspace_default if override is None else override
+
+    @hyperspace_enabled.setter
+    def hyperspace_enabled(self, value: bool) -> None:
+        self._hyperspace_default = bool(value)
+
     def enable_hyperspace(self) -> "Session":
         self.hyperspace_enabled = True
         return self
@@ -118,13 +134,18 @@ class Session:
         return self.is_hyperspace_enabled()
 
     @contextlib.contextmanager
-    def with_hyperspace_disabled(self):
-        prev = self.hyperspace_enabled
-        self.hyperspace_enabled = False
+    def hyperspace_scope(self, enabled: bool):
+        """Pin the hyperspace flag for this thread/context only. Other threads
+        (and requests queued behind this one) keep the session default —
+        unlike mutating the flag, which raced under concurrent queries."""
+        token = self._hyperspace_override.set(bool(enabled))
         try:
-            yield
+            yield self
         finally:
-            self.hyperspace_enabled = prev
+            self._hyperspace_override.reset(token)
+
+    def with_hyperspace_disabled(self):
+        return self.hyperspace_scope(False)
 
     # --- index manager ------------------------------------------------------
     @property

@@ -9,6 +9,7 @@ from conf ``hyperspace.eventLoggerClass`` with a NoOp default
 from __future__ import annotations
 
 import importlib
+import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -85,6 +86,29 @@ class HyperspaceIndexUsageEvent(HyperspaceEvent):
     plan_summary: str = ""
 
 
+@dataclass
+class ServingStatsEvent(HyperspaceEvent):
+    """Periodic serving-runtime snapshot (``QueryServer.stats(emit=True)``):
+    queue pressure, cache effectiveness, and latency tail."""
+
+    queue_depth: int = 0
+    rejected: int = 0
+    plan_cache_hit_rate: float = 0.0
+    bucket_cache_hit_rate: float = 0.0
+    latency_p50: Optional[float] = None
+    latency_p95: Optional[float] = None
+    latency_p99: Optional[float] = None
+    completed: int = 0
+
+
+@dataclass
+class ServingRejectionEvent(HyperspaceEvent):
+    """A request was rejected at admission (queue full, backpressure)."""
+
+    queue_depth: int = 0
+    queued: int = 0
+
+
 class EventLogger:
     def log_event(self, event: HyperspaceEvent) -> None:
         raise NotImplementedError
@@ -96,26 +120,39 @@ class NoOpEventLogger(EventLogger):
 
 
 class CollectingEventLogger(EventLogger):
-    """In-memory sink for tests (ref: MockEventLogger in TestUtils.scala:93-121)."""
+    """In-memory sink for tests (ref: MockEventLogger in TestUtils.scala:93-121).
+
+    Thread-safe: the serving runtime logs from worker threads concurrently,
+    and a bare ``list.append`` raced with ``reset``/snapshot reads."""
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.events: List[HyperspaceEvent] = []
 
     def log_event(self, event: HyperspaceEvent) -> None:
-        self.events.append(event)
+        with self._lock:
+            self.events.append(event)
+
+    def snapshot(self) -> List[HyperspaceEvent]:
+        """Consistent copy for readers racing concurrent log_event calls."""
+        with self._lock:
+            return list(self.events)
 
     def reset(self) -> None:
-        self.events = []
+        with self._lock:
+            self.events = []
 
 
 _cached: Dict[str, EventLogger] = {}
+_cached_lock = threading.Lock()
 
 
 def get_event_logger(session) -> EventLogger:
     cls_name: Optional[str] = session.conf.get("hyperspace.eventLoggerClass")
-    if not cls_name:
-        return _cached.setdefault("__noop__", NoOpEventLogger())
-    if cls_name not in _cached:
-        module_name, _, attr = cls_name.rpartition(".")
-        _cached[cls_name] = getattr(importlib.import_module(module_name), attr)()
-    return _cached[cls_name]
+    with _cached_lock:
+        if not cls_name:
+            return _cached.setdefault("__noop__", NoOpEventLogger())
+        if cls_name not in _cached:
+            module_name, _, attr = cls_name.rpartition(".")
+            _cached[cls_name] = getattr(importlib.import_module(module_name), attr)()
+        return _cached[cls_name]

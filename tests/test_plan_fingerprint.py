@@ -1,0 +1,215 @@
+"""Canonical plan-fingerprint properties over the TPC-H corpus.
+
+The serving plan cache keys on ``plan_fingerprint``: its correctness story is
+(a) *invariance* — alias-renamed and literal-varied plans share a structure
+hash so a template compiled once serves the whole family, and (b)
+*separation* — structurally different plans never collide, so a cache hit can
+never return the wrong program. Both directions are checked here against the
+same TPC-H fixture the gold-standard parity suite plans (all 22 query texts),
+plus targeted unit cases for the slot-alignment machinery.
+"""
+
+import itertools
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.serving.fingerprint import (
+    Fingerprint,
+    Unparameterizable,
+    bind_literals,
+    canonical_form,
+    plan_fingerprint,
+    slot_mapping,
+)
+from test_tpch_queries import build_tpch_env
+from tpch_queries import TPCH_QUERIES
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("fp_tpch"))
+    sess, frames = build_tpch_env(root)
+    yield sess
+    hst.set_session(None)
+
+
+@pytest.fixture(scope="module")
+def simple(tmp_path_factory):
+    root = tmp_path_factory.mktemp("fp_simple")
+    n = 100
+    pq.write_table(
+        pa.table(
+            {
+                "id": np.arange(n, dtype=np.int64),
+                "name": np.array([f"n{i % 7}" for i in range(n)]),
+                "price": (np.arange(n, dtype=np.int64) * 13) % 50,
+            }
+        ),
+        str(root / "t.parquet"),
+    )
+    sess = hst.Session()
+    sess.read_parquet(str(root / "t.parquet")).create_or_replace_temp_view("t")
+    return sess
+
+
+# --- invariance --------------------------------------------------------------
+
+
+def test_literal_variation_shares_structure(simple):
+    f5 = plan_fingerprint(simple.sql("SELECT name FROM t WHERE price > 5").plan)
+    f9 = plan_fingerprint(simple.sql("SELECT name FROM t WHERE price > 9").plan)
+    assert f5.structure == f9.structure
+    assert f5.slot_sigs == f9.slot_sigs
+    assert f5.literals == (5,) and f9.literals == (9,)
+    # exact keys still separate them — verbatim repeats hit the exact tier
+    assert f5.exact != f9.exact
+
+
+def test_alias_renaming_shares_structure(simple):
+    plain = plan_fingerprint(simple.sql("SELECT name FROM t WHERE price > 5").plan)
+    alias = plan_fingerprint(simple.sql("SELECT name AS x FROM t WHERE price > 5").plan)
+    alias2 = plan_fingerprint(simple.sql("SELECT name AS y FROM t WHERE price > 5").plan)
+    assert plain.structure == alias.structure == alias2.structure
+    assert plain.exact == alias.exact  # aliases don't even perturb the exact key
+    # ...but the output labels (used to relabel results) track each request
+    assert plain.output_columns == ("name",)
+    assert alias.output_columns == ("x",)
+    assert alias2.output_columns == ("y",)
+
+
+def test_alias_and_literal_combined(simple):
+    a = plan_fingerprint(simple.sql("SELECT name AS a, id FROM t WHERE price > 45").plan)
+    b = plan_fingerprint(simple.sql("SELECT name AS b, id FROM t WHERE price > 40").plan)
+    assert a.structure == b.structure
+    assert a.literals != b.literals
+
+
+def test_in_list_same_arity_shares_structure(simple):
+    a = plan_fingerprint(simple.sql("SELECT id FROM t WHERE price IN (1, 2)").plan)
+    b = plan_fingerprint(simple.sql("SELECT id FROM t WHERE price IN (3, 4)").plan)
+    c = plan_fingerprint(simple.sql("SELECT id FROM t WHERE price IN (1, 2, 3)").plan)
+    assert a.structure == b.structure
+    assert a.literals == (1, 2) and b.literals == (3, 4)
+    # arity is structural: a 3-element IN is a different program
+    assert a.structure != c.structure
+
+
+def test_fingerprint_deterministic(simple):
+    q = "SELECT name FROM t WHERE price > 5 AND id < 90"
+    f1 = plan_fingerprint(simple.sql(q).plan)
+    f2 = plan_fingerprint(simple.sql(q).plan)
+    assert f1 == f2
+    assert canonical_form(simple.sql(q).plan) == canonical_form(simple.sql(q).plan)
+
+
+# --- separation --------------------------------------------------------------
+
+
+def test_distinct_shapes_do_not_collide(simple):
+    queries = [
+        "SELECT name FROM t WHERE price > 5",
+        "SELECT id FROM t WHERE price > 5",
+        "SELECT name FROM t WHERE price < 5",
+        "SELECT name FROM t WHERE id > 5",
+        "SELECT name FROM t WHERE price > 5 AND id > 5",
+        "SELECT name FROM t",
+        "SELECT name, price FROM t WHERE price > 5",
+        "SELECT count(*) AS c FROM t WHERE price > 5",
+        "SELECT name FROM t WHERE price > 5 ORDER BY name",
+        "SELECT name FROM t WHERE price > 5 LIMIT 10",
+        "SELECT name FROM t WHERE price IN (5)",
+    ]
+    fps = [plan_fingerprint(simple.sql(q).plan) for q in queries]
+    for (qa, fa), (qb, fb) in itertools.combinations(zip(queries, fps), 2):
+        assert fa.structure != fb.structure, f"collision: {qa!r} vs {qb!r}"
+
+
+def test_tpch_corpus_no_collisions(env):
+    """All 22 TPC-H texts must land on 22 distinct structure hashes — the
+    whole benchmark family disagrees pairwise, so a plan-cache hit can never
+    cross queries."""
+    fps = {}
+    for qname, text in TPCH_QUERIES.items():
+        fps[qname] = plan_fingerprint(env.sql(text).plan)
+    structures = [f.structure for f in fps.values()]
+    assert len(set(structures)) == len(TPCH_QUERIES)
+    # exact keys are at least as fine-grained as structures
+    assert len({f.exact for f in fps.values()}) == len(TPCH_QUERIES)
+
+
+def test_tpch_fingerprints_stable_across_replans(env):
+    for qname, text in TPCH_QUERIES.items():
+        f1 = plan_fingerprint(env.sql(text).plan)
+        f2 = plan_fingerprint(env.sql(text).plan)
+        assert f1.structure == f2.structure, qname
+        assert f1.exact == f2.exact, qname
+
+
+# --- slot alignment + binding ------------------------------------------------
+
+
+def _fp(sigs, lits):
+    return Fingerprint(
+        structure="s",
+        literals=tuple(lits),
+        slot_sigs=tuple(sigs),
+        output_columns=("c",),
+        has_subquery=False,
+    )
+
+
+def test_slot_mapping_aligns_by_signature():
+    template = _fp(["F/a", "F/b"], [1, 2])
+    request = _fp(["F/b", "F/a"], [20, 10])  # reordered by the optimizer
+    assert slot_mapping(template, request) == [1, 0]
+
+
+def test_slot_mapping_rejects_ambiguity_and_gaps():
+    with pytest.raises(Unparameterizable):
+        slot_mapping(_fp(["F/a", "F/a"], [1, 2]), _fp(["F/a", "F/a"], [3, 4]))
+    with pytest.raises(Unparameterizable):  # template slot absent from request
+        slot_mapping(_fp(["F/a", "F/b"], [1, 2]), _fp(["F/a"], [3]))
+    with pytest.raises(Unparameterizable):  # request literal the template dropped
+        slot_mapping(_fp(["F/a"], [1]), _fp(["F/a", "F/b"], [3, 4]))
+
+
+def test_bind_literals_round_trip(simple):
+    p5 = simple.sql("SELECT name FROM t WHERE price > 5 AND id < 90").plan
+    p9 = simple.sql("SELECT name FROM t WHERE price > 9 AND id < 70").plan
+    f5, f9 = plan_fingerprint(p5), plan_fingerprint(p9)
+    mapping = slot_mapping(f5, f9)
+    bound = bind_literals(p5, [f9.literals[j] for j in mapping])
+    assert plan_fingerprint(bound).exact == f9.exact
+    # and the bound plan executes to the other query's answer
+    from hyperspace_tpu.exec.executor import Executor
+
+    got = Executor(simple).execute(bound, required_columns=["name"])
+    want = simple.sql("SELECT name FROM t WHERE price > 9 AND id < 70").collect()
+    assert np.array_equal(got["name"], want["name"])
+
+
+def test_bind_literals_count_mismatch_raises(simple):
+    p = simple.sql("SELECT name FROM t WHERE price > 5").plan
+    with pytest.raises(Unparameterizable):
+        bind_literals(p, [1, 2, 3])
+
+
+def test_subquery_plans_are_exact_only(env):
+    # q17-style scalar subquery: literals inside the inner plan are structural
+    text = (
+        "SELECT s_name FROM supplier WHERE s_acctbal > "
+        "(SELECT avg(s_acctbal) FROM supplier WHERE s_suppkey < 20)"
+    )
+    text2 = (
+        "SELECT s_name FROM supplier WHERE s_acctbal > "
+        "(SELECT avg(s_acctbal) FROM supplier WHERE s_suppkey < 30)"
+    )
+    f1 = plan_fingerprint(env.sql(text).plan)
+    f2 = plan_fingerprint(env.sql(text2).plan)
+    assert f1.has_subquery and f2.has_subquery
+    # differing inner literals => different structures (no unsound sharing)
+    assert f1.structure != f2.structure
