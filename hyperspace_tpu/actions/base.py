@@ -24,7 +24,7 @@ from hyperspace_tpu.models import states
 from hyperspace_tpu.models.data_manager import IndexDataManager
 from hyperspace_tpu.models.log_entry import IndexLogEntry
 from hyperspace_tpu.models.log_manager import IndexLogManager
-from hyperspace_tpu.telemetry.events import ActionEvent, get_event_logger
+from hyperspace_tpu.telemetry.events import ActionEvent, emit_event
 
 
 class HyperspaceActionException(Exception):
@@ -120,8 +120,9 @@ class Action:
 
     # --- protocol ----------------------------------------------------------
     def _emit(self, state: str, message: str = "") -> None:
-        get_event_logger(self.session).log_event(
-            self.event_class(index_name=self.index_name, state=state, message=message)
+        emit_event(
+            self.session,
+            self.event_class(index_name=self.index_name, state=state, message=message),
         )
 
     def run(self) -> IndexLogEntry:

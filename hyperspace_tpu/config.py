@@ -65,6 +65,14 @@ class keys:
     SERVING_BUCKET_CACHE_BYTES = "hyperspace.serving.bucketCache.bytes"
     SERVING_PREFETCH_ENABLED = "hyperspace.serving.prefetch.enabled"
     SERVING_PREFETCH_WORKERS = "hyperspace.serving.prefetch.workers"
+    # Observability (hyperspace_tpu/obs/): span tracing, metrics registry,
+    # query profiles. Tracing is opt-in; metrics are always-on (bumping a
+    # counter is cheaper than checking whether to).
+    OBS_TRACING_ENABLED = "hyperspace.obs.tracing.enabled"
+    OBS_TRACE_MAX_SPANS = "hyperspace.obs.trace.maxSpans"
+    OBS_METRICS_ENABLED = "hyperspace.obs.metrics.enabled"
+    OBS_PROFILE_HISTORY = "hyperspace.obs.profile.history"
+    OBS_PROFILE_WHY_NOT = "hyperspace.obs.profile.whyNot"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -165,6 +173,18 @@ DEFAULTS: Dict[str, Any] = {
     keys.SERVING_BUCKET_CACHE_BYTES: 1 << 30,
     keys.SERVING_PREFETCH_ENABLED: True,
     keys.SERVING_PREFETCH_WORKERS: 2,
+    # Span tracing is opt-in: when off, each instrumentation point costs one
+    # contextvar read (bench.py --obs-overhead pins the bar at <= 3%).
+    keys.OBS_TRACING_ENABLED: False,
+    # Per-trace span budget; beyond it the tree stops growing and the trace
+    # reports droppedSpans (bounded memory under pathological plans).
+    keys.OBS_TRACE_MAX_SPANS: 100_000,
+    keys.OBS_METRICS_ENABLED: True,
+    # How many per-request QueryProfiles a QueryServer retains.
+    keys.OBS_PROFILE_HISTORY: 16,
+    # Run the why-not analysis on traced queries (extra optimizer passes per
+    # query — diagnostic sessions only).
+    keys.OBS_PROFILE_WHY_NOT: False,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -382,6 +402,27 @@ class HyperspaceConf:
     @property
     def serving_prefetch_workers(self) -> int:
         return int(self.get(keys.SERVING_PREFETCH_WORKERS))
+
+    # Observability ----------------------------------------------------------
+    @property
+    def obs_tracing_enabled(self) -> bool:
+        return bool(self.get(keys.OBS_TRACING_ENABLED))
+
+    @property
+    def obs_trace_max_spans(self) -> int:
+        return int(self.get(keys.OBS_TRACE_MAX_SPANS))
+
+    @property
+    def obs_metrics_enabled(self) -> bool:
+        return bool(self.get(keys.OBS_METRICS_ENABLED))
+
+    @property
+    def obs_profile_history(self) -> int:
+        return int(self.get(keys.OBS_PROFILE_HISTORY))
+
+    @property
+    def obs_profile_why_not(self) -> bool:
+        return bool(self.get(keys.OBS_PROFILE_WHY_NOT))
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

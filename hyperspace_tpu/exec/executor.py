@@ -18,6 +18,7 @@ import pyarrow.dataset as pads
 
 from hyperspace_tpu.exec import batch as B
 from hyperspace_tpu.exec import trace
+from hyperspace_tpu.obs import spans
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.plan.expr import (
     INPUT_FILE_NAME,
@@ -568,6 +569,24 @@ class Executor:
         return self._exec_inner(plan, with_file_names)
 
     def _exec_inner(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
+        # per-operator span: node-type name + result rows/bytes. One
+        # contextvar read on the disabled path (spans.span returns the shared
+        # null CM), so this sits on the recursion unconditionally.
+        cm = spans.span(type(plan).__name__, cat="exec")
+        if cm is spans._NULL_CM:
+            return self._exec_node(plan, with_file_names)
+        with cm as sp:
+            batch = self._exec_node(plan, with_file_names)
+            try:
+                sp.set(
+                    rows=B.num_rows(batch),
+                    bytes=int(sum(getattr(a, "nbytes", 0) for a in batch.values())),
+                )
+            except Exception:
+                pass
+            return batch
+
+    def _exec_node(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
         if isinstance(plan, L.Scan):
             return self._exec_scan(plan, with_file_names)
 

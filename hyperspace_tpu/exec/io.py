@@ -21,6 +21,7 @@ import pyarrow.parquet as pq
 
 from hyperspace_tpu.exec import batch as B
 from hyperspace_tpu.exec import trace
+from hyperspace_tpu.obs import spans
 
 # ---------------------------------------------------------------------------
 # Per-file decoded-batch cache (the framework's buffer pool). Spark gets this
@@ -227,29 +228,31 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
                 return _dataset_read()
 
     def read_one(f: str, schema) -> B.Batch:
-        ckey = _io_cache_key(f, columns)
-        got = _io_cache_get(ckey)
-        if got is not None:
-            trace.record("decode", "cached")
-            return got
-        try:
-            cols = list(columns) if columns is not None else list(schema.names)
-            hints = _dtype_hints(schema, cols)
-            got = native.read_columns(f, cols, hints) if hints is not None else None
-        except (native.NativeUnsupported, OSError, KeyError) as e:
-            if os.environ.get("HS_DEBUG_DECODE_FALLBACK"):
-                import sys
+        with spans.span("decode", cat="io", file=os.path.basename(f)) as dsp:
+            ckey = _io_cache_key(f, columns)
+            got = _io_cache_get(ckey)
+            if got is not None:
+                trace.record("decode", "cached")
+                return got
+            try:
+                cols = list(columns) if columns is not None else list(schema.names)
+                hints = _dtype_hints(schema, cols)
+                got = native.read_columns(f, cols, hints) if hints is not None else None
+            except (native.NativeUnsupported, OSError, KeyError) as e:
+                if os.environ.get("HS_DEBUG_DECODE_FALLBACK"):
+                    import sys
 
-                print(f"DECODE-FALLBACK {f}: {type(e).__name__}: {e}", file=sys.stderr)
-            got = None
-        if got is None:
-            trace.record("decode", "pyarrow")
-            t = pads.dataset([f], format="parquet").to_table(columns=columns)
-            got = B.table_to_batch(t)
-        else:
-            trace.record("decode", "native")
-        _io_cache_put(ckey, got)
-        return got
+                    print(f"DECODE-FALLBACK {f}: {type(e).__name__}: {e}", file=sys.stderr)
+                got = None
+            if got is None:
+                trace.record("decode", "pyarrow")
+                t = pads.dataset([f], format="parquet").to_table(columns=columns)
+                got = B.table_to_batch(t)
+            else:
+                trace.record("decode", "native")
+            dsp.set(rows=B.num_rows(got))
+            _io_cache_put(ckey, got)
+            return got
 
     # decode files concurrently (pyarrow and the native decoder release the
     # GIL); list order — bucket sortedness — is preserved by mapping, not by
@@ -260,7 +263,10 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
             trace.record("decode", "cached")
         batches = cached
     elif len(files) > 1:
-        batches = list(_decode_pool().map(read_one, files, schemas))
+        # spans.wrap binds the submitting request's current span into the
+        # pool workers — contextvars do NOT cross ThreadPoolExecutor on
+        # their own, and decode spans must land in the caller's tree
+        batches = list(_decode_pool().map(spans.wrap(read_one), files, schemas))
     else:
         batches = [read_one(f, s) for f, s in zip(files, schemas)]
     if not batches:

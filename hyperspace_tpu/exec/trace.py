@@ -22,18 +22,44 @@ list.append is atomic under the GIL. Enable with::
 from __future__ import annotations
 
 import contextlib
+import threading
 from collections import Counter
 from typing import Iterator, List, Optional
 
+from hyperspace_tpu.obs import spans as _spans
+
 _events: Optional[List] = None
+
+# QueryServers currently running in this process. A process-global recording
+# under concurrent serving would interleave events from unrelated requests —
+# recording() refuses to start instead (the obs span tracer is the
+# per-request surface; see docs/observability.md).
+_servers_running = 0
+_servers_lock = threading.Lock()
+
+
+def server_started() -> None:
+    global _servers_running
+    with _servers_lock:
+        _servers_running += 1
+
+
+def server_stopped() -> None:
+    global _servers_running
+    with _servers_lock:
+        _servers_running = max(0, _servers_running - 1)
 
 
 def record(kind: str, detail: str) -> None:
     """Append a dispatch event (e.g. ``record("join", "device-smj")``) to the
-    active recorder, if any."""
+    active recorder, if any — and annotate the context's current obs span, so
+    dispatch decisions land inside the per-request span tree too."""
     events = _events
     if events is not None:
         events.append((kind, detail))
+    sp = _spans.current_span()
+    if sp is not None:
+        sp.event(kind, detail)
 
 
 def active() -> bool:
@@ -42,8 +68,22 @@ def active() -> bool:
 
 @contextlib.contextmanager
 def recording() -> Iterator[List]:
-    """Collect dispatch events for the duration of the block."""
+    """Collect dispatch events for the duration of the block.
+
+    Raises ``RuntimeError`` while a ``QueryServer`` is running: this recorder
+    is process-global, so it would interleave events from every concurrent
+    request. Use span traces (``hyperspace.obs.tracing.enabled`` + per-request
+    profiles) under serving instead.
+    """
     global _events
+    with _servers_lock:
+        if _servers_running:
+            raise RuntimeError(
+                "exec.trace.recording() is process-global and cannot run while "
+                f"{_servers_running} QueryServer(s) are serving concurrent "
+                "requests; use obs span tracing (hyperspace.obs.tracing.enabled) "
+                "for per-request dispatch visibility"
+            )
     prev = _events
     _events = []
     try:

@@ -1,0 +1,60 @@
+"""Unified query observability: spans, metrics, profiles.
+
+Three layers on one substrate (see docs/observability.md):
+
+- :mod:`hyperspace_tpu.obs.spans` — context-propagated hierarchical span
+  traces per query, with Chrome trace-event export (Perfetto);
+- :mod:`hyperspace_tpu.obs.metrics` — a process-wide, labeled metrics
+  registry (counters/gauges/histograms) with Prometheus text exposition;
+- :mod:`hyperspace_tpu.obs.profile` — the per-query ``QueryProfile``
+  joining span timings with plan facts (indexes applied, rows/bytes,
+  why-not reasons).
+
+Import of this package is stdlib-only: no jax, no numpy (the library's
+import-side-effect contract, tests/test_import_side_effects.py).
+"""
+
+from hyperspace_tpu.obs.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from hyperspace_tpu.obs.profile import QueryProfile, build_profile
+from hyperspace_tpu.obs.spans import (
+    NULL_SPAN,
+    Span,
+    Trace,
+    add_manual,
+    attach,
+    current_span,
+    span,
+    start_trace,
+    to_chrome_trace,
+    trace,
+    wrap,
+)
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "QueryProfile",
+    "build_profile",
+    "NULL_SPAN",
+    "Span",
+    "Trace",
+    "add_manual",
+    "attach",
+    "current_span",
+    "span",
+    "start_trace",
+    "to_chrome_trace",
+    "trace",
+    "wrap",
+]

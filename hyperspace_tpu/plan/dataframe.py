@@ -143,11 +143,44 @@ class DataFrame:
         Arrays may be read-only views of the scan cache (pass-through plans
         share decoded buffers across queries); ``np.copy`` one before
         mutating it in place.
+
+        With ``hyperspace.obs.tracing.enabled`` and no trace already active
+        in this context, the whole call is traced and the resulting
+        ``QueryProfile`` is retrievable via ``session.last_query_profile()``.
+        A trace already active (a QueryServer request, an outer traced block)
+        just gains child spans instead of rooting a second tree.
         """
         from hyperspace_tpu.exec.executor import Executor
+        from hyperspace_tpu.obs import spans
 
-        plan = self.optimized_plan()
-        return Executor(self.session).execute(plan, required_columns=plan.output_columns)
+        conf = self.session.conf
+        if not conf.obs_tracing_enabled or spans.current_span() is not None:
+            plan = self.optimized_plan()
+            return Executor(self.session).execute(plan, required_columns=plan.output_columns)
+
+        from hyperspace_tpu.obs.profile import build_profile
+
+        error = None
+        with spans.trace("query", max_spans=conf.obs_trace_max_spans) as root:
+            try:
+                plan = self.optimized_plan()
+                with spans.span("execute", cat="exec"):
+                    return Executor(self.session).execute(
+                        plan, required_columns=plan.output_columns
+                    )
+            except BaseException as e:
+                error = type(e).__name__
+                raise
+            finally:
+                profile = build_profile(root, query=self.plan.describe(), error=error)
+                if conf.obs_profile_why_not:
+                    try:
+                        from hyperspace_tpu.analysis.why_not import why_not_string
+
+                        profile.why_not = why_not_string(self, self.session)
+                    except Exception:
+                        pass
+                self.session._last_profile = profile
 
     def to_local_iterator(self):
         """Yield the result as a stream of column batches (dict of numpy

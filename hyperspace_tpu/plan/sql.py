@@ -2317,4 +2317,9 @@ def _resolve_select_name(name: str, df, alias_cols) -> str:
 
 
 def run_sql(text: str, session) -> "DataFrame":  # noqa: F821
-    return plan_query(parse(text), session._temp_views)
+    from hyperspace_tpu.obs import spans
+
+    with spans.span("parse", cat="plan"):
+        q = parse(text)
+    with spans.span("resolve", cat="plan"):
+        return plan_query(q, session._temp_views)

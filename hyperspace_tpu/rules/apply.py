@@ -19,7 +19,8 @@ from hyperspace_tpu.plan.expr import BinaryOp, Expr, IsNull, Not, SubqueryExpr
 from hyperspace_tpu.rules.candidate import collect_candidates
 from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.rules.score import ScoreBasedIndexPlanOptimizer
-from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, get_event_logger
+from hyperspace_tpu.obs import spans
+from hyperspace_tpu.telemetry.events import HyperspaceIndexUsageEvent, emit_event
 
 logger = logging.getLogger(__name__)
 
@@ -74,7 +75,8 @@ def optimize_plan(plan: L.LogicalPlan, session, enabled: Optional[bool] = None) 
         enabled = session.hyperspace_enabled
     if not enabled:
         return plan
-    return ApplyHyperspace(session).apply(plan)
+    with spans.span("optimize", cat="plan"):
+        return ApplyHyperspace(session).apply(plan)
 
 
 class ApplyHyperspace:
@@ -94,10 +96,14 @@ class ApplyHyperspace:
         new_plan, score = self._rewrite(plan)
         if score == 0:
             return plan, 0
-        get_event_logger(self.session).log_event(
-            HyperspaceIndexUsageEvent(
-                index_names=used_index_names(new_plan), plan_summary=new_plan.describe()
-            )
+        names = used_index_names(new_plan)
+        summary = new_plan.describe()
+        sp = spans.current_span()
+        if sp is not None:
+            sp.set(indexes=names, plan=summary, score=score)
+        emit_event(
+            self.session,
+            HyperspaceIndexUsageEvent(index_names=names, plan_summary=summary),
         )
         return new_plan, score
 
@@ -115,9 +121,12 @@ class ApplyHyperspace:
         # linear sub-plan for the rules to match (a self-join's two sides
         # are one object before this)
         pruned = prune_columns_duplicating(plan)
-        candidates = collect_candidates(self.ctx, pruned, indexes)
+        with spans.span("collect-candidates", cat="plan") as csp:
+            candidates = collect_candidates(self.ctx, pruned, indexes)
+            csp.set(candidates=sum(len(ents) for _, ents in candidates.values()))
         if candidates:
-            new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(pruned, candidates)
+            with spans.span("rewrite", cat="plan"):
+                new_plan, score = ScoreBasedIndexPlanOptimizer(self.ctx).apply(pruned, candidates)
         else:
             new_plan, score = plan, 0
         if score == 0 and sub_score == 0:

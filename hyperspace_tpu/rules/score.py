@@ -9,8 +9,10 @@ FilterIndexRule :: JoinIndexRule :: NoOpRule).
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Tuple
 
+from hyperspace_tpu.obs import spans
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.rules.dataskipping_rule import apply_data_skipping_rule
@@ -43,6 +45,10 @@ class ScoreBasedIndexPlanOptimizer:
         self.ctx = ctx
         self._memo: Dict[int, Tuple[L.LogicalPlan, int]] = {}
         self._multi_parent: set = set()
+        # accumulated wall seconds per rule across the whole recursion — a
+        # span per rule-per-node would explode the trace, so the tracer gets
+        # one aggregate attr instead (surfaced in QueryProfile.rule_timings)
+        self._rule_seconds: Dict[str, float] = {}
 
     def apply(self, plan: L.LogicalPlan, candidates) -> Tuple[L.LogicalPlan, int]:
         counts: Dict[int, int] = {}
@@ -60,7 +66,11 @@ class ScoreBasedIndexPlanOptimizer:
         # keeps sharing a single object (the executor's shared-subplan memo
         # depends on that identity)
         self._multi_parent = {pid for pid, c in counts.items() if c > 1}
-        return self._rec(plan, candidates)
+        result = self._rec(plan, candidates)
+        sp = spans.current_span()
+        if sp is not None and self._rule_seconds:
+            sp.set(rule_timings=dict(self._rule_seconds))
+        return result
 
     def _rec(
         self, plan: L.LogicalPlan, candidates, in_chain: bool = False
@@ -93,10 +103,19 @@ class ScoreBasedIndexPlanOptimizer:
                 best_plan, best_score = plan.with_children(new_children), child_score
 
         if analysis or not in_chain:
+            timing = spans.current_span() is not None
             for rule, max_score in RULES:
                 if max_score <= best_score and not analysis:
                     continue  # cannot beat the current best (ties keep it)
-                transformed, score = rule(self.ctx, plan, candidates)
+                if timing:
+                    t0 = time.perf_counter()
+                    transformed, score = rule(self.ctx, plan, candidates)
+                    name = rule.__name__
+                    self._rule_seconds[name] = self._rule_seconds.get(name, 0.0) + (
+                        time.perf_counter() - t0
+                    )
+                else:
+                    transformed, score = rule(self.ctx, plan, candidates)
                 if score > best_score:
                     best_plan, best_score = transformed, score
 

@@ -79,6 +79,31 @@ class AdmissionController:
     def queued(self) -> int:
         return self._q.qsize()
 
+    def bind_registry(self, registry, **labels) -> None:
+        """Publish this controller's accounting into an obs metrics registry
+        as callback gauges: scrapes read the live counters themselves, so a
+        Prometheus sample and ``stats()`` can never disagree."""
+        registry.gauge(
+            "hs_serving_queue_depth", "requests waiting in the admission queue",
+            fn=self._q.qsize, **labels,
+        )
+        registry.gauge(
+            "hs_serving_queue_capacity", "admission queue bound",
+            fn=lambda: self.depth, **labels,
+        )
+        registry.gauge(
+            "hs_serving_rejected", "requests rejected at admission (queue full)",
+            fn=lambda: self.rejected, **labels,
+        )
+        registry.gauge(
+            "hs_serving_timeouts", "requests whose deadline expired",
+            fn=lambda: self.timeouts, **labels,
+        )
+        registry.gauge(
+            "hs_serving_submitted", "requests admitted",
+            fn=lambda: self.submitted, **labels,
+        )
+
     def stats(self) -> dict:
         with self._lock:
             return {

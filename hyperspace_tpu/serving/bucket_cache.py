@@ -98,6 +98,25 @@ class BucketCache:
     def clear(self) -> None:
         self._lru.clear()
 
+    def bind_registry(self, registry, **labels) -> None:
+        """Publish cache accounting as callback gauges (see
+        ``AdmissionController.bind_registry`` for the equality rationale)."""
+        registry.gauge("hs_bucket_cache_bytes", "decoded bytes resident", fn=lambda: self._lru.total_bytes, **labels)
+        registry.gauge("hs_bucket_cache_hits", "bucket-cache hits", fn=lambda: self._lru.hits, **labels)
+        registry.gauge("hs_bucket_cache_misses", "bucket-cache misses", fn=lambda: self._lru.misses, **labels)
+        registry.gauge(
+            "hs_bucket_cache_hit_rate", "hits / lookups",
+            fn=lambda: self.stats()["hitRate"], **labels,
+        )
+        registry.gauge(
+            "hs_bucket_cache_prefetch_issued", "prefetch tasks issued",
+            fn=lambda: self.prefetch_issued, **labels,
+        )
+        registry.gauge(
+            "hs_bucket_cache_prefetch_completed", "prefetch tasks completed",
+            fn=lambda: self.prefetch_completed, **labels,
+        )
+
     def stats(self) -> dict:
         total = self._lru.hits + self._lru.misses
         return {

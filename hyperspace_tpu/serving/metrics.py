@@ -1,59 +1,88 @@
-"""Serving metrics: counters + latency percentiles, exported to telemetry.
+"""Serving metrics, backed by the process-wide observability registry.
 
-Latencies keep a bounded reservoir (most recent N) so long-running servers
-report *current* tail behavior without unbounded memory. ``snapshot`` merges
-in the queue / plan-cache / bucket-cache stats so one call yields the whole
-serving picture; ``QueryServer.stats(emit=True)`` wraps it in a
-``ServingStatsEvent`` on the session's telemetry sink.
+``ServingMetrics`` keeps its original surface (``observe`` /
+``observe_batch`` / ``latency_percentiles`` / ``snapshot`` with the same key
+schema) but stores everything in :mod:`hyperspace_tpu.obs.metrics`
+instruments: completion/error/batch counters and one latency histogram,
+labeled per server. ``snapshot`` therefore *reads the registry* — its fields
+and a Prometheus scrape of the same process cannot disagree, because they are
+the same store (tests/test_obs_serving.py pins this).
+
+A ``registry=None`` default gives each instance a private registry, so
+constructing a bare ``ServingMetrics`` (tests, tools) never pollutes the
+global one; ``QueryServer`` passes the global registry plus its server label.
 """
 
 from __future__ import annotations
 
-import threading
-from collections import deque
 from typing import Dict, Optional
 
-import numpy as np
+from hyperspace_tpu.obs.metrics import MetricsRegistry
 
 
 class ServingMetrics:
-    def __init__(self, latency_window: int = 4096):
-        self._lock = threading.Lock()
-        self._lat = deque(maxlen=int(latency_window))
-        self.completed = 0
-        self.errors = 0
-        self.batches = 0
-        self.batched_requests = 0
+    def __init__(
+        self,
+        latency_window: int = 4096,
+        registry: Optional[MetricsRegistry] = None,
+        server: str = "",
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        labels = {"server": server} if server else {}
+        self._completed = self.registry.counter(
+            "hs_serving_completed_total", "requests completed", **labels
+        )
+        self._errors = self.registry.counter(
+            "hs_serving_errors_total", "requests failed", **labels
+        )
+        self._batches = self.registry.counter(
+            "hs_serving_batches_total", "shared-scan micro-batches executed", **labels
+        )
+        self._batched = self.registry.counter(
+            "hs_serving_batched_requests_total", "requests served via micro-batches", **labels
+        )
+        self._latency = self.registry.histogram(
+            "hs_serving_latency_seconds",
+            "submit-to-result latency",
+            window=int(latency_window),
+            **labels,
+        )
+
+    # original counter surface, preserved for existing callers/tests
+    @property
+    def completed(self) -> int:
+        return int(self._completed.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def batches(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def batched_requests(self) -> int:
+        return int(self._batched.value)
 
     def observe(self, latency_s: float, error: bool = False) -> None:
-        with self._lock:
-            self._lat.append(float(latency_s))
-            if error:
-                self.errors += 1
-            else:
-                self.completed += 1
+        self._latency.observe(float(latency_s))
+        (self._errors if error else self._completed).inc()
 
     def observe_batch(self, n_requests: int) -> None:
-        with self._lock:
-            self.batches += 1
-            self.batched_requests += int(n_requests)
+        self._batches.inc()
+        self._batched.inc(int(n_requests))
 
     def latency_percentiles(self) -> Dict[str, Optional[float]]:
-        with self._lock:
-            lat = list(self._lat)
-        if not lat:
-            return {"p50": None, "p95": None, "p99": None}
-        p50, p95, p99 = np.percentile(np.asarray(lat), [50, 95, 99])
-        return {"p50": float(p50), "p95": float(p95), "p99": float(p99)}
+        return self._latency.percentiles()
 
     def snapshot(self, admission=None, plan_cache=None, bucket_cache=None) -> dict:
-        with self._lock:
-            out = {
-                "completed": self.completed,
-                "errors": self.errors,
-                "batches": self.batches,
-                "batchedRequests": self.batched_requests,
-            }
+        out = {
+            "completed": self.completed,
+            "errors": self.errors,
+            "batches": self.batches,
+            "batchedRequests": self.batched_requests,
+        }
         out["latencySeconds"] = self.latency_percentiles()
         if admission is not None:
             out["queue"] = admission.stats()

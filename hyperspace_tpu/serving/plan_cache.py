@@ -169,6 +169,18 @@ class PlanCache:
         return entry
 
     # -- stats ---------------------------------------------------------------
+    def bind_registry(self, registry, **labels) -> None:
+        """Publish cache accounting as callback gauges (see
+        ``AdmissionController.bind_registry`` for the equality rationale)."""
+        registry.gauge("hs_plan_cache_entries", "compiled plans resident", fn=self.__len__, **labels)
+        registry.gauge("hs_plan_cache_hits", "plan-cache hits", fn=lambda: self.hits, **labels)
+        registry.gauge("hs_plan_cache_misses", "plan-cache misses", fn=lambda: self.misses, **labels)
+        registry.gauge("hs_plan_cache_evictions", "plan-cache evictions", fn=lambda: self.evictions, **labels)
+        registry.gauge(
+            "hs_plan_cache_hit_rate", "hits / lookups",
+            fn=lambda: self.stats()["hitRate"], **labels,
+        )
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

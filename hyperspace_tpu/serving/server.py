@@ -19,11 +19,16 @@ requests admitted before it.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import spans
+from hyperspace_tpu.obs.profile import build_profile
 from hyperspace_tpu.serving.admission import (
     AdmissionController,
     AdmissionRejected,
@@ -38,13 +43,17 @@ from hyperspace_tpu.serving.plan_cache import CompiledPlan, PlanCache, session_t
 
 __all__ = ["QueryServer", "AdmissionRejected", "RequestTimeout", "ServerClosed"]
 
+# distinguishes concurrent QueryServers' series in the process-wide registry
+_server_seq = itertools.count()
+
 
 class _Request:
     __slots__ = (
         "plan", "fp", "token", "enabled", "future", "deadline", "submitted_at",
+        "root",
     )
 
-    def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline):
+    def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline, root=None):
         self.plan = plan
         self.fp = fp
         self.token = token
@@ -52,6 +61,9 @@ class _Request:
         self.future: "Future" = Future()
         self.deadline = deadline
         self.submitted_at = time.monotonic()
+        # per-request span-tree root (None when obs tracing is off); workers
+        # attach() it so each request's spans land in its own disjoint tree
+        self.root = root
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -96,7 +108,20 @@ class QueryServer:
             int(opt("bucket_cache_bytes", conf.serving_bucket_cache_bytes)),
             prefetch_workers=int(opt("prefetch_workers", conf.serving_prefetch_workers)),
         )
-        self.metrics = ServingMetrics()
+        # every server labels its series in the process-wide registry (a
+        # private registry when metrics are conf'd off, so accounting still
+        # works but nothing is published)
+        self.server_name = f"qs{next(_server_seq)}"
+        self.registry = (
+            obs_metrics.REGISTRY if conf.obs_metrics_enabled else obs_metrics.MetricsRegistry()
+        )
+        self.metrics = ServingMetrics(registry=self.registry, server=self.server_name)
+        self.admission.bind_registry(self.registry, server=self.server_name)
+        self.plan_cache.bind_registry(self.registry, server=self.server_name)
+        self.bucket_cache.bind_registry(self.registry, server=self.server_name)
+        self.tracing_enabled = bool(conf.obs_tracing_enabled)
+        self._trace_max_spans = conf.obs_trace_max_spans
+        self._profiles: "deque" = deque(maxlen=max(1, conf.obs_profile_history))
         if overrides:
             raise TypeError(f"Unknown QueryServer options: {sorted(overrides)}")
 
@@ -113,6 +138,11 @@ class QueryServer:
         if self._started:
             return self
         self._started = True
+        # the process-global dispatch recorder cannot disambiguate concurrent
+        # requests — exec.trace.recording() refuses to start while we serve
+        from hyperspace_tpu.exec import trace as exec_trace
+
+        exec_trace.server_started()
         # executor-side scans consult session.bucket_cache when present
         self._prev_bucket_cache = getattr(self.session, "bucket_cache", None)
         self.session.bucket_cache = self.bucket_cache
@@ -139,6 +169,10 @@ class QueryServer:
                 req.future.set_exception(ServerClosed("server shut down"))
         self.bucket_cache.shutdown()
         self.session.bucket_cache = self._prev_bucket_cache
+        if self._started:
+            from hyperspace_tpu.exec import trace as exec_trace
+
+            exec_trace.server_stopped()
 
     def __enter__(self) -> "QueryServer":
         return self.start()
@@ -155,20 +189,31 @@ class QueryServer:
         if self._closed or not self._started:
             raise ServerClosed("server is not running (call start() or use as a context manager)")
         enabled = bool(self.session.hyperspace_enabled)
-        plan, fp = self._parse(query)
+        root = None
+        if self.tracing_enabled:
+            root = spans.start_trace(
+                "request",
+                max_spans=self._trace_max_spans,
+                server=self.server_name,
+                query=(query if isinstance(query, str) else type(query).__name__),
+            )
+        with spans.attach(root):
+            plan, fp = self._parse(query)
         token = session_token(self.session, enabled)
-        req = _Request(plan, fp, token, enabled, self.admission.deadline_for(timeout))
+        req = _Request(plan, fp, token, enabled, self.admission.deadline_for(timeout), root=root)
         try:
             self.admission.submit(req)  # raises AdmissionRejected on overflow
         except AdmissionRejected:
-            from hyperspace_tpu.telemetry.events import ServingRejectionEvent, get_event_logger
+            from hyperspace_tpu.telemetry.events import ServingRejectionEvent, emit_event
 
-            get_event_logger(self.session).log_event(
+            emit_event(
+                self.session,
                 ServingRejectionEvent(
                     queue_depth=self.admission.depth, queued=self.admission.queued
-                )
+                ),
             )
             raise
+        req.future.request_root = root  # span tree visible to the caller
         if self.prefetch_enabled:
             self._prefetch_hint(token, fp)
         return req.future
@@ -258,6 +303,7 @@ class QueryServer:
                 self.admission.record_timeout()
                 if not r.future.done():
                     r.future.set_exception(RequestTimeout("deadline expired in queue"))
+                    self._seal(r, error="RequestTimeout")
             else:
                 live.append(r)
         if not live:
@@ -266,9 +312,7 @@ class QueryServer:
             self._execute_requests(live)
         except Exception as exc:  # defensive: never kill a worker thread
             for r in live:
-                if not r.future.done():
-                    r.future.set_exception(exc)
-                    self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+                self._fail(r, exc)
 
     def _execute_requests(self, reqs: List[_Request]) -> None:
         from hyperspace_tpu.exec.executor import Executor
@@ -276,11 +320,10 @@ class QueryServer:
         resolved = []  # (req, bound_plan, entry or None)
         for r in reqs:
             try:
-                resolved.append((r, *self._resolve(r)))
+                with spans.attach(r.root), spans.span("resolve-plan", cat="serving"):
+                    resolved.append((r, *self._resolve(r)))
             except Exception as exc:
-                if not r.future.done():
-                    r.future.set_exception(exc)
-                    self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+                self._fail(r, exc)
 
         # shared-scan micro-batch: >1 request on the same parameterized
         # template whose shape is a filter chain over one scan
@@ -294,12 +337,21 @@ class QueryServer:
                 ops_leaf = shared_scan_ops(entry.template)
                 if ops_leaf is not None:
                     ops, leaf = ops_leaf
+                    t0 = time.perf_counter()
                     with self.session.hyperspace_scope(resolved[0][0].enabled):
                         batches = execute_shared_scan(
                             self.session, ops, leaf, [b for _, b, _ in resolved]
                         )
+                    t1 = time.perf_counter()
                     self.metrics.observe_batch(len(resolved))
                     for (r, _, e), batch in zip(resolved, batches):
+                        # the scan ran ONCE for the whole group; each tree
+                        # records its share as a pre-timed child
+                        if r.root is not None:
+                            spans.add_manual(
+                                r.root, "execute-shared-scan", "serving", t0, t1,
+                                batch_size=len(resolved),
+                            )
                         self._finish(r, batch, e)
                     return
 
@@ -308,18 +360,18 @@ class QueryServer:
                 self.admission.record_timeout()
                 if not r.future.done():
                     r.future.set_exception(RequestTimeout("deadline expired before execution"))
+                    self._seal(r, error="RequestTimeout")
                 continue
             try:
-                with self.session.hyperspace_scope(r.enabled):
-                    out_cols = list(entry.output_columns) if entry is not None else list(bound.output_columns)
-                    batch = Executor(self.session).execute(
-                        bound, required_columns=out_cols, prepruned=entry is not None
-                    )
+                with spans.attach(r.root), spans.span("execute", cat="serving"):
+                    with self.session.hyperspace_scope(r.enabled):
+                        out_cols = list(entry.output_columns) if entry is not None else list(bound.output_columns)
+                        batch = Executor(self.session).execute(
+                            bound, required_columns=out_cols, prepruned=entry is not None
+                        )
                 self._finish(r, batch, entry)
             except Exception as exc:
-                if not r.future.done():
-                    r.future.set_exception(exc)
-                    self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+                self._fail(r, exc)
 
     def _resolve(self, r: _Request):
         """(bound plan, cache entry or None). A None entry means the plan was
@@ -359,8 +411,36 @@ class QueryServer:
         if not r.future.done():
             r.future.set_result(batch)
             self.metrics.observe(time.monotonic() - r.submitted_at)
+            self._seal(r)
+
+    def _fail(self, r: _Request, exc: BaseException) -> None:
+        if not r.future.done():
+            r.future.set_exception(exc)
+            self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+            self._seal(r, error=type(exc).__name__)
+
+    def _seal(self, r: _Request, error: Optional[str] = None) -> None:
+        """Finish the request's span tree and publish its QueryProfile (on
+        the future as ``.profile`` and in the bounded server history)."""
+        if r.root is None:
+            return
+        profile = build_profile(
+            r.root, query=str(r.root.attrs.get("query", "")), error=error
+        )
+        r.future.profile = profile
+        self._profiles.append(profile)
 
     # -- observability -------------------------------------------------------
+    def last_profiles(self) -> List:
+        """Most recent per-request ``QueryProfile``s (bounded by
+        ``hyperspace.obs.profile.history``), oldest first."""
+        return list(self._profiles)
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition of this server's registry (the process-wide
+        one unless metrics were conf'd off)."""
+        return self.registry.prometheus_text()
+
     def stats(self, emit: bool = False) -> dict:
         snap = self.metrics.snapshot(
             admission=self.admission,
@@ -368,9 +448,10 @@ class QueryServer:
             bucket_cache=self.bucket_cache,
         )
         if emit:
-            from hyperspace_tpu.telemetry.events import ServingStatsEvent, get_event_logger
+            from hyperspace_tpu.telemetry.events import ServingStatsEvent, emit_event
 
-            get_event_logger(self.session).log_event(
+            emit_event(
+                self.session,
                 ServingStatsEvent(
                     queue_depth=snap["queue"]["queued"],
                     rejected=snap["queue"]["rejected"],

@@ -46,6 +46,8 @@ class Session:
         self._index_manager = None
         self._mesh = None
         self._temp_views: Dict[str, Any] = {}
+        # most recent QueryProfile from a traced collect() (obs tracing on)
+        self._last_profile = None
 
     # --- reading data ------------------------------------------------------
     def read(self, paths, file_format: str, **options) -> "DataFrame":  # noqa: F821
@@ -155,6 +157,13 @@ class Session:
 
             self._index_manager = CachingIndexCollectionManager(self)
         return self._index_manager
+
+    # --- query profiles (obs) ----------------------------------------------
+    def last_query_profile(self):
+        """The ``QueryProfile`` of the most recent traced ``collect()`` on
+        this session, or None. Requires ``hyperspace.obs.tracing.enabled``;
+        see docs/observability.md."""
+        return self._last_profile
 
     # --- profiling ----------------------------------------------------------
     # The reference delegates runtime profiling to the Spark UI (SURVEY.md

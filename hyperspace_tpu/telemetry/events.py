@@ -143,16 +143,45 @@ class CollectingEventLogger(EventLogger):
             self.events = []
 
 
-_cached: Dict[str, EventLogger] = {}
-_cached_lock = threading.Lock()
+_cache_lock = threading.Lock()
 
 
 def get_event_logger(session) -> EventLogger:
+    """Resolve the session's event sink from conf ``hyperspace.eventLoggerClass``.
+
+    Logger instances are cached *per session*, keyed by the configured class
+    name — NOT in a module-level dict keyed by class name alone, which made
+    two sessions configured with the same class silently share one sink and
+    ignored mid-session conf changes. Repeated calls with an unchanged conf
+    return the same instance (tests rely on that identity); changing the conf
+    key resolves a fresh logger on the next call.
+    """
     cls_name: Optional[str] = session.conf.get("hyperspace.eventLoggerClass")
-    with _cached_lock:
-        if not cls_name:
-            return _cached.setdefault("__noop__", NoOpEventLogger())
-        if cls_name not in _cached:
-            module_name, _, attr = cls_name.rpartition(".")
-            _cached[cls_name] = getattr(importlib.import_module(module_name), attr)()
-        return _cached[cls_name]
+    key = cls_name or "__noop__"
+    with _cache_lock:
+        cache: Dict[str, EventLogger] = getattr(session, "_event_logger_cache", None)
+        if cache is None:
+            cache = {}
+            session._event_logger_cache = cache
+        got = cache.get(key)
+        if got is None:
+            if not cls_name:
+                got = NoOpEventLogger()
+            else:
+                module_name, _, attr = cls_name.rpartition(".")
+                got = getattr(importlib.import_module(module_name), attr)()
+            cache[key] = got
+        return got
+
+
+def emit_event(session, event: HyperspaceEvent) -> None:
+    """Log ``event`` on the session's sink AND count it in the process-wide
+    metrics registry (``hs_events_total{event=...}``) — telemetry events are
+    just another metric emitter on the shared observability substrate."""
+    if session.conf.obs_metrics_enabled:
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.counter(
+            "hs_events_total", "telemetry events emitted", event=event.name
+        ).inc()
+    get_event_logger(session).log_event(event)

@@ -1,0 +1,399 @@
+"""Unit tests for the unified observability layer (hyperspace_tpu/obs/):
+span-tree semantics (null fast path, budget, cross-thread propagation),
+Chrome trace-event export schema, the metrics registry (get-or-create,
+kind conflicts, Prometheus text, snapshot), QueryProfile rendering, the
+per-session event-logger cache, and the exec/trace recording guard."""
+
+import json
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exec import trace as exec_trace
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.obs import spans
+from hyperspace_tpu.obs.profile import build_profile
+from hyperspace_tpu.serving import QueryServer
+from hyperspace_tpu.telemetry.events import (
+    CollectingEventLogger,
+    HyperspaceIndexUsageEvent,
+    NoOpEventLogger,
+    emit_event,
+    get_event_logger,
+)
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture()
+def simple(tmp_path):
+    n = 400
+    pq.write_table(
+        pa.table(
+            {
+                "a": np.arange(n, dtype=np.int64),
+                "b": (np.arange(n, dtype=np.int64) * 13) % 500,
+                "v": np.arange(n, dtype=np.float64),
+            }
+        ),
+        str(tmp_path / "t.parquet"),
+    )
+    sess = hst.Session()
+    sess.read_parquet(str(tmp_path / "t.parquet")).create_or_replace_temp_view("t")
+    return sess
+
+
+# --- span tree semantics -----------------------------------------------------
+
+
+def test_span_without_trace_is_shared_noop():
+    # the disabled path must allocate nothing: same CM object every call
+    assert spans.current_span() is None
+    cm = spans.span("anything", cat="x", rows=1)
+    assert cm is spans._NULL_CM
+    with cm as sp:
+        assert sp is spans.NULL_SPAN
+        sp.set(rows=2)  # no-op, no error
+        sp.event("k", "d")
+    assert spans.wrap(len) is len  # identity when no trace to propagate
+
+
+def test_trace_builds_hierarchy_with_timings_and_attrs():
+    with spans.trace("query") as root:
+        assert spans.current_span() is root
+        with spans.span("optimize", cat="plan") as osp:
+            osp.set(indexes=["ix"])
+        with spans.span("execute", cat="exec"):
+            with spans.span("decode", cat="io", file="f.parquet") as d:
+                d.set(rows=7)
+    assert spans.current_span() is None
+    assert [c.name for c in root.children] == ["optimize", "execute"]
+    (decode,) = root.find("decode")
+    assert decode.attrs == {"file": "f.parquet", "rows": 7}
+    assert decode.t1 >= decode.t0 and decode.duration_s >= 0.0
+    # child intervals nest inside the parent's
+    execute = root.children[1]
+    assert execute.t0 <= decode.t0 and decode.t1 <= execute.t1
+    assert len(list(root.walk())) == 4
+
+
+def test_span_records_exception_and_reraises():
+    with pytest.raises(ValueError):
+        with spans.trace("query") as root:
+            with spans.span("boom"):
+                raise ValueError("nope")
+    (boom,) = root.find("boom")
+    assert boom.attrs["error"] == "ValueError"
+    assert boom.t1 > 0.0  # still finished
+    assert spans.current_span() is None  # context restored past the raise
+
+
+def test_span_budget_drops_and_counts():
+    with spans.trace("query", max_spans=3) as root:
+        for i in range(10):
+            with spans.span(f"s{i}"):
+                pass
+    assert len(root.children) == 2  # root consumed 1 of the 3 slots
+    assert root.trace.dropped == 8
+    # dropped spans surface in the export, not silently
+    assert spans.to_chrome_trace(root)["otherData"]["droppedSpans"] == 8
+
+
+def test_start_trace_is_detached_and_attach_scopes_it():
+    root = spans.start_trace("request", server="qs0")
+    assert spans.current_span() is None  # detached: submitter thread unaffected
+    with spans.attach(root):
+        assert spans.current_span() is root
+        with spans.span("inner"):
+            pass
+    assert spans.current_span() is None
+    assert [c.name for c in root.children] == ["inner"]
+    with spans.attach(None):  # None attach must be a cheap no-op
+        assert spans.current_span() is None
+
+
+def test_wrap_carries_trace_into_pool_threads():
+    # contextvars do NOT cross ThreadPoolExecutor boundaries by themselves;
+    # wrap() is the explicit hand-off the decode pool uses.
+    def job(i):
+        with spans.span("job", i=i):
+            pass
+        return spans.current_span().name
+
+    with spans.trace("query") as root:
+        with ThreadPoolExecutor(max_workers=4) as pool:
+            names = list(pool.map(spans.wrap(job), range(8)))
+    assert names == ["query"] * 8
+    assert sorted(c.attrs["i"] for c in root.children) == list(range(8))
+
+
+def test_concurrent_traces_are_disjoint_across_threads():
+    barrier = threading.Barrier(4)
+    roots = {}
+
+    def worker(k):
+        with spans.trace(f"t{k}") as root:
+            barrier.wait()
+            for j in range(5):
+                with spans.span(f"s{j}", owner=k):
+                    pass
+            roots[k] = root
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for k, root in roots.items():
+        assert {c.attrs["owner"] for c in root.children} == {k}
+        assert len(root.children) == 5
+
+
+def test_add_manual_pre_timed_child():
+    root = spans.start_trace("request")
+    sp = spans.add_manual(root, "execute-shared-scan", "serving", 10.0, 10.5, batch_size=3)
+    assert sp in root.children
+    assert sp.duration_s == pytest.approx(0.5)
+    assert sp.attrs["batch_size"] == 3
+
+
+# --- chrome trace export -----------------------------------------------------
+
+
+def _validate_chrome(doc):
+    """Every event must satisfy the trace-event schema: name/ph/pid/tid
+    always, numeric ts+dur for complete ('X') events."""
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for ev in doc["traceEvents"]:
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["tid"], int)
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+            assert isinstance(ev["args"], dict)
+    json.dumps(doc)  # must be strictly JSON-serializable
+
+
+def test_chrome_trace_schema_and_content():
+    with spans.trace("query") as root:
+        with spans.span("execute", cat="exec", rows=3):
+            with spans.span("decode", cat="io") as d:
+                d.event("decode", "native path")
+    doc = spans.to_chrome_trace(root, pid=1234)
+    _validate_chrome(doc)
+    xs = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert set(xs) == {"query", "execute", "decode"}
+    assert xs["execute"]["cat"] == "exec" and xs["execute"]["args"]["rows"] == 3
+    assert xs["decode"]["args"]["events"] == ["decode: native path"]
+    # ts is relative to the root, in microseconds, and nesting is preserved
+    assert xs["query"]["ts"] == 0
+    assert xs["execute"]["ts"] >= xs["query"]["ts"]
+    assert xs["execute"]["ts"] + xs["execute"]["dur"] <= xs["query"]["ts"] + xs["query"]["dur"] + 1
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(m["name"] == "process_name" for m in metas)
+    assert all(e["pid"] == 1234 for e in doc["traceEvents"])
+
+
+# --- metrics registry --------------------------------------------------------
+
+
+def test_registry_get_or_create_identity_and_labels():
+    reg = obs_metrics.MetricsRegistry()
+    c1 = reg.counter("hs_x_total", "help", server="a")
+    c2 = reg.counter("hs_x_total", server="a")
+    assert c1 is c2  # same (name, labels) -> same instrument
+    cb = reg.counter("hs_x_total", server="b")
+    assert cb is not c1
+    c1.inc()
+    c1.inc(2.5)
+    assert c1.value == 3.5 and cb.value == 0.0
+
+
+def test_registry_kind_conflict_raises():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("hs_dup", "as counter")
+    with pytest.raises(ValueError, match="hs_dup"):
+        reg.gauge("hs_dup")
+
+
+def test_gauge_callback_reads_live_value():
+    reg = obs_metrics.MetricsRegistry()
+    box = {"v": 1}
+    g = reg.gauge("hs_live", fn=lambda: box["v"])
+    assert g.value == 1
+    box["v"] = 42
+    assert g.value == 42  # no set() needed: reads the live source
+
+
+def test_histogram_percentiles_and_buckets():
+    reg = obs_metrics.MetricsRegistry()
+    h = reg.histogram("hs_lat_seconds", "latency")
+    for ms in range(1, 101):
+        h.observe(ms / 1000.0)
+    assert h.count == 100
+    assert h.sum == pytest.approx(sum(range(1, 101)) / 1000.0)
+    p = h.percentiles()
+    assert set(p) == {"p50", "p95", "p99"}
+    assert 0.045 <= p["p50"] <= 0.055
+    assert 0.090 <= p["p95"] <= 0.100
+    # cumulative buckets: the +Inf bucket always equals count
+    bks = dict(h.snapshot_buckets())
+    assert bks["+Inf"] == 100
+    assert bks["0.05"] <= bks["0.1"] <= bks["+Inf"]
+
+
+def test_prometheus_text_exposition():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("hs_served_total", "requests served", server="qs1").inc(5)
+    reg.gauge("hs_depth", "queue depth").set(3)
+    reg.histogram("hs_lat_seconds", "latency").observe(0.02)
+    text = reg.prometheus_text()
+    assert '# TYPE hs_served_total counter' in text
+    assert 'hs_served_total{server="qs1"} 5' in text
+    assert "hs_depth 3" in text
+    assert '# TYPE hs_lat_seconds histogram' in text
+    assert 'hs_lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "hs_lat_seconds_count 1" in text
+    assert text.endswith("\n")
+
+
+def test_registry_snapshot_shape():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("hs_c", "c", k="v").inc(2)
+    reg.histogram("hs_h").observe(0.5)
+    snap = reg.snapshot()
+    assert snap["hs_c"]["kind"] == "counter"
+    (series,) = snap["hs_c"]["series"]
+    assert series["labels"] == {"k": "v"} and series["value"] == 2
+    (hs,) = snap["hs_h"]["series"]
+    assert hs["count"] == 1 and set(hs["percentiles"]) == {"p50", "p95", "p99"}
+    json.dumps(snap)
+
+
+def test_counter_thread_safety():
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("hs_hammer")
+
+    def hammer():
+        for _ in range(10_000):
+            c.inc()
+
+    threads = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == 80_000
+
+
+# --- query profiles ----------------------------------------------------------
+
+
+def test_profile_report_and_json():
+    with spans.trace("query") as root:
+        with spans.span("optimize", cat="plan") as o:
+            o.set(indexes=["ix1"], rule_timings={"FilterIndexRule": 0.002})
+        with spans.span("execute", cat="exec") as e:
+            e.set(rows=10, bytes=80)
+    prof = build_profile(root, query="SELECT ...")
+    assert prof.indexes_applied == ["ix1"]
+    assert prof.duration_s > 0
+    assert prof.total("rows") == 10
+    rep = prof.report()
+    assert "optimize" in rep and "execute" in rep and "rows=10" in rep
+    assert "FilterIndexRule" in rep
+    js = prof.to_json()
+    assert js["indexesApplied"] == ["ix1"]
+    json.dumps(js)
+    _validate_chrome(prof.chrome_trace())
+
+
+def test_collect_profile_end_to_end(simple):
+    simple.conf.set(hst.keys.OBS_TRACING_ENABLED, True)
+    simple.enable_hyperspace()
+    df = simple.sql("SELECT a, v FROM t WHERE b > 300")
+    got = df.collect()
+    prof = simple.last_query_profile()
+    assert prof is not None and prof.error is None
+    names = {sp.name for sp in prof.root.walk()}
+    # ad-hoc lifecycle under collect(): optimize -> execute -> per-operator
+    # -> decode (parse/resolve happen at sql() time, before the trace roots;
+    # the serving suite covers them inside request trees)
+    assert {"query", "optimize", "execute", "decode"} <= names
+    (proj,) = prof.root.find("Project")
+    assert proj.attrs["rows"] == len(next(iter(got.values())))
+    _validate_chrome(prof.chrome_trace())
+
+
+def test_collect_untraced_leaves_no_profile(simple):
+    simple.sql("SELECT a FROM t WHERE b > 490").collect()
+    assert simple.last_query_profile() is None
+
+
+# --- per-session event logger cache (satellite a) ----------------------------
+
+
+_COLLECTOR = "hyperspace_tpu.telemetry.events.CollectingEventLogger"
+
+
+def test_event_logger_honors_conf_change_per_session(simple):
+    first = get_event_logger(simple)
+    assert isinstance(first, NoOpEventLogger)
+    assert get_event_logger(simple) is first  # identity while conf unchanged
+    simple.conf.set("hyperspace.eventLoggerClass", _COLLECTOR)
+    second = get_event_logger(simple)
+    assert isinstance(second, CollectingEventLogger)  # mid-session change honored
+    assert get_event_logger(simple) is second
+    simple.conf.unset("hyperspace.eventLoggerClass")
+    assert isinstance(get_event_logger(simple), NoOpEventLogger)
+
+
+def test_event_logger_not_shared_across_sessions():
+    s1 = hst.Session(conf={"hyperspace.eventLoggerClass": _COLLECTOR})
+    s2 = hst.Session(conf={"hyperspace.eventLoggerClass": _COLLECTOR})
+    l1, l2 = get_event_logger(s1), get_event_logger(s2)
+    assert isinstance(l1, CollectingEventLogger)
+    assert l1 is not l2  # same class name, but each session gets its own sink
+
+
+def test_emit_event_counts_in_registry(simple):
+    simple.conf.set("hyperspace.eventLoggerClass", _COLLECTOR)
+    ctr = obs_metrics.REGISTRY.counter(
+        "hs_events_total", event="HyperspaceIndexUsageEvent"
+    )
+    before = ctr.value
+    emit_event(simple, HyperspaceIndexUsageEvent(index_names=["ix"]))
+    assert ctr.value == before + 1
+    logged = get_event_logger(simple).snapshot()
+    assert logged and logged[-1].name == "HyperspaceIndexUsageEvent"
+
+
+# --- exec/trace recording guard (satellite b) --------------------------------
+
+
+def test_recording_raises_while_server_runs(simple):
+    with QueryServer(simple, workers=1) as srv:
+        fut = srv.submit("SELECT a FROM t WHERE b > 450")
+        fut.result(timeout=30)
+        with pytest.raises(RuntimeError, match="QueryServer"):
+            with exec_trace.recording():
+                pass
+    # server stopped -> the golden-test surface works again
+    with exec_trace.recording() as events:
+        simple.sql("SELECT a FROM t WHERE b > 450").collect()
+    assert events  # dispatch decisions were recorded
+
+
+def test_record_annotates_current_obs_span(simple):
+    simple.conf.set(hst.keys.OBS_TRACING_ENABLED, True)
+    simple.sql("SELECT a, v FROM t WHERE b > 300").collect()
+    prof = simple.last_query_profile()
+    all_events = [ev for sp in prof.root.walk() for ev in sp.events]
+    assert all_events  # decode-path dispatch decisions landed in the span tree

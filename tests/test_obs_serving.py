@@ -1,0 +1,224 @@
+"""Observability under the serving runtime: a TPC-H query served through a
+``QueryServer`` with 8 concurrent submitter threads must yield one disjoint
+span tree per request (the cross-request isolation the process-global
+``exec/trace.py`` recorder cannot give), the ``ServingStatsEvent`` snapshot
+must agree field-for-field with the metrics registry (they read the same
+store), and served profiles must export valid Chrome trace-event JSON."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.obs import metrics as obs_metrics
+from hyperspace_tpu.serving import QueryServer
+from test_obs import _validate_chrome
+from tpch_queries import TPCH_QUERIES
+
+pytestmark = pytest.mark.obs
+
+N_THREADS = 8
+REQS_PER_THREAD = 3
+
+
+@pytest.fixture()
+def lineitem_sess(tmp_path):
+    """A lineitem-shaped table sized for q6 plus a shipdate covering index —
+    the lifecycle exercised is the real one (optimize applies the index,
+    execute decodes index buckets)."""
+    n = 4000
+    rng = np.random.default_rng(6)
+    cols = {
+        "l_orderkey": rng.integers(0, 1000, n).astype(np.int64),
+        "l_quantity": rng.integers(1, 60, n).astype(np.int64),
+        "l_extendedprice": np.round(rng.uniform(0, 2000, n), 2),
+        "l_discount": np.round(rng.integers(0, 11, n) / 100.0, 2),
+        "l_shipdate": np.datetime64("1992-01-01")
+        + rng.integers(0, 2500, n).astype("timedelta64[D]"),
+    }
+    d = tmp_path / "lineitem"
+    d.mkdir()
+    pq.write_table(pa.table(cols), str(d / "part-00000.parquet"))
+    sysp = tmp_path / "_indexes"
+    sysp.mkdir()
+    sess = hst.Session(
+        conf={
+            hst.keys.SYSTEM_PATH: str(sysp),
+            hst.keys.NUM_BUCKETS: 4,
+            hst.keys.OBS_TRACING_ENABLED: True,
+        }
+    )
+    df = sess.read_parquet(str(d))
+    df.create_or_replace_temp_view("lineitem")
+    hst.Hyperspace(sess).create_index(
+        df,
+        hst.CoveringIndexConfig(
+            "li_sd",
+            ["l_shipdate"],
+            ["l_extendedprice", "l_discount", "l_quantity", "l_orderkey"],
+        ),
+    )
+    sess.enable_hyperspace()
+    return sess
+
+
+def _submit_q6_storm(srv):
+    """8 threads × 3 requests of q6 literal variants; returns all futures."""
+    futures = [[] for _ in range(N_THREADS)]
+    errors = []
+    start = threading.Barrier(N_THREADS)
+
+    def submitter(k):
+        try:
+            start.wait()
+            for j in range(REQS_PER_THREAD):
+                q = TPCH_QUERIES["q6"].replace(
+                    "l_quantity < 24", f"l_quantity < {20 + (k + j) % 8}"
+                )
+                futures[k].append(srv.submit(q, timeout=60))
+        except Exception as e:  # surface in the main thread, not as a hang
+            errors.append(e)
+
+    threads = [threading.Thread(target=submitter, args=(k,)) for k in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    return [f for per in futures for f in per]
+
+
+def test_q6_concurrent_span_trees_disjoint(lineitem_sess):
+    with QueryServer(
+        lineitem_sess, workers=N_THREADS, queue_depth=N_THREADS * REQS_PER_THREAD * 2
+    ) as srv:
+        futs = _submit_q6_storm(srv)
+        for f in futs:
+            got = f.result(timeout=120)
+            assert "revenue" in got
+        profiles = [f.profile for f in futs]
+
+    assert len(profiles) == N_THREADS * REQS_PER_THREAD
+    seen_ids = set()
+    for prof in profiles:
+        assert prof is not None and prof.error is None
+        nodes = list(prof.root.walk())
+        # every request carries its full lifecycle in ITS OWN tree
+        names = {sp.name for sp in nodes}
+        assert prof.root.name == "request"
+        # compile side: first sight of a text parses under the request root;
+        # memo/plan-cache hits still record the per-request plan resolution
+        assert names & {"parse", "resolve", "resolve-plan"}
+        assert names & {"execute", "execute-shared-scan"}  # execute side
+        # no cross-request leakage: span objects appear in exactly one tree
+        ids = {id(sp) for sp in nodes}
+        assert not (ids & seen_ids)
+        seen_ids |= ids
+        # and the tree is internally consistent: every child's trace is the root's
+        assert all(sp.trace is prof.root.trace for sp in nodes)
+    # 24 requests -> 24 distinct traces
+    assert len({id(p.root.trace) for p in profiles}) == len(profiles)
+
+
+def test_served_profile_chrome_trace_valid(lineitem_sess, tmp_path):
+    with QueryServer(lineitem_sess, workers=2) as srv:
+        fut = srv.submit(TPCH_QUERIES["q6"], timeout=60)
+        fut.result(timeout=120)
+        prof = fut.profile
+    doc = prof.chrome_trace()
+    _validate_chrome(doc)
+    path = str(tmp_path / "q6.trace.json")
+    prof.save_chrome_trace(path)
+    with open(path) as fh:
+        assert json.load(fh)["traceEvents"]
+    assert os.path.getsize(path) > 0
+
+
+def test_profile_history_bounded(lineitem_sess):
+    lineitem_sess.conf.set(hst.keys.OBS_PROFILE_HISTORY, 4)
+    with QueryServer(lineitem_sess, workers=2) as srv:
+        futs = [srv.submit(TPCH_QUERIES["q6"], timeout=60) for _ in range(10)]
+        for f in futs:
+            f.result(timeout=120)
+        kept = srv.last_profiles()
+    assert len(kept) == 4  # bounded by hyperspace.obs.profile.history
+    assert all(p.root.name == "request" for p in kept)
+
+
+def test_stats_event_matches_registry_under_load(lineitem_sess):
+    """Satellite: the ServingStatsEvent emitted by stats(emit=True) and the
+    live registry must agree — equality by construction, asserted under the
+    same 8-thread storm."""
+    lineitem_sess.conf.set(
+        "hyperspace.eventLoggerClass",
+        "hyperspace_tpu.telemetry.events.CollectingEventLogger",
+    )
+    with QueryServer(
+        lineitem_sess, workers=N_THREADS, queue_depth=N_THREADS * REQS_PER_THREAD * 2
+    ) as srv:
+        futs = _submit_q6_storm(srv)
+        for f in futs:
+            f.result(timeout=120)
+
+        snap = srv.stats(emit=True)
+        reg, labels = srv.registry, {"server": srv.server_name}
+        from hyperspace_tpu.telemetry.events import get_event_logger
+
+        events = [
+            e
+            for e in get_event_logger(lineitem_sess).snapshot()
+            if e.name == "ServingStatsEvent"
+        ]
+        assert events, "stats(emit=True) must emit a ServingStatsEvent"
+        ev = events[-1]
+
+        # event fields == registry instrument values (same store, no copies)
+        assert ev.completed == int(reg.counter("hs_serving_completed_total", **labels).value)
+        assert ev.completed == N_THREADS * REQS_PER_THREAD
+        assert ev.queue_depth == int(reg.gauge("hs_serving_queue_depth", **labels).value)
+        assert ev.rejected == int(reg.gauge("hs_serving_rejected", **labels).value)
+        assert ev.plan_cache_hit_rate == pytest.approx(
+            reg.gauge("hs_plan_cache_hit_rate", **labels).value
+        )
+        assert ev.bucket_cache_hit_rate == pytest.approx(
+            reg.gauge("hs_bucket_cache_hit_rate", **labels).value
+        )
+        hist = reg.histogram("hs_serving_latency_seconds", **labels)
+        pcts = hist.percentiles()
+        assert ev.latency_p50 == pytest.approx(pcts["p50"])
+        assert ev.latency_p95 == pytest.approx(pcts["p95"])
+        assert ev.latency_p99 == pytest.approx(pcts["p99"])
+        assert hist.count == N_THREADS * REQS_PER_THREAD
+
+        # ...and the stats() dict view agrees too
+        assert snap["completed"] == ev.completed
+        assert snap["queue"]["queued"] == ev.queue_depth
+        assert snap["latencySeconds"]["p50"] == pytest.approx(pcts["p50"])
+
+        # the same numbers are scrapeable
+        text = srv.prometheus_text()
+        assert (
+            f'hs_serving_completed_total{{server="{srv.server_name}"}} '
+            f"{N_THREADS * REQS_PER_THREAD}" in text
+        )
+
+    # shutdown unpublishes nothing the test depends on; the event count made
+    # it into the shared substrate as a metric as well
+    total = obs_metrics.REGISTRY.counter("hs_events_total", event="ServingStatsEvent")
+    assert total.value >= 1
+
+
+def test_private_registry_when_metrics_disabled(lineitem_sess):
+    lineitem_sess.conf.set(hst.keys.OBS_METRICS_ENABLED, False)
+    with QueryServer(lineitem_sess, workers=2) as srv:
+        assert srv.registry is not obs_metrics.REGISTRY
+        fut = srv.submit(TPCH_QUERIES["q6"], timeout=60)
+        fut.result(timeout=120)
+        assert srv.stats()["completed"] == 1  # accounting still works locally
+        labels = {"server": srv.server_name}
+        assert srv.registry.counter("hs_serving_completed_total", **labels).value == 1
