@@ -17,6 +17,10 @@ serving workload with span tracing off vs on, plus a disabled-path span
 microbenchmark; writes BENCH_obs.json. The acceptance bar is <= 3% throughput
 regression with tracing DISABLED (the instrumentation points are
 unconditional; only their cost must vanish).
+
+``--scan-pipeline`` runs the pipelined scan engine benchmark (cold-cache
+streamed filter scan, pipelined vs serial, byte-identity and XLA-compile-count
+checks) and writes BENCH_scan_pipeline.json. Bar: >= 1.4x.
 """
 
 from __future__ import annotations
@@ -318,6 +322,129 @@ def obs_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def scan_pipeline_main() -> None:
+    """``python bench.py --scan-pipeline``: pipelined scan engine benchmark.
+
+    Cold-cache multi-chunk filter scan, pipelined vs serial (same session,
+    ``hyperspace.exec.pipeline.enabled`` toggled; io + device caches cleared
+    before each run). Reports rows/s both ways, verifies byte-identical
+    results, and samples ``hs_xla_compiles_total`` after every chunk — shape
+    bucketing means the count must be flat after the first two chunks.
+    Baseline: >= 1.4x pipelined/serial; writes BENCH_scan_pipeline.json.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    num_files = int(os.environ.get("BENCH_SCAN_FILES", 12))
+    rows_per = int(os.environ.get("BENCH_SCAN_ROWS_PER_FILE", 400_000))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_scan_")
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.exec import batch as B
+        from hyperspace_tpu.exec.device import clear_device_cache
+        from hyperspace_tpu.exec.io import clear_io_cache
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        data_dir = os.path.join(tmp, "events")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        rng = np.random.default_rng(7)
+        for i in range(num_files):
+            # a decode-heavy mix (strings dominate parquet decode, like real
+            # event tables) filtered on a numeric key (device path)
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": rng.integers(0, 1_000_000, rows_per).astype(np.int64),
+                        "v": rng.uniform(0.0, 1.0, rows_per),
+                        "w": rng.integers(0, 1 << 40, rows_per).astype(np.int64),
+                        "x": rng.uniform(-1.0, 1.0, rows_per),
+                        "tag": np.char.add(
+                            "session-", rng.integers(0, 10_000_000, rows_per).astype(str)
+                        ),
+                    }
+                ),
+                os.path.join(data_dir, f"part-{i:05d}.parquet"),
+                compression="zstd",
+            )
+
+        sess = hst.Session(
+            conf={
+                hst.keys.SYSTEM_PATH: sys_dir,
+                hst.keys.EXEC_STREAM_CHUNK_BYTES: 1,  # one file per chunk
+                hst.keys.TPU_QUERY_DEVICE_MIN_ROWS: 1,  # exercise the device path
+            }
+        )
+        hst.set_session(sess)
+        q = sess.read_parquet(data_dir).filter(hst.col("k") < 500_000)
+        compiles = REGISTRY.counter(
+            "hs_xla_compiles_total", "first-time XLA compilations (program x shape bucket)"
+        )
+
+        import hashlib
+
+        def digest(batch) -> str:
+            """Order-sensitive content hash of a chunk: equal digests per chunk
+            position == byte-identical streamed results."""
+            h = hashlib.sha1()
+            for name in sorted(batch):
+                a = np.asarray(batch[name])
+                h.update(name.encode())
+                if a.dtype == object:
+                    h.update("\x00".join(map(str, a.tolist())).encode())
+                else:
+                    h.update(np.ascontiguousarray(a).tobytes())
+            return h.hexdigest()
+
+        def run(pipelined: bool):
+            # chunks are digested and DROPPED, like a real streaming consumer —
+            # retaining millions of decoded objects would measure the Python
+            # GC's reaction to the pile, not the scan engine
+            sess.conf.set(hst.keys.EXEC_PIPELINE_ENABLED, pipelined)
+            clear_io_cache()
+            clear_device_cache()
+            digests = []
+            counts = []
+            rows = 0
+            t0 = time.perf_counter()
+            for chunk in q.to_local_iterator():
+                rows += B.num_rows(chunk)
+                digests.append(digest(chunk))
+                counts.append(int(compiles.value))
+            dt = time.perf_counter() - t0
+            return digests, rows, dt, counts
+
+        run(True)  # warm jit (process-wide by design) so neither timed run bills compile
+        d_serial, rows_serial, dt_serial, _ = run(False)
+        d_pipe, rows_pipe, dt_pipe, counts = run(True)
+
+        identical = d_serial == d_pipe and rows_serial == rows_pipe
+        src_rows = num_files * rows_per
+        speedup = dt_serial / dt_pipe
+        out = {
+            "metric": "scan_pipeline_speedup",
+            "value": round(speedup, 3),
+            "unit": "x vs serial",
+            "vs_baseline": round(speedup / 1.4, 4),  # baseline: 1.4x
+            "pipelined_rows_per_sec": round(src_rows / dt_pipe, 1),
+            "serial_rows_per_sec": round(src_rows / dt_serial, 1),
+            "chunks": num_files,
+            "result_rows": int(rows_pipe),
+            "byte_identical": bool(identical),
+            "xla_compiles_after_chunk": counts,
+            "compiles_flat_after_first_two": bool(counts[-1] == counts[min(1, len(counts) - 1)]),
+        }
+        line = json.dumps(out)
+        with open("BENCH_scan_pipeline.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     _honor_cpu_request()
     _backend_watchdog()
@@ -400,5 +527,7 @@ if __name__ == "__main__":
         serve_main()
     elif "--obs-overhead" in sys.argv[1:]:
         obs_main()
+    elif "--scan-pipeline" in sys.argv[1:]:
+        scan_pipeline_main()
     else:
         main()

@@ -52,6 +52,13 @@ class keys:
     EXEC_STREAM_AGG_MIN_BYTES = "hyperspace.exec.stream.aggMinBytes"
     EXEC_STREAM_CHUNK_BYTES = "hyperspace.exec.stream.chunkBytes"
     EXEC_JOIN_SPILL_MIN_ROWS = "hyperspace.exec.join.spillMinRows"
+    # Scan IO + pipelined streaming (hyperspace_tpu/exec/pipeline.py):
+    # decode-pool width, chunk prefetch depth/budget, and row-group pruning.
+    EXEC_IO_DECODE_THREADS = "hyperspace.exec.io.decodeThreads"
+    EXEC_IO_ROWGROUP_PRUNING = "hyperspace.exec.io.rowGroupPruning"
+    EXEC_PIPELINE_ENABLED = "hyperspace.exec.pipeline.enabled"
+    EXEC_PIPELINE_DEPTH = "hyperspace.exec.pipeline.depth"
+    EXEC_PIPELINE_MAX_BUFFERED_BYTES = "hyperspace.exec.pipeline.maxBufferedBytes"
     # Query-serving runtime (hyperspace_tpu/serving/): concurrent request
     # admission, compiled-plan caching, micro-batching, bucket prefetch.
     SERVING_QUEUE_DEPTH = "hyperspace.serving.queueDepth"
@@ -157,6 +164,23 @@ DEFAULTS: Dict[str, Any] = {
     # partitioned (grace-join style): both sides split by key hash and each
     # partition merges independently, bounding the merge intermediate.
     keys.EXEC_JOIN_SPILL_MIN_ROWS: 1 << 26,
+    # Width of the shared parquet decode pool (exec/io.py). Applied when a
+    # Session is constructed; the HS_DECODE_THREADS env var overrides both.
+    keys.EXEC_IO_DECODE_THREADS: 8,
+    # Evaluate pushed-down scan predicates against parquet row-group min/max
+    # statistics so definitely-non-matching row groups are never decoded
+    # (three-valued, conservative — pruning never changes results).
+    keys.EXEC_IO_ROWGROUP_PRUNING: True,
+    # Pipelined streamed scans (exec/pipeline.py): while the chain executes
+    # over chunk k, up to `depth` later chunks decode on the pipeline pool
+    # (and pre-stage their H2D transfer). depth=1 is classic double
+    # buffering: one chunk in compute, one in decode.
+    keys.EXEC_PIPELINE_ENABLED: True,
+    keys.EXEC_PIPELINE_DEPTH: 2,
+    # Byte cap on decoded-but-unconsumed prefetched chunks; prefetch stalls
+    # above it (one chunk ahead is always allowed, or the pipeline would
+    # degenerate to serial on a single oversized chunk).
+    keys.EXEC_PIPELINE_MAX_BUFFERED_BYTES: 1 << 30,
     # Serving runtime. Queue depth bounds memory under overload: submits
     # beyond it are REJECTED (AdmissionRejected), never silently queued.
     keys.SERVING_QUEUE_DEPTH: 64,
@@ -356,6 +380,26 @@ class HyperspaceConf:
     @property
     def join_spill_min_rows(self) -> int:
         return int(self.get(keys.EXEC_JOIN_SPILL_MIN_ROWS))
+
+    @property
+    def io_decode_threads(self) -> int:
+        return int(self.get(keys.EXEC_IO_DECODE_THREADS))
+
+    @property
+    def rowgroup_pruning_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_IO_ROWGROUP_PRUNING))
+
+    @property
+    def pipeline_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_PIPELINE_ENABLED))
+
+    @property
+    def pipeline_depth(self) -> int:
+        return int(self.get(keys.EXEC_PIPELINE_DEPTH))
+
+    @property
+    def pipeline_max_buffered_bytes(self) -> int:
+        return int(self.get(keys.EXEC_PIPELINE_MAX_BUFFERED_BYTES))
 
     # Serving runtime --------------------------------------------------------
     @property

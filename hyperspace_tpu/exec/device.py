@@ -467,6 +467,64 @@ def _pad_to_multiple(arr: np.ndarray, m: int, fill) -> np.ndarray:
     return np.concatenate([arr, pad])
 
 
+# --------------------------------------------------------------------------
+# geometric shape buckets: every jitted program here specializes per input
+# SHAPE, so ad-hoc padding (next multiple of n_dev) turns a streamed scan's
+# slightly-varying chunk sizes into one fresh XLA compile per chunk. Rounding
+# shapes up to powers of sqrt(2) over a floor caps the distinct shapes any
+# stream can produce at 2-3 (chunking targets equal byte sizes), at <= 41%
+# memory overhead. Shared by the filter, aggregate, and bucketed-SMJ
+# rectangle paths; hs_xla_compiles_total measures the effect.
+# --------------------------------------------------------------------------
+
+_BUCKET_FLOOR = 4096
+_SQRT2 = 1.4142135623730951
+
+
+def bucket_rows(n: int, floor: int = _BUCKET_FLOOR) -> int:
+    """Smallest geometric shape bucket (powers of sqrt(2) over ``floor``)
+    holding ``n`` rows."""
+    b = floor
+    while b < n:
+        b = int(b * _SQRT2) + 1
+    return b
+
+
+def _pad_to_bucket(arr: np.ndarray, m: int, fill) -> np.ndarray:
+    """Pad axis 0 to the shape bucket for len(arr), rounded up to a multiple
+    of ``m`` (the device count) so sharding stays even."""
+    n = arr.shape[0]
+    target = bucket_rows(n)
+    target += (-target) % m
+    if target == n:
+        return arr
+    pad = np.full((target - n,) + arr.shape[1:], fill, dtype=arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+# own (program skeleton, input-shape signature) ledger: jax.jit compiles
+# exactly once per such pair, so first-seen here == one XLA compilation.
+# Survives clear_device_cache() because the jit caches do too.
+import threading as _threading
+
+_COMPILE_SEEN: set = set()
+_COMPILE_SEEN_LOCK = _threading.Lock()
+
+
+def _note_compile(skeleton: str, sig) -> None:
+    key = (skeleton, sig)
+    with _COMPILE_SEEN_LOCK:
+        if key in _COMPILE_SEEN:
+            return
+        _COMPILE_SEEN.add(key)
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_xla_compiles_total",
+        "Distinct (device program skeleton, input shape) XLA compilations",
+    ).inc()
+
+
 # skeleton -> jitted predicate program; the jit object is reused across
 # queries so only genuinely new predicate *structures* pay an XLA compile
 from collections import OrderedDict as _OrderedDict
@@ -577,7 +635,7 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
 
         for r in missing:
             arr, codec = encode_column(batch[r])
-            padded = _pad_to_multiple(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
+            padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
             dev = jax.device_put(padded, sharding)
             dev_cols[r] = dev
             codecs[r] = codec
@@ -585,9 +643,51 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
                 _device_cache_put((scan_key, r, n_dev), (dev, codec, n), int(padded.nbytes))
 
     fn, lit_values = compile_predicate(condition, codecs)
-    jitted = _cached_predicate_jit(predicate_skeleton(condition, codecs), fn)
+    skeleton = predicate_skeleton(condition, codecs)
+    jitted = _cached_predicate_jit(skeleton, fn)
+    _note_compile(skeleton, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
     mask = jitted(dev_cols, lit_values)
     return np.asarray(mask)[:n]
+
+
+def stage_filter_columns(session, batch: B.Batch, condition: Expr, scan_key) -> None:
+    """H2D staging hook for the scan pipeline (stage 2 of 3): encode,
+    bucket-pad and ``device_put`` ``condition``'s columns into the device
+    cache on the prefetch thread, so the consumer's ``device_filter_mask``
+    on this chunk is a pure cache hit and the transfer overlaps chunk k's
+    compute. Silently a no-op when the predicate is outside the device
+    language or ``scan_key`` is None (nothing would be cached)."""
+    if scan_key is None or condition is None:
+        return
+    n = B.num_rows(batch)
+    if n == 0:
+        return
+    refs = sorted(condition.references())
+    if any(r not in batch for r in refs):
+        return
+    from hyperspace_tpu.obs import spans as obs_spans
+
+    try:
+        ensure_x64()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        compile_predicate(condition, _dry_codecs(batch, refs))
+        mesh = session.mesh
+        n_dev = mesh.devices.size
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        with obs_spans.span("h2d-stage", cat="pipeline", rows=n):
+            for r in refs:
+                ckey = (scan_key, r, n_dev)
+                cached = _device_cache_get(ckey)
+                if cached is not None and cached[2] == n:
+                    continue
+                arr, codec = encode_column(batch[r])
+                padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
+                dev = jax.device_put(padded, sharding)
+                _device_cache_put(ckey, (dev, codec, n), int(padded.nbytes))
+    except DeviceUnsupported:
+        return  # the consumer's host fallback will handle this chunk
 
 
 # --------------------------------------------------------------------------
@@ -656,7 +756,7 @@ def device_filtered_aggregate(
         arr, codec = encode_column(batch[r])
         if codec.kind == "string":
             raise DeviceUnsupported("string aggregate/predicate columns stay host-side here")
-        padded = _pad_to_multiple(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
+        padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
         dev = jax.device_put(padded, sharding)
         dev_cols[r] = dev
         codecs[r] = codec
@@ -713,6 +813,7 @@ def device_filtered_aggregate(
         return tuple(outs), tuple(valids)
 
     jitted = _cached_predicate_jit(skeleton, program)
+    _note_compile(skeleton, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
     outs, valids = jitted(dev_cols, lit_values, np.int64(n))
     outs = [np.asarray(o) for o in outs]
     valids = [int(v) for v in valids]
@@ -1650,7 +1751,9 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
 
         def stack_side(buckets: Dict[int, B.Batch], keymap: Dict[int, np.ndarray]):
             lens = [B.num_rows(buckets[b]) if b in buckets else 0 for b in range(nb_padded)]
-            width = max(max(lens), 1)
+            # bucket the rectangle width so streamed chunks of slightly
+            # varying bucket sizes reuse the span program's executable
+            width = bucket_rows(max(max(lens), 1), floor=256)
             keys_mat = np.full((nb_padded, width), SENTINEL, dtype=np.int64)
             for b in range(nb_padded):
                 enc = keymap.get(b)
@@ -1669,6 +1772,7 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
             )
 
     spans = _bucketed_span_program(mesh, axis)
+    _note_compile("join-span", (tuple(lmat_dev.shape), tuple(rmat_dev.shape)))
     lo, hi = spans(lmat_dev, rmat_dev)
 
     if plan.how == "inner" and session.conf.join_device_materialize:
@@ -1937,7 +2041,7 @@ def _device_materialize_inner(
     if cached is not None:
         llens_dev, rlens_dev, lmats_dev, rmats_dev = cached
     else:
-        wr = max((B.num_rows(rbuckets[b]) for b in participating), default=1)
+        wr = bucket_rows(max((B.num_rows(rbuckets[b]) for b in participating), default=1), floor=256)
         lmats = rectangles(lbuckets, l_device, wl)
         rmats = rectangles(rbuckets, r_device, wr)
         llens_dev = jax.device_put(llens_np)

@@ -66,11 +66,14 @@ def _read_files(
     partition_values: Optional[dict] = None,
     partition_dtypes: Optional[dict] = None,
     format_options: Optional[dict] = None,
+    predicate=None,
 ) -> B.Batch:
     """Read ``files`` into one batch. ``partition_values`` ({file -> {col ->
     typed value}}) attaches hive-partition columns — constant per file, absent
-    from the file bytes — to each file's rows."""
-    from hyperspace_tpu.exec.io import read_parquet_batch
+    from the file bytes — to each file's rows. ``predicate`` (the scan's
+    pushed-down filter, re-applied by the Filter above) enables parquet
+    row-group min/max pruning in the reader."""
+    from hyperspace_tpu.exec.io import _decode_pool, read_parquet_batch
 
     if not files:
         # every file pruned (e.g. data-skipping removed all of them): empty
@@ -104,7 +107,7 @@ def _read_files(
             b: B.Batch = {}
             n = F.count_rows(f, file_format, format_options)
         elif file_format == "parquet":
-            b = read_parquet_batch([f], file_columns)
+            b = read_parquet_batch([f], file_columns, predicate=predicate)
             n = B.num_rows(b)
         else:
             b = B.table_to_batch(F.read_table(f, file_format, file_columns, format_options))
@@ -121,9 +124,17 @@ def _read_files(
         return b
 
     if with_file_names or attach:
+        if len(files) > 1:
+            # same fan-out as the plain-parquet path: per-file decode +
+            # partition/file-name attachment are independent, and both the
+            # native decoder and pyarrow release the GIL. spans.wrap carries
+            # the caller's span context into the pool workers.
+            from hyperspace_tpu.obs import spans
+
+            return B.concat(list(_decode_pool().map(spans.wrap(read_one), files)))
         return B.concat([read_one(f) for f in files])
     if file_format == "parquet":
-        return read_parquet_batch(list(files), columns)
+        return read_parquet_batch(list(files), columns, predicate=predicate)
     from hyperspace_tpu.sources import formats as F
 
     t = F.open_dataset(list(files), file_format, format_options).to_table(columns=columns)
@@ -357,6 +368,34 @@ def _chain_needed_columns(chain, aggs=None, keys=None):
     return needed
 
 
+def _chain_pushdown_condition(chain):
+    """AND of the chain's Filter conditions that sit over only Projects —
+    still expressed in source-column terms, so the scan's row-group pruning
+    can evaluate them against file statistics. Compute/Rename rebind the
+    namespace, so conditions above them don't push."""
+    from hyperspace_tpu.plan.expr import BinaryOp
+
+    cond = None
+    for node in reversed(chain):  # leaf-most wrapper first
+        if isinstance(node, L.Project):
+            continue
+        if isinstance(node, L.Filter):
+            cond = node.condition if cond is None else BinaryOp("AND", cond, node.condition)
+            continue
+        break
+    return cond
+
+
+def _pruned_scan_key(key, pruned_by):
+    """Brand a device-cache scan key with the predicate whose row-group
+    pruning shaped the batch: two predicates can prune the same files to
+    EQUAL row counts but DIFFERENT rows, and the device cache's (key, col,
+    n_rows) check alone would alias them."""
+    if key is None or pruned_by is None:
+        return key
+    return key + (("rg-pred", str(pruned_by)),)
+
+
 def _rebuild_chain(chain, leaf: L.LogicalPlan) -> L.LogicalPlan:
     """Clone the row-wise wrappers over a replacement leaf (bottom-up)."""
     node = leaf
@@ -545,15 +584,84 @@ class Executor:
                     )
                     if len(groups) > 1:
                         needed = _chain_needed_columns(chain) | set(plan.output_columns)
-                        for g in groups:
-                            sub = _rebuild_chain(chain, _leaf_subset(leaf, g, needed))
-                            yield self._exec(sub, False)
+                        yield from self._stream_chunks(chain, leaf, groups, needed)
                         return
                 batch = self._exec(plan, False)
                 yield {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
         finally:
             self._memo = {}
             self._shared = set()
+
+    def _stream_chunks(self, chain, leaf, groups, needed):
+        """Yield one executed chain batch per file group, overlapping chunk
+        k+1's decode + H2D staging with chunk k's execution via ScanPipeline
+        (the tentpole's stage-1/2/3 split). Pushed-down Filter conditions are
+        attached to each leaf clone for row-group pruning; the serial path
+        (pipeline disabled, or a chain that needs file names) executes the
+        same clones, so streamed results are identical either way."""
+        conf = self.session.conf
+        pushed = _chain_pushdown_condition(chain) if conf.rowgroup_pruning_enabled else None
+        leaves, subs = [], []
+        for g in groups:
+            lf = _leaf_subset(leaf, g, needed)
+            if pushed is not None and isinstance(lf, (L.FileScan, L.IndexScan)):
+                lf.pushdown_predicate = pushed
+            leaves.append(lf)
+            subs.append(_rebuild_chain(chain, lf))
+        wfns = [_plan_needs_file_names(s) for s in subs]
+        if not conf.pipeline_enabled or len(groups) < 2 or any(wfns):
+            # leaf-batch prefetch can't also carry file-name columns; such
+            # chains (rare: InputFileName in a filter) stay serial
+            for sub, wfn in zip(subs, wfns):
+                yield self._exec(sub, wfn)
+            return
+
+        try:
+            from hyperspace_tpu.exec import device as D
+        except ImportError:
+            D = None
+        from hyperspace_tpu.exec.pipeline import ScanPipeline
+
+        # H2D staging (stage 2) applies when the chunk will take the device
+        # filter path: Filter directly over the scan leaf
+        dev_cond = None
+        if (
+            D is not None
+            and conf.device_execution_enabled
+            and chain
+            and isinstance(chain[-1], L.Filter)
+            and isinstance(leaves[0], (L.FileScan, L.IndexScan))
+        ):
+            dev_cond = chain[-1].condition
+
+        def stage(i, batch):
+            if dev_cond is None or B.num_rows(batch) < conf.device_exec_min_rows:
+                return
+            key = _pruned_scan_key(_scan_identity(leaves[i]), pushed)
+            D.stage_filter_columns(self.session, batch, dev_cond, key)
+
+        def weigh(batch):
+            return sum(int(getattr(a, "nbytes", 0)) for a in batch.values())
+
+        pipe = ScanPipeline(
+            [(lambda i=i: self._exec(leaves[i], False)) for i in range(len(leaves))],
+            depth=max(1, conf.pipeline_depth),
+            max_buffered_bytes=conf.pipeline_max_buffered_bytes,
+            weigh=weigh,
+            stage=stage if dev_cond is not None else None,
+        )
+        try:
+            for i, leaf_batch in enumerate(pipe):
+                prev = getattr(self, "_leaf_override", None)
+                self._leaf_override = (leaves[i], leaf_batch)
+                try:
+                    with spans.span("execute", cat="pipeline", chunk=i):
+                        out = self._exec(subs[i], False)
+                finally:
+                    self._leaf_override = prev
+                yield out
+        finally:
+            pipe.close()
 
     def _exec(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
         # hits hand out shallow copies so callers may add derived keys
@@ -587,6 +695,13 @@ class Executor:
             return batch
 
     def _exec_node(self, plan: L.LogicalPlan, with_file_names: bool) -> B.Batch:
+        # pipelined streaming hands the current chunk's prefetched leaf batch
+        # to the consumer's chain execution through this override (identity
+        # match: each chunk's leaf clone is unique to that chunk)
+        ov = getattr(self, "_leaf_override", None)
+        if ov is not None and plan is ov[0]:
+            return dict(ov[1])
+
         if isinstance(plan, L.Scan):
             return self._exec_scan(plan, with_file_names)
 
@@ -610,6 +725,7 @@ class Executor:
                 partition_values=plan.partition_values,
                 partition_dtypes=plan.partition_dtypes,
                 format_options=plan.format_options,
+                predicate=getattr(plan, "pushdown_predicate", None),
             )
 
         if isinstance(plan, L.IndexScan):
@@ -622,7 +738,13 @@ class Executor:
             if bucket_cache is not None and not with_file_names and plan.files:
                 batch = bucket_cache.read(list(plan.files), list(fcols))
             else:
-                batch = _read_files(list(plan.files), "parquet", list(fcols), with_file_names)
+                batch = _read_files(
+                    list(plan.files),
+                    "parquet",
+                    list(fcols),
+                    with_file_names,
+                    predicate=getattr(plan, "pushdown_predicate", None),
+                )
             if plan.file_columns is not None:
                 # nested index columns are stored under their flat
                 # __hs_nested. name; present them under the output name
@@ -635,15 +757,42 @@ class Executor:
             return batch
 
         if isinstance(plan, L.Filter):
+            rg_ok = self.session.conf.rowgroup_pruning_enabled
+            pushed = None
             if isinstance(plan.child, L.Scan):
                 # partition pruning: conjuncts over partition columns decide
                 # per-file from path-derived values which files to read at all
                 # (Spark's PartitioningAwareFileIndex.listFiles role)
                 files = _prune_partitions(plan.child, plan.condition)
-                child = self._exec_scan(plan.child, with_file_names, files=files)
+                if rg_ok:
+                    pushed = plan.condition
+                child = self._exec_scan(
+                    plan.child, with_file_names, files=files, predicate=pushed
+                )
             else:
-                child = self._exec(plan.child, with_file_names)
-            mask = self._filter_mask(plan, child)
+                existing = getattr(plan.child, "pushdown_predicate", None)
+                if existing is not None:
+                    # a streamed leaf subset arrives with its pushdown already
+                    # attached (_stream_chunks); just execute it
+                    pushed = existing
+                    child = self._exec(plan.child, with_file_names)
+                elif (
+                    rg_ok
+                    and isinstance(plan.child, (L.FileScan, L.IndexScan))
+                    and id(plan.child) not in self._shared
+                ):
+                    # push the predicate down for row-group pruning on a CLONE:
+                    # the original node may be referenced by plan caches or
+                    # shared subtrees, which must keep full-read semantics
+                    import copy
+
+                    clone = copy.copy(plan.child)
+                    clone.pushdown_predicate = plan.condition
+                    pushed = plan.condition
+                    child = self._exec(clone, with_file_names)
+                else:
+                    child = self._exec(plan.child, with_file_names)
+            mask = self._filter_mask(plan, child, pruned_by=pushed)
             return B.mask_rows(child, mask)
 
         if isinstance(plan, L.Project):
@@ -777,6 +926,7 @@ class Executor:
         with_file_names: bool,
         files: Optional[List[str]] = None,
         columns: Optional[List[str]] = None,
+        predicate=None,
     ) -> B.Batch:
         rel = plan.relation
         if files is None:
@@ -807,11 +957,13 @@ class Executor:
             pv,
             pd,
             format_options=getattr(rel, "options", None) or None,
+            predicate=predicate,
         )
 
-    def _filter_mask(self, plan: L.Filter, child: B.Batch) -> np.ndarray:
+    def _filter_mask(self, plan: L.Filter, child: B.Batch, pruned_by=None) -> np.ndarray:
         """Predicate evaluation: device path over index/file scans when the
-        session mesh is available, host numpy otherwise."""
+        session mesh is available, host numpy otherwise. ``pruned_by`` is the
+        predicate whose row-group pruning produced ``child``, if any."""
         if (
             self.session.conf.device_execution_enabled
             and isinstance(plan.child, (L.IndexScan, L.FileScan))
@@ -821,7 +973,10 @@ class Executor:
 
             try:
                 mask = D.device_filter_mask(
-                    self.session, child, plan.condition, scan_key=_scan_identity(plan.child)
+                    self.session,
+                    child,
+                    plan.condition,
+                    scan_key=_pruned_scan_key(_scan_identity(plan.child), pruned_by),
                 )
                 trace.record("filter", "device")
                 return mask
@@ -1033,10 +1188,9 @@ class Executor:
         distinct_frames = {i: [] for i, *_ in distinct}  # per-agg pair frames
         g_state: Dict[int, Any] = {}       # global plain partials
 
-        for group in groups:
-            sub = _rebuild_chain(chain, _leaf_subset(leaf, group, needed))
-            wfn = _plan_needs_file_names(sub)
-            batch = self._exec(sub, wfn)
+        # chunks arrive through the prefetch pipeline: chunk k+1 decodes (and
+        # stages) while this loop folds chunk k's partials
+        for batch in self._stream_chunks(chain, leaf, groups, needed):
             batch = {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
             n = B.num_rows(batch)
 

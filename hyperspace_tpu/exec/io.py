@@ -84,19 +84,49 @@ def clear_io_cache() -> None:
 
 _DECODE_POOL = None
 _DECODE_POOL_LOCK = threading.Lock()
+_DECODE_POOL_SIZE = None  # width the live pool was created with
+_CONFIGURED_THREADS: Optional[int] = None  # from conf, via set_decode_threads
+
+
+def decode_threads() -> int:
+    """Effective decode-pool width: HS_DECODE_THREADS env > session conf
+    (``hyperspace.exec.io.decodeThreads``) > default 8."""
+    env = os.environ.get("HS_DECODE_THREADS")
+    if env:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return max(1, _CONFIGURED_THREADS or 8)
+
+
+def set_decode_threads(n: Optional[int]) -> None:
+    """Record the conf-requested pool width (called on Session construction).
+    An already-built pool of a different width is retired — its in-flight
+    decodes finish on the old threads — and the next scan builds the new one."""
+    global _CONFIGURED_THREADS, _DECODE_POOL, _DECODE_POOL_SIZE
+    with _DECODE_POOL_LOCK:
+        _CONFIGURED_THREADS = int(n) if n else None
+        if _DECODE_POOL is not None and _DECODE_POOL_SIZE != decode_threads():
+            _DECODE_POOL.shutdown(wait=False)
+            _DECODE_POOL = None
+            _DECODE_POOL_SIZE = None
 
 
 def _decode_pool():
     """Shared decode thread pool — per-call pools would pay thread spin-up on
     every scan. Init is locked: serving workers scan concurrently, and a
     double-create here leaked a whole thread pool."""
-    global _DECODE_POOL
+    global _DECODE_POOL, _DECODE_POOL_SIZE
     if _DECODE_POOL is None:
         with _DECODE_POOL_LOCK:
             if _DECODE_POOL is None:
                 from concurrent.futures import ThreadPoolExecutor
 
-                _DECODE_POOL = ThreadPoolExecutor(max_workers=8, thread_name_prefix="hs-decode")
+                _DECODE_POOL_SIZE = decode_threads()
+                _DECODE_POOL = ThreadPoolExecutor(
+                    max_workers=_DECODE_POOL_SIZE, thread_name_prefix="hs-decode"
+                )
     return _DECODE_POOL
 
 
@@ -128,13 +158,161 @@ def _dtype_hints(schema: pa.Schema, columns: List[str]) -> Optional[Dict[str, np
     return hints
 
 
-def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batch:
+# ---------------------------------------------------------------------------
+# Row-group pruning: a scan's pushed-down predicate is evaluated against the
+# parquet footers' per-row-group min/max statistics BEFORE any decode, through
+# the data-skipping rule's three-valued _SketchEvaluator (reused, not
+# duplicated): "definitely no matching rows" skips the row group, anything
+# uncertain decodes it. The Filter above re-applies the full predicate, so
+# pruning is conservative by construction and never changes results.
+# ---------------------------------------------------------------------------
+
+
+def _rg_counters():
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    return (
+        REGISTRY.counter(
+            "hs_rowgroups_scanned_total",
+            "Parquet row groups decoded by predicate-pushdown scans",
+        ),
+        REGISTRY.counter(
+            "hs_rowgroups_skipped_total",
+            "Parquet row groups skipped by min/max statistics pruning",
+        ),
+        REGISTRY.counter(
+            "hs_rowgroup_bytes_skipped_total",
+            "Bytes of parquet row groups skipped by min/max statistics pruning",
+        ),
+    )
+
+
+def _stats_array(vals: List) -> np.ndarray:
+    """Per-row-group min or max values as an array the sketch evaluator's
+    comparisons understand. None entries (absent statistics) survive as
+    object-array nulls, which the evaluator keeps unconditionally."""
+    import datetime
+
+    if not vals or any(v is None for v in vals):
+        out = np.empty(len(vals), dtype=object)
+        out[:] = vals
+        return out
+    v0 = vals[0]
+    if isinstance(v0, datetime.datetime):
+        return np.array(vals, dtype="datetime64[us]")
+    if isinstance(v0, datetime.date):
+        return np.array(vals, dtype="datetime64[D]")
+    if isinstance(v0, bytes):
+        vals = [v.decode("utf-8", "surrogateescape") for v in vals]
+    out = np.asarray(vals)
+    if out.dtype.kind in ("U", "S"):
+        out = out.astype(object)
+    return out
+
+
+def prune_row_groups(path: str, predicate) -> Optional[List[int]]:
+    """Row-group indices of ``path`` that *might* hold rows matching
+    ``predicate``, judged by footer min/max statistics; None when nothing can
+    be pruned (every group kept). Columns without statistics — or predicate
+    shapes outside the evaluator's language — keep their groups."""
+    from hyperspace_tpu.indexes.dataskipping import MinMaxSketch
+    from hyperspace_tpu.rules.dataskipping_rule import _SketchEvaluator
+
+    refs = sorted(set(predicate.references()))
+    if not refs:
+        return None
+    try:
+        md = pq.read_metadata(path)
+    except (OSError, pa.ArrowInvalid):
+        return None
+    n_rg = md.num_row_groups
+    if n_rg == 0:
+        return None
+    rg0 = md.row_group(0)
+    col_idx = {rg0.column(j).path_in_schema: j for j in range(rg0.num_columns)}
+    lower_idx = {name.lower(): j for name, j in col_idx.items()}
+    sketches, table = [], {}
+    for c in refs:
+        j = col_idx.get(c, lower_idx.get(c.lower()))
+        if j is None:
+            continue  # partition / computed column: no file statistics
+        mins: List = []
+        maxs: List = []
+        for i in range(n_rg):
+            st = md.row_group(i).column(j).statistics
+            if st is not None and st.has_min_max:
+                mins.append(st.min)
+                maxs.append(st.max)
+            else:
+                mins.append(None)
+                maxs.append(None)
+        s = MinMaxSketch(c)
+        mn_name, mx_name = s.output_names()
+        table[mn_name] = _stats_array(mins)
+        table[mx_name] = _stats_array(maxs)
+        sketches.append(s)
+    if not sketches:
+        return None
+    try:
+        mask = _SketchEvaluator(sketches, table, n_rg).eval(predicate)
+    except Exception:
+        return None  # pruning must never break a read the full decode answers
+    if mask is None or mask.all():
+        return None
+    return [int(i) for i in np.nonzero(mask)[0]]
+
+
+def _read_row_groups(
+    f: str, columns: Optional[List[str]], schema: pa.Schema, keep: List[int], dsp
+) -> B.Batch:
+    """Decode only the surviving row groups of one file (pyarrow path; the
+    native decoder reads whole column chunks). Fully-pruned files return a
+    typed empty batch from the file schema."""
+    scanned_c, skipped_c, bytes_c = _rg_counters()
+    md = pq.read_metadata(f)
+    n_rg = md.num_row_groups
+    kept = set(keep)
+    sk_bytes = sum(
+        md.row_group(i).total_byte_size for i in range(n_rg) if i not in kept
+    )
+    scanned_c.inc(len(keep))
+    skipped_c.inc(n_rg - len(keep))
+    bytes_c.inc(sk_bytes)
+    dsp.set(rowgroups_skipped=n_rg - len(keep), rowgroup_bytes_skipped=int(sk_bytes))
+    if not keep:
+        trace.record("decode", "rowgroup-pruned")
+        t = schema.empty_table()
+        if columns is not None:
+            t = t.select(columns)
+        return B.table_to_batch(t)
+    ckey = _io_cache_key(f, columns)
+    ckey = ckey + (("rg",) + tuple(keep),) if ckey is not None else None
+    got = _io_cache_get(ckey)
+    if got is not None:
+        trace.record("decode", "cached")
+        return got
+    trace.record("decode", "pyarrow-rowgroups")
+    t = pq.ParquetFile(f).read_row_groups(keep, columns=columns)
+    got = B.table_to_batch(t)
+    dsp.set(rows=B.num_rows(got))
+    _io_cache_put(ckey, got)
+    return got
+
+
+def read_parquet_batch(
+    files: List[str], columns: Optional[List[str]], predicate=None
+) -> B.Batch:
     """Read ``columns`` of ``files`` into one concatenated batch, native-first.
 
     Schema-evolved datasets (a file missing a requested column, or differing
     per-file schemas when ``columns`` is None) go through a single
     dataset-level pyarrow read, which unifies schemas and null-fills — the
     per-file native path requires every file to carry every column.
+
+    ``predicate`` (a pushed-down filter Expr) enables row-group min/max
+    pruning: groups its statistics definitively exclude are never decoded.
+    The caller's Filter still applies the predicate, so a cached full-file
+    batch (more rows) is always an acceptable answer.
     """
     from hyperspace_tpu import native
 
@@ -234,6 +412,10 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
             if got is not None:
                 trace.record("decode", "cached")
                 return got
+            if predicate is not None:
+                keep = prune_row_groups(f, predicate)
+                if keep is not None:
+                    return _read_row_groups(f, columns, schema, keep, dsp)
             try:
                 cols = list(columns) if columns is not None else list(schema.names)
                 hints = _dtype_hints(schema, cols)
@@ -274,6 +456,9 @@ def read_parquet_batch(files: List[str], columns: Optional[List[str]]) -> B.Batc
     if len(batches) == 1:
         return batches[0]
     out = B.concat(batches)
-    if concat_key is not None:
+    # a predicate-pruned concatenation holds FEWER rows than the full scan;
+    # caching it under the unpruned concat key would poison predicate-less
+    # readers of the same files with silently missing rows
+    if concat_key is not None and predicate is None:
         _io_cache_put(concat_key, out)
     return out

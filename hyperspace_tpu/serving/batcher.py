@@ -61,21 +61,26 @@ def execute_shared_scan(
     leaf: L.LogicalPlan,
     bound_plans: List[L.LogicalPlan],
 ) -> List[B.Batch]:
-    """One leaf decode, then per-request mask/project over the shared batch.
-    Returns one result batch per bound plan, in order."""
+    """One streamed leaf decode, then per-request mask/project over each
+    shared chunk. Returns one result batch per bound plan, in order.
+
+    The leaf streams through ``execute_stream`` (so multi-chunk leaves ride
+    the prefetch pipeline: chunk k+1 decodes while chunk k's request masks
+    evaluate); every op here is row-wise, so per-chunk application followed
+    by concatenation is exactly the materialized result."""
     from hyperspace_tpu.exec.executor import Executor
 
-    base = Executor(session).execute(leaf, prepruned=True)
-    results = []
-    for bound in bound_plans:
-        conds = _bound_conditions(bound)
-        ci = len(conds)
-        batch = base
-        for kind, payload in reversed(ops):  # leaf -> root
-            if kind == "filter":
-                ci -= 1
-                batch = B.mask_rows(batch, as_bool_mask(conds[ci].eval(batch)))
-            else:
-                batch = B.select(batch, payload)
-        results.append(batch)
-    return results
+    per_request_conds = [_bound_conditions(bound) for bound in bound_plans]
+    pieces: List[List[B.Batch]] = [[] for _ in bound_plans]
+    for base in Executor(session).execute_stream(leaf):
+        for r, conds in enumerate(per_request_conds):
+            ci = len(conds)
+            batch = base
+            for kind, payload in reversed(ops):  # leaf -> root
+                if kind == "filter":
+                    ci -= 1
+                    batch = B.mask_rows(batch, as_bool_mask(conds[ci].eval(batch)))
+                else:
+                    batch = B.select(batch, payload)
+            pieces[r].append(batch)
+    return [ps[0] if len(ps) == 1 else B.concat(ps) for ps in pieces]

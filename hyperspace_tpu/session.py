@@ -35,6 +35,11 @@ class Session:
         # global JAX side effects — documented in docs/configuration.md
         ensure_x64()
         self.conf = HyperspaceConf(conf)
+        # apply the configured decode-pool width (the pool is process-global;
+        # the most recently constructed session's conf wins, env overrides)
+        from hyperspace_tpu.exec import io as _io
+
+        _io.set_decode_threads(self.conf.io_decode_threads)
         self.provider_manager = FileBasedSourceProviderManager(self)
         # context-local override beats the session-wide default, so a scoped
         # toggle (with_hyperspace_disabled, a serving worker pinning the flag

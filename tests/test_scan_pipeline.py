@@ -1,0 +1,333 @@
+"""Pipelined scan engine (decode/transfer/compute overlap, row-group pruning,
+shape-bucketed executables) — the three-stage accelerator input-pipeline
+treatment of the scan path.
+
+Pinned properties:
+- streamed execution (pipelined OR serial) is byte-identical to materialized;
+- row-group min/max pruning never changes results, preserves the schema of
+  fully-eliminated chunks, and skips decode work (counters prove it);
+- closing a stream mid-flight leaks no futures/threads;
+- geometric shape buckets keep hs_xla_compiles_total constant after the
+  first chunks of a stream.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exec import io as hio
+from hyperspace_tpu.exec.pipeline import ScanPipeline
+from hyperspace_tpu.plan.expr import BinaryOp, Col, Lit
+
+pytestmark = pytest.mark.pipeline
+
+
+def _write_files(d, num_files=6, rows_per=4000, seed=11, row_group_size=1000):
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(seed)
+    for i in range(num_files):
+        # k is written SORTED within each file so row groups carry disjoint
+        # min/max ranges — the shape row-group pruning exploits
+        k = np.sort(rng.integers(0, 1000, rows_per).astype(np.int64))
+        t = pa.table(
+            {
+                "k": k,
+                "v": np.round(rng.uniform(0, 100, rows_per), 3),
+                "name": np.array([f"row_{i}_{j % 23}" for j in range(rows_per)]),
+            }
+        )
+        pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"), row_group_size=row_group_size)
+    return d
+
+
+def _mk_session(tmp_path, **conf):
+    base = {
+        hst.keys.SYSTEM_PATH: str(tmp_path / "indexes"),
+        hst.keys.NUM_BUCKETS: 8,
+        hst.keys.EXEC_STREAM_CHUNK_BYTES: 1,  # one file per chunk
+    }
+    base.update(conf)
+    sess = hst.Session(conf=base)
+    hst.set_session(sess)
+    return sess
+
+
+def _assert_batches_equal(got, want):
+    assert set(got) == set(want)
+    for c in want:
+        g, w = got[c], want[c]
+        assert g.dtype == w.dtype or g.dtype.kind == w.dtype.kind
+        np.testing.assert_array_equal(g, w)
+
+
+class TestStreamedEquality:
+    def test_pipelined_stream_matches_materialized(self, tmp_path):
+        data = _write_files(str(tmp_path / "data"))
+        sess = _mk_session(tmp_path)
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 400).select("k", "v")
+        want = q.collect()
+        chunks = list(q.to_local_iterator())
+        assert len(chunks) > 1
+        got = {c: np.concatenate([b[c] for b in chunks]) for c in want}
+        _assert_batches_equal(got, want)
+
+    def test_serial_fallback_matches_pipelined(self, tmp_path):
+        data = _write_files(str(tmp_path / "data"))
+        sess = _mk_session(tmp_path)
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 400).select("k", "v")
+        piped = list(q.to_local_iterator())
+        sess.conf.set(hst.keys.EXEC_PIPELINE_ENABLED, False)
+        serial = list(q.to_local_iterator())
+        assert len(piped) == len(serial)
+        for p, s in zip(piped, serial):
+            _assert_batches_equal(p, s)
+
+    def test_chunks_fully_eliminated_by_pruning(self, tmp_path):
+        """A predicate outside some files' k ranges prunes every row group of
+        those chunks; the stream still yields schema-preserving batches and
+        the total equals the materialized result."""
+        d = str(tmp_path / "data")
+        os.makedirs(d)
+        for i in range(4):
+            k = np.arange(i * 1000, (i + 1) * 1000, dtype=np.int64)
+            t = pa.table({"k": k, "v": k.astype(np.float64) / 7})
+            pq.write_table(t, os.path.join(d, f"part-{i:05d}.parquet"), row_group_size=250)
+        sess = _mk_session(tmp_path)
+        df = sess.read_parquet(d)
+        q = df.filter(hst.col("k") >= 3500).select("k", "v")
+        chunks = list(q.to_local_iterator())
+        assert len(chunks) == 4
+        for b in chunks:
+            assert set(b) == {"k", "v"}
+            assert b["k"].dtype == np.int64
+            assert b["v"].dtype == np.float64
+        got = np.concatenate([b["k"] for b in chunks])
+        np.testing.assert_array_equal(np.sort(got), np.arange(3500, 4000))
+
+
+class TestRowGroupPruning:
+    def _one_file(self, tmp_path):
+        p = str(tmp_path / "x.parquet")
+        t = pa.table(
+            {
+                "a": pa.array(np.arange(40, dtype=np.int64)),
+                "s": pa.array([f"s{i:02d}" for i in range(40)]),
+            }
+        )
+        pq.write_table(t, p, row_group_size=10)
+        return p
+
+    def test_prune_semantics(self, tmp_path):
+        p = self._one_file(tmp_path)
+        assert hio.prune_row_groups(p, BinaryOp(">=", Col("a"), Lit(35))) == [3]
+        assert hio.prune_row_groups(p, BinaryOp("=", Col("s"), Lit("s17"))) == [1]
+        assert hio.prune_row_groups(p, BinaryOp("<", Col("a"), Lit(-1))) == []
+        # nothing prunable -> None (keep all)
+        assert hio.prune_row_groups(p, BinaryOp(">=", Col("a"), Lit(0))) is None
+
+    def test_pruned_read_and_counters(self, tmp_path):
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        p = self._one_file(tmp_path)
+        skipped = REGISTRY.counter(
+            "hs_rowgroups_skipped_total",
+            "Parquet row groups skipped by min/max statistics pruning",
+        )
+        before = skipped.value
+        b = hio.read_parquet_batch([p], ["a"], predicate=BinaryOp(">=", Col("a"), Lit(35)))
+        # the surviving row group [30, 40) decodes WHOLE — pruning yields a
+        # superset of matching rows; the Filter above re-applies the predicate
+        np.testing.assert_array_equal(b["a"], np.arange(30, 40))
+        assert skipped.value == before + 3
+
+    def test_fully_pruned_keeps_schema(self, tmp_path):
+        p = self._one_file(tmp_path)
+        b = hio.read_parquet_batch([p], ["a", "s"], predicate=BinaryOp("<", Col("a"), Lit(-1)))
+        assert b["a"].dtype == np.int64 and b["a"].shape == (0,)
+        assert b["s"].shape == (0,)
+
+    def test_pruned_read_never_poisons_full_cache(self, tmp_path):
+        p = self._one_file(tmp_path)
+        pruned = hio.read_parquet_batch([p], ["a"], predicate=BinaryOp(">=", Col("a"), Lit(35)))
+        assert len(pruned["a"]) == 10  # one surviving row group of 10
+        full = hio.read_parquet_batch([p], ["a"])
+        assert len(full["a"]) == 40
+
+    def test_conf_kill_switch(self, tmp_path):
+        data = _write_files(str(tmp_path / "data"), num_files=2)
+        sess = _mk_session(tmp_path, **{hst.keys.EXEC_IO_ROWGROUP_PRUNING: False})
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 100).select("k")
+        want = np.sort(q.collect()["k"])
+        got = np.sort(np.concatenate([b["k"] for b in q.to_local_iterator()]))
+        np.testing.assert_array_equal(got, want)
+
+
+class TestScanPipelineUnit:
+    def test_ordered_results_and_counters(self):
+        def mk(i):
+            def task():
+                time.sleep(0.002 * (5 - i))  # later tasks finish FIRST
+                return i
+
+            return task
+
+        pipe = ScanPipeline([mk(i) for i in range(5)], depth=2)
+        assert list(pipe) == [0, 1, 2, 3, 4]
+
+    def test_close_midstream_leaks_nothing(self):
+        started, finished = [], []
+        release = threading.Event()
+
+        def mk(i):
+            def task():
+                started.append(i)
+                release.wait(5)
+                finished.append(i)
+                return i
+
+            return task
+
+        pipe = ScanPipeline([mk(i) for i in range(8)], depth=1)
+        it = iter(pipe)
+        t = threading.Thread(target=lambda: next(it))
+        t.start()
+        time.sleep(0.05)
+        release.set()
+        t.join(5)
+        pipe.close()
+        # close() waits for in-flight tasks: everything started has finished,
+        # and queued-but-cancelled tasks never started
+        assert sorted(finished) == sorted(started)
+        assert len(started) < 8
+
+    def test_generator_close_midstream(self, tmp_path):
+        data = _write_files(str(tmp_path / "data"))
+        sess = _mk_session(tmp_path)
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 400).select("k", "v")
+        it = q.to_local_iterator()
+        first = next(it)
+        assert len(first) == 2
+        it.close()  # must not raise, deadlock, or leave workers running
+
+    def test_byte_budget_limits_lookahead(self):
+        order = []
+
+        def mk(i):
+            def task():
+                order.append(i)
+                return np.zeros(1 << 16)
+
+            return task
+
+        # depth allows chunk 5 at k=1 (1+4), but the byte budget — already
+        # exceeded by completed-unconsumed chunks 2-4 — must veto it until
+        # it becomes the always-allowed one-ahead chunk
+        pipe = ScanPipeline(
+            [mk(i) for i in range(6)],
+            depth=4,
+            max_buffered_bytes=1,
+            weigh=lambda a: int(a.nbytes),
+        )
+        it = iter(pipe)
+        next(it)  # consume chunk 0; 1-4 submitted by the initial pump
+        deadline = time.monotonic() + 5
+        while pipe._buffered <= 1 and time.monotonic() < deadline:
+            time.sleep(0.005)  # wait for completions to register their weight
+        assert pipe._buffered > 1
+        next(it)  # k=1: pump sees the exceeded budget
+        assert 5 not in order
+        rest = list(it)  # budget stalls lookahead, never starves the stream
+        assert len(rest) == 4
+        assert sorted(order) == list(range(6))
+
+
+class TestShapeBuckets:
+    def test_bucket_rows_geometry(self):
+        from hyperspace_tpu.exec.device import bucket_rows
+
+        assert bucket_rows(1) == 4096
+        assert bucket_rows(4096) == 4096
+        buckets = {bucket_rows(n) for n in range(3000, 6000)}
+        assert len(buckets) <= 3  # a whole stream's chunk spread -> few shapes
+        for n in (1, 100, 5000, 123457):
+            assert bucket_rows(n) >= n
+        # geometric growth: consecutive buckets within sqrt(2)+eps
+        b = 4096
+        for _ in range(10):
+            nxt = bucket_rows(b + 1)
+            assert b < nxt <= int(b * 1.5) + 2
+            b = nxt
+
+    def test_compile_count_constant_after_first_chunks(self, tmp_path):
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        data = _write_files(str(tmp_path / "data"), num_files=6, rows_per=5000)
+        sess = _mk_session(tmp_path, **{hst.keys.TPU_QUERY_DEVICE_MIN_ROWS: 1})
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 400).select("k", "v")
+        compiles = REGISTRY.counter(
+            "hs_xla_compiles_total",
+            "Distinct (device program skeleton, input shape) XLA compilations",
+        )
+        counts = []
+        for b in q.to_local_iterator():
+            counts.append(compiles.value)
+        assert len(counts) == 6
+        assert counts[-1] == counts[1], f"compiles kept growing: {counts}"
+
+
+class TestDecodeThreadsConf:
+    def test_conf_resizes_pool(self, tmp_path):
+        old = hio._CONFIGURED_THREADS
+        try:
+            hio.set_decode_threads(3)
+            if not os.environ.get("HS_DECODE_THREADS"):
+                assert hio.decode_threads() == 3
+                pool = hio._decode_pool()
+                assert pool._max_workers == 3
+            _mk_session(tmp_path, **{hst.keys.EXEC_IO_DECODE_THREADS: 5})
+            if not os.environ.get("HS_DECODE_THREADS"):
+                assert hio.decode_threads() == 5
+                assert hio._decode_pool()._max_workers == 5
+        finally:
+            hio.set_decode_threads(old)
+
+    def test_default_is_eight(self):
+        from hyperspace_tpu.config import DEFAULTS
+
+        assert DEFAULTS[hst.keys.EXEC_IO_DECODE_THREADS] == 8
+
+
+class TestSpansShowOverlap:
+    def test_prefetch_and_execute_spans_in_stream_trace(self, tmp_path):
+        from hyperspace_tpu.obs import spans
+
+        data = _write_files(str(tmp_path / "data"))
+        sess = _mk_session(tmp_path)
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 400).select("k", "v")
+        with spans.trace("stream") as root:
+            list(q.to_local_iterator())
+        prefetch = root.find("prefetch")
+        execute = root.find("execute")
+        assert len(prefetch) >= 2 and len(execute) >= 2
+        # prefetch runs on pipeline-pool threads, not the consumer's
+        consumer_tid = threading.get_ident()
+        assert any(s.tid != consumer_tid for s in prefetch)
+        # chunk k+1's prefetch is submitted before chunk k's execute finishes
+        by_chunk = {s.attrs.get("chunk"): s for s in prefetch}
+        ex0 = min(execute, key=lambda s: s.attrs.get("chunk", 0))
+        nxt = by_chunk.get(ex0.attrs.get("chunk", 0) + 1)
+        assert nxt is not None
+        assert nxt.t0 <= ex0.t1  # started no later than execute-0 ended
