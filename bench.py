@@ -445,6 +445,142 @@ def scan_pipeline_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def groupby_main() -> None:
+    """``python bench.py --groupby``: device grouped-aggregation benchmark.
+
+    TPC-H q1-shaped query (filter + two group keys + six aggregates) over a
+    covering index, device segment-reduction engine vs the host pandas
+    aggregation — same session, ``TPU_QUERY_DEVICE_EXECUTION`` toggled, both
+    sides reading the same io-cached scan so the comparison is the aggregation
+    work itself. Reports cold (first device run, includes XLA compile) and
+    warm (steady-state, min of reps) timings, checks results are
+    byte-identical on exact columns (keys, counts, int sums, min/max — float
+    reductions differ only in summation order and are checked to tolerance),
+    and that warm runs add zero compiles. Baseline: >= 1.5x warm device/host;
+    writes BENCH_groupby.json.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    num_files = int(os.environ.get("BENCH_GROUPBY_FILES", 8))
+    rows_per = int(os.environ.get("BENCH_GROUPBY_ROWS_PER_FILE", 500_000))
+    reps = max(1, int(os.environ.get("BENCH_GROUPBY_REPS", 3)))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_groupby_")
+    try:
+        import jax
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        data_dir = os.path.join(tmp, "lineitem")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        rng = np.random.default_rng(11)
+        for i in range(num_files):
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": rng.integers(0, 1_000_000, rows_per).astype(np.int64),
+                        "g1": rng.integers(0, 25, rows_per).astype(np.int64),
+                        "g2": rng.integers(0, 40, rows_per).astype(np.int64),
+                        "qty": rng.integers(1, 51, rows_per).astype(np.int64),
+                        "price": rng.uniform(900.0, 105_000.0, rows_per),
+                        "disc": rng.uniform(0.0, 0.1, rows_per),
+                    }
+                ),
+                os.path.join(data_dir, f"part-{i:05d}.parquet"),
+                compression="zstd",
+            )
+
+        sess = hst.Session(
+            conf={
+                hst.keys.SYSTEM_PATH: sys_dir,
+                hst.keys.NUM_BUCKETS: 8,
+                hst.keys.TPU_QUERY_DEVICE_MIN_ROWS: 1,
+                # materialized one-shot on both sides: the streamed variant is
+                # covered by its own tests; here we time the aggregation alone
+                hst.keys.EXEC_STREAM_AGG_MIN_BYTES: 1 << 60,
+            }
+        )
+        hst.set_session(sess)
+        hs = hst.Hyperspace(sess)
+        df = sess.read_parquet(data_dir)
+        hs.create_index(
+            df,
+            hst.CoveringIndexConfig(
+                "gbIdx", ["k"], ["g1", "g2", "qty", "price", "disc"]
+            ),
+        )
+        sess.enable_hyperspace()
+        q = (
+            df.filter(hst.col("k") < 500_000)
+            .group_by("g1", "g2")
+            .agg(
+                n=("*", "count"),
+                sum_qty=("qty", "sum"),
+                lo=("qty", "min"),
+                hi=("qty", "max"),
+                sum_price=("price", "sum"),
+                avg_disc=("disc", "avg"),
+            )
+        )
+        compiles = REGISTRY.counter(
+            "hs_xla_compiles_total", "first-time XLA compilations (program x shape bucket)"
+        )
+
+        def run(device: bool):
+            sess.conf.set(hst.keys.TPU_QUERY_DEVICE_EXECUTION, device)
+            t0 = time.perf_counter()
+            out = q.collect()
+            return out, time.perf_counter() - t0
+
+        host_res, _ = run(False)  # warms the io cache for every later run
+        c0 = compiles.value
+        dev_res, cold_dev = run(True)  # first device run: compile + staging
+        cold_compiles = compiles.value - c0
+        dev_times = [run(True)[1] for _ in range(reps)]
+        warm_compile_delta = compiles.value - c0 - cold_compiles
+        host_times = [run(False)[1] for _ in range(reps)]
+        dt_dev, dt_host = min(dev_times), min(host_times)
+
+        exact = ("g1", "g2", "n", "sum_qty", "lo", "hi")
+        identical = len(dev_res["n"]) == len(host_res["n"]) and all(
+            np.asarray(dev_res[k]).tobytes() == np.asarray(host_res[k]).tobytes()
+            for k in exact
+        )
+        floats_ok = all(
+            np.allclose(dev_res[k], host_res[k], rtol=1e-9, equal_nan=True)
+            for k in ("sum_price", "avg_disc")
+        )
+        src_rows = num_files * rows_per
+        speedup = dt_host / dt_dev
+        out = {
+            "metric": "groupby_device_speedup",
+            "value": round(speedup, 3),
+            "unit": "x vs host",
+            "vs_baseline": round(speedup / 1.5, 4),  # baseline: 1.5x
+            "device_rows_per_sec": round(src_rows / dt_dev, 1),
+            "host_rows_per_sec": round(src_rows / dt_host, 1),
+            "cold_device_s": round(cold_dev, 4),
+            "warm_device_s": round(dt_dev, 4),
+            "host_s": round(dt_host, 4),
+            "groups": int(len(dev_res["n"])),
+            "byte_identical": bool(identical),
+            "floats_within_tolerance": bool(floats_ok),
+            "cold_compiles": int(cold_compiles),
+            "warm_compile_delta": int(warm_compile_delta),
+            "platform": jax.default_backend(),
+        }
+        line = json.dumps(out)
+        with open("BENCH_groupby.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     _honor_cpu_request()
     _backend_watchdog()
@@ -529,5 +665,7 @@ if __name__ == "__main__":
         obs_main()
     elif "--scan-pipeline" in sys.argv[1:]:
         scan_pipeline_main()
+    elif "--groupby" in sys.argv[1:]:
+        groupby_main()
     else:
         main()

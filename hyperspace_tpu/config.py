@@ -59,6 +59,12 @@ class keys:
     EXEC_PIPELINE_ENABLED = "hyperspace.exec.pipeline.enabled"
     EXEC_PIPELINE_DEPTH = "hyperspace.exec.pipeline.depth"
     EXEC_PIPELINE_MAX_BUFFERED_BYTES = "hyperspace.exec.pipeline.maxBufferedBytes"
+    # Device grouped aggregation (exec/device.py sort-based segment
+    # reduction): master switch, host-spill cardinality bound, and the
+    # smallest segment-capacity bucket.
+    EXEC_AGG_DEVICE_GROUPED = "hyperspace.exec.agg.enabled"
+    EXEC_AGG_MAX_GROUPS = "hyperspace.exec.agg.maxGroups"
+    EXEC_AGG_CAPACITY_FLOOR = "hyperspace.exec.agg.capacityFloor"
     # Query-serving runtime (hyperspace_tpu/serving/): concurrent request
     # admission, compiled-plan caching, micro-batching, bucket prefetch.
     SERVING_QUEUE_DEPTH = "hyperspace.serving.queueDepth"
@@ -181,6 +187,18 @@ DEFAULTS: Dict[str, Any] = {
     # above it (one chunk ahead is always allowed, or the pipeline would
     # degenerate to serial on a single oversized chunk).
     keys.EXEC_PIPELINE_MAX_BUFFERED_BYTES: 1 << 30,
+    # Grouped aggregates over index/file scans run on device as one fused
+    # predicate + sort-based segment-reduction program (exec/device.py);
+    # False routes every group-by back to the host pandas path.
+    keys.EXEC_AGG_DEVICE_GROUPED: True,
+    # When the observed group cardinality exceeds this, the device grouped
+    # path spills to the host hash-combine (pandas) path — segment capacity
+    # (and the per-group output tables) stay bounded on device.
+    keys.EXEC_AGG_MAX_GROUPS: 1 << 20,
+    # Smallest `num_segments` capacity bucket; capacities grow geometrically
+    # (powers of sqrt(2)) above it so arbitrary cardinalities land on a
+    # handful of cached executables.
+    keys.EXEC_AGG_CAPACITY_FLOOR: 256,
     # Serving runtime. Queue depth bounds memory under overload: submits
     # beyond it are REJECTED (AdmissionRejected), never silently queued.
     keys.SERVING_QUEUE_DEPTH: 64,
@@ -400,6 +418,18 @@ class HyperspaceConf:
     @property
     def pipeline_max_buffered_bytes(self) -> int:
         return int(self.get(keys.EXEC_PIPELINE_MAX_BUFFERED_BYTES))
+
+    @property
+    def agg_device_grouped_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_AGG_DEVICE_GROUPED))
+
+    @property
+    def agg_max_groups(self) -> int:
+        return int(self.get(keys.EXEC_AGG_MAX_GROUPS))
+
+    @property
+    def agg_capacity_floor(self) -> int:
+        return int(self.get(keys.EXEC_AGG_CAPACITY_FLOOR))
 
     # Serving runtime --------------------------------------------------------
     @property

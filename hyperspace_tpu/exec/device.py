@@ -56,6 +56,12 @@ class DeviceUnsupported(Exception):
     """Raised when an expression/plan shape cannot run on the device path."""
 
 
+class GroupCapacityExceeded(DeviceUnsupported):
+    """Observed group cardinality exceeds conf ``hyperspace.exec.agg.maxGroups``
+    — the caller spills to the host hash-combine path (the accumulated device
+    partial stays valid; see ``GroupedAggStream.to_partial_frame``)."""
+
+
 # --------------------------------------------------------------------------
 # column encoding
 # --------------------------------------------------------------------------
@@ -558,6 +564,7 @@ def clear_device_cache() -> None:
     # clear too or decode-count dispatch traces depend on run history
     _RANK_CACHE.clear()
     _REBUCKET_CACHE.clear()
+    _CAP_HINT_MEMO.clear()
 
 
 def _cached_predicate_jit(skeleton: str, fn):
@@ -650,21 +657,24 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
     return np.asarray(mask)[:n]
 
 
-def stage_filter_columns(session, batch: B.Batch, condition: Expr, scan_key) -> None:
+def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], scan_key, extra_columns=None) -> None:
     """H2D staging hook for the scan pipeline (stage 2 of 3): encode,
     bucket-pad and ``device_put`` ``condition``'s columns into the device
     cache on the prefetch thread, so the consumer's ``device_filter_mask``
     on this chunk is a pure cache hit and the transfer overlaps chunk k's
-    compute. Silently a no-op when the predicate is outside the device
-    language or ``scan_key`` is None (nothing would be cached)."""
-    if scan_key is None or condition is None:
+    compute. ``extra_columns`` (group keys / aggregate inputs for the fused
+    grouped-aggregate path) stage alongside the predicate columns. Silently
+    a no-op when the predicate is outside the device language or
+    ``scan_key`` is None (nothing would be cached)."""
+    if scan_key is None or (condition is None and not extra_columns):
         return
     n = B.num_rows(batch)
     if n == 0:
         return
-    refs = sorted(condition.references())
+    refs = sorted(condition.references()) if condition is not None else []
     if any(r not in batch for r in refs):
         return
+    cols = list(dict.fromkeys(refs + [c for c in (extra_columns or []) if c in batch]))
     from hyperspace_tpu.obs import spans as obs_spans
 
     try:
@@ -672,12 +682,13 @@ def stage_filter_columns(session, batch: B.Batch, condition: Expr, scan_key) -> 
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        compile_predicate(condition, _dry_codecs(batch, refs))
+        if condition is not None:
+            compile_predicate(condition, _dry_codecs(batch, refs))
         mesh = session.mesh
         n_dev = mesh.devices.size
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         with obs_spans.span("h2d-stage", cat="pipeline", rows=n):
-            for r in refs:
+            for r in cols:
                 ckey = (scan_key, r, n_dev)
                 cached = _device_cache_get(ckey)
                 if cached is not None and cached[2] == n:
@@ -833,6 +844,646 @@ def device_filtered_aggregate(
             else:
                 result[name] = np.asarray([float(val)])
     return result
+
+
+# --------------------------------------------------------------------------
+# fused filter + grouped aggregate: sort-based segment reduction
+#
+# One jitted program per (predicate skeleton, key/slot spec, shape bucket,
+# capacity bucket): predicate mask, lexicographic rank-compression of the
+# encoded group keys, and jax.ops.segment_sum/min/max reductions all run on
+# device; only the per-group partial table (<= capacity rows) ever leaves.
+# Streamed chunks each produce such a partial, merged chunk-to-chunk ON
+# DEVICE by the same segment-reduction applied to the concatenated partials
+# (avg/stddev decompose into sum/count/sumsq, so every state is mergeable).
+# `num_segments` capacities grow geometrically (powers of sqrt(2) over a
+# conf floor) so arbitrary group cardinalities land on a handful of cached
+# executables; cardinalities beyond conf maxGroups spill to the host
+# hash-combine path via DeviceUnsupported.
+# --------------------------------------------------------------------------
+
+_GROUPED_AGG_FNS = ("count", "sum", "min", "max", "avg", "stddev_samp")
+
+_FS_SENTINEL = np.int64(np.iinfo(np.int64).max)
+
+
+def group_capacity(n: int, floor: int) -> int:
+    """Smallest geometric capacity bucket (powers of sqrt(2) over ``floor``)
+    holding ``n`` groups — same geometry as the row-shape buckets, applied to
+    ``num_segments`` so cardinality sweeps reuse executables."""
+    return bucket_rows(max(1, int(n)), floor=max(1, int(floor)))
+
+
+def _grouped_slots(aggs, is_int: Dict[str, bool]):
+    """Decompose ``aggs`` into deduplicated mergeable state slots.
+
+    Returns (slots, refs): ``slots`` is a list of (kind, col, int-valued)
+    with kind in cntm/cnt/sum/sumsq/min/max (cntm = matched-row count for
+    count(*)); ``refs[i]`` maps aggregate i to its slot indices."""
+    slots: List[Tuple[str, Optional[str], bool]] = []
+    index: Dict[Tuple[str, Optional[str], bool], int] = {}
+
+    def slot(kind, col, isint):
+        key = (kind, col, isint)
+        got = index.get(key)
+        if got is None:
+            got = index[key] = len(slots)
+            slots.append(key)
+        return got
+
+    refs: List[List[int]] = []
+    for _, fn, c in aggs:
+        if fn not in _GROUPED_AGG_FNS:
+            raise DeviceUnsupported(f"unsupported grouped aggregate fn {fn!r}")
+        if fn == "count" and c is None:
+            refs.append([slot("cntm", None, True)])
+            continue
+        if c is None:
+            raise DeviceUnsupported(f"aggregate {fn!r} without an input column")
+        ii = bool(is_int[c])
+        if fn == "count":
+            refs.append([slot("cnt", c, ii)])
+        elif fn == "sum":
+            refs.append([slot("sum", c, ii), slot("cnt", c, ii)])
+        elif fn == "min":
+            refs.append([slot("min", c, ii), slot("cnt", c, ii)])
+        elif fn == "max":
+            refs.append([slot("max", c, ii), slot("cnt", c, ii)])
+        elif fn == "avg":
+            # float64 sum even for int inputs (the host streaming partial
+            # does the same); exactness holds below 2^53
+            refs.append([slot("sum", c, False), slot("cnt", c, ii)])
+        else:  # stddev_samp
+            refs.append([slot("cnt", c, ii), slot("sum", c, False), slot("sumsq", c, False)])
+    return slots, refs
+
+
+def _key_code(k, tag):
+    """int64 grouping code of an encoded key column: equality of codes ==
+    group identity. Floats canonicalize (-0.0 -> +0.0, NaN -> one canonical
+    NaN, so NaN keys form ONE group like pandas dropna=False) then bitcast."""
+    import jax.numpy as jnp
+
+    if tag == "f":
+        kf = k.astype(jnp.float64)
+        kf = jnp.where(jnp.isnan(kf), jnp.float64(np.nan), kf + 0.0)
+        return jax.lax.bitcast_convert_type(kf, jnp.int64)
+    return k.astype(jnp.int64)
+
+
+def _segment_ids(codes, mask, cap):
+    """Sort rows so equal key tuples are adjacent (masked rows last), then
+    rank-compress into segment ids. Returns (order, sorted-mask, n_groups,
+    scatter ids) — scatter ids send masked rows to ``cap``, which
+    segment_sum/min/max silently drop (out-of-range scatter)."""
+    import jax.numpy as jnp
+
+    total = mask.shape[0]
+    inv = (~mask).astype(jnp.int32)
+    order = jnp.lexsort(tuple(reversed(codes)) + (inv,))
+    ms = mask[order]
+    ch = jnp.zeros((total - 1,), dtype=bool)
+    for c in codes:
+        cs = c[order]
+        ch = ch | (cs[1:] != cs[:-1])
+    ch = ch | (ms[1:] != ms[:-1])
+    seg = jnp.concatenate([jnp.zeros((1,), jnp.int64), jnp.cumsum(ch.astype(jnp.int64))])
+    n_groups = jnp.max(jnp.where(ms, seg, -1)) + 1
+    segs = jnp.where(ms, seg, cap)
+    return order, ms, n_groups, segs
+
+
+def _segment_reduce_slots(cols_sorted, ms, segs, cap, slot_specs):
+    """Per-slot segment reductions over the sorted rows. ``cols_sorted`` maps
+    input column -> (sorted values, int-valued)."""
+    import jax.numpy as jnp
+    from jax import ops as jops
+
+    out = []
+    for kind, col, isint in slot_specs:
+        if kind == "cntm":
+            out.append(jops.segment_sum(ms.astype(jnp.int64), segs, num_segments=cap, indices_are_sorted=True))
+            continue
+        x = cols_sorted[col]
+        nn = ms if isint else (ms & ~jnp.isnan(x))
+        if kind == "cnt":
+            out.append(jops.segment_sum(nn.astype(jnp.int64), segs, num_segments=cap, indices_are_sorted=True))
+        elif kind == "sum":
+            z = x.astype(jnp.int64) if isint else x.astype(jnp.float64)
+            out.append(jops.segment_sum(jnp.where(nn, z, z.dtype.type(0)), segs, num_segments=cap, indices_are_sorted=True))
+        elif kind == "sumsq":
+            xf = x.astype(jnp.float64)
+            out.append(jops.segment_sum(jnp.where(nn, xf * xf, 0.0), segs, num_segments=cap, indices_are_sorted=True))
+        elif kind == "min":
+            if isint:
+                z = jnp.where(nn, x.astype(jnp.int64), jnp.iinfo(jnp.int64).max)
+            else:
+                z = jnp.where(nn, x.astype(jnp.float64), jnp.inf)
+            out.append(jops.segment_min(z, segs, num_segments=cap, indices_are_sorted=True))
+        else:  # max
+            if isint:
+                z = jnp.where(nn, x.astype(jnp.int64), jnp.iinfo(jnp.int64).min)
+            else:
+                z = jnp.where(nn, x.astype(jnp.float64), -jnp.inf)
+            out.append(jops.segment_max(z, segs, num_segments=cap, indices_are_sorted=True))
+    return tuple(out)
+
+
+def _grouped_chunk_program(pred_fn, key_specs, slot_specs, cap):
+    """Build the fused filter -> group-by -> segment-reduce device program.
+
+    Returns n_groups, per-group first-seen global row index, per-group key
+    representatives (gathered from the first-occurrence row, so -0.0/NaN
+    payloads follow appearance order like pandas), and the state slots."""
+    import jax.numpy as jnp
+    from jax import ops as jops
+
+    def program(cols, lits, n_valid, row_base):
+        total = next(iter(cols.values())).shape[0]
+        valid = jnp.arange(total) < n_valid
+        mask = valid if pred_fn is None else (pred_fn(cols, lits) & valid)
+        codes = [_key_code(cols[name], tag) for name, tag in key_specs]
+        order, ms, n_groups, segs = _segment_ids(codes, mask, cap)
+        # first original row index per group == appearance order == the
+        # representative row the key values gather from
+        rep = jops.segment_min(
+            jnp.where(ms, order.astype(jnp.int64), jnp.int64(total)),
+            segs, num_segments=cap, indices_are_sorted=True,
+        )
+        repc = jnp.clip(rep, 0, total - 1)
+        fs = jnp.where(rep < total, rep + row_base, _FS_SENTINEL)
+        key_out = tuple(cols[name][repc] for name, _ in key_specs)
+        cols_sorted = {c: cols[c][order] for _, c, _ in slot_specs if c is not None}
+        slot_out = _segment_reduce_slots(cols_sorted, ms, segs, cap, slot_specs)
+        return n_groups, fs, key_out, slot_out
+
+    return program
+
+
+def _grouped_merge_program(key_specs, slot_specs, cap_in, cap_out):
+    """Merge two partial-aggregate tables (each padded to ``cap_in`` rows) on
+    device: concatenate, re-rank-compress the keys, and segment-reduce the
+    states with each slot's merge op (cnt/sum/sumsq add, min/max fold)."""
+    import jax.numpy as jnp
+    from jax import ops as jops
+
+    def program(keys_a, keys_b, slots_a, slots_b, fs_a, fs_b, n_a, n_b):
+        two = 2 * cap_in
+        idx = jnp.arange(cap_in)
+        mask = jnp.concatenate([idx < n_a, idx < n_b])
+        kcat = [jnp.concatenate([a, b]) for a, b in zip(keys_a, keys_b)]
+        codes = [_key_code(k, tag) for k, (_, tag) in zip(kcat, key_specs)]
+        order, ms, n_groups, segs = _segment_ids(codes, mask, cap_out)
+        # the running partial occupies the first half, and its groups were
+        # first seen no later than the incoming chunk's (row bases ascend),
+        # so min concat position == min first-seen representative
+        rep = jops.segment_min(
+            jnp.where(ms, order.astype(jnp.int64), jnp.int64(two)),
+            segs, num_segments=cap_out, indices_are_sorted=True,
+        )
+        repc = jnp.clip(rep, 0, two - 1)
+        key_out = tuple(k[repc] for k in kcat)
+        # values fed to the segment ops must follow the SORTED row order that
+        # ``segs`` is defined over (the keys above gather by concat position
+        # instead, so they stay unsorted)
+        fscat = jnp.concatenate([fs_a, fs_b])[order]
+        fs = jops.segment_min(
+            jnp.where(ms, fscat, _FS_SENTINEL), segs,
+            num_segments=cap_out, indices_are_sorted=True,
+        )
+        slot_out = []
+        for (kind, _, _), va, vb in zip(slot_specs, slots_a, slots_b):
+            v = jnp.concatenate([va, vb])[order]
+            if kind in ("cntm", "cnt", "sum", "sumsq"):
+                slot_out.append(jops.segment_sum(jnp.where(ms, v, v.dtype.type(0)), segs, num_segments=cap_out, indices_are_sorted=True))
+            elif kind == "min":
+                big = jnp.iinfo(jnp.int64).max if jnp.issubdtype(v.dtype, jnp.integer) else jnp.inf
+                slot_out.append(jops.segment_min(jnp.where(ms, v, big), segs, num_segments=cap_out, indices_are_sorted=True))
+            else:  # max
+                low = jnp.iinfo(jnp.int64).min if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf
+                slot_out.append(jops.segment_max(jnp.where(ms, v, low), segs, num_segments=cap_out, indices_are_sorted=True))
+        return n_groups, fs, key_out, tuple(slot_out)
+
+    return program
+
+
+def _dev_pad(arr, target, fill):
+    """Pad a (small, per-group) device array up to ``target`` rows."""
+    import jax.numpy as jnp
+
+    n = arr.shape[0]
+    if n == target:
+        return arr
+    return jnp.concatenate([arr, jnp.full((target - n,), fill, arr.dtype)])
+
+
+class GroupedAggStream:
+    """Streaming grouped aggregation with device-resident partials.
+
+    ``update(batch, condition)`` fuses the scan predicate with the grouped
+    segment reduction over one chunk and merges the resulting partial table
+    into the running device partial; ``finalize()`` pulls only the per-group
+    table back and reconstructs exact host-path semantics (NULL sums,
+    NaN-skipping counts, dtype-preserving min/max, appearance-ordered rows).
+
+    String group keys are grouped per-chunk in their chunk-local dictionary
+    codes, then the <= cardinality per-group codes are remapped into one
+    growing global dictionary between chunk and merge — O(groups) host
+    traffic, never O(rows).
+
+    Raises DeviceUnsupported whenever the shape, a dtype, or the observed
+    group cardinality (> ``max_groups``) leaves the device language; callers
+    fall back (or spill) to the host hash-combine path.
+    """
+
+    def __init__(
+        self, session, group_keys, aggs, *, max_groups: int, cap_floor: int, hint_key=None
+    ):
+        if not group_keys:
+            raise DeviceUnsupported("global aggregates take the fused-scalar path")
+        self.session = session
+        self.group_keys = list(group_keys)
+        self.aggs = [(name, fn, c) for name, fn, c in aggs]
+        self.max_groups = int(max_groups)
+        self.cap_floor = max(1, int(cap_floor))
+        self._schema = None  # per-key (tag, dtype, unit) + per-input dtype
+        self._slots = None
+        self._refs = None
+        self._partial = None  # dict(cap, n, fs, keys, slots) — device arrays
+        self._row_base = 0
+        # seed capacity from the last observed cardinality of the same query
+        # shape over the same scan: a fresh stream otherwise starts at the
+        # floor and pays a right-sizing re-run on EVERY repeated (warm) query
+        self._hint_key = (
+            (hint_key, tuple(self.group_keys), tuple((fn, c) for _, fn, c in self.aggs))
+            if hint_key is not None
+            else None
+        )
+        self._cap_hint = _CAP_HINT_MEMO.get(self._hint_key, 1)
+        self._strmaps: Dict[str, Dict[str, int]] = {}
+        self._struniq: Dict[str, List] = {}
+
+    # -- schema ---------------------------------------------------------------
+
+    def _key_tag(self, arr: np.ndarray) -> str:
+        kind = arr.dtype.kind
+        if kind in ("i", "u", "b"):
+            return "i"
+        if kind == "f":
+            return "f"
+        if kind == "M":
+            return "d"
+        if kind in ("U", "S", "O"):
+            return "s"
+        raise DeviceUnsupported(f"unsupported group-key dtype {arr.dtype}")
+
+    def _check_schema(self, batch: B.Batch):
+        keys_schema = []
+        for k in self.group_keys:
+            arr = batch[k]
+            tag = self._key_tag(arr)
+            unit = np.datetime_data(arr.dtype)[0] if tag == "d" else None
+            keys_schema.append((tag, arr.dtype, unit))
+        inputs = {}
+        for _, fn, c in self.aggs:
+            if c is None:
+                continue
+            kind = batch[c].dtype.kind
+            if kind not in ("i", "u", "b", "f"):
+                raise DeviceUnsupported(f"grouped aggregate over non-numeric column {c!r}")
+            inputs[c] = batch[c].dtype
+        if self._schema is None:
+            self._schema = (keys_schema, inputs)
+            self._slots, self._refs = _grouped_slots(
+                self.aggs, {c: dt.kind in ("i", "u", "b") for c, dt in inputs.items()}
+            )
+        else:
+            prev_keys, prev_inputs = self._schema
+            if [s[:1] + (s[2],) for s in prev_keys] != [s[:1] + (s[2],) for s in keys_schema] or {
+                c: dt.kind in ("i", "u", "b") for c, dt in prev_inputs.items()
+            } != {c: dt.kind in ("i", "u", "b") for c, dt in inputs.items()}:
+                raise DeviceUnsupported("chunk schema drift under grouped aggregate")
+
+    # -- chunk update ---------------------------------------------------------
+
+    @property
+    def has_data(self) -> bool:
+        return self._partial is not None
+
+    def update(self, batch: B.Batch, condition: Optional[Expr] = None, scan_key=None) -> None:
+        ensure_x64()
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        n = B.num_rows(batch)
+        if n == 0:
+            return
+        refs = sorted(condition.references()) if condition is not None else []
+        agg_inputs = sorted({c for _, _, c in self.aggs if c is not None})
+        for col in refs + agg_inputs + self.group_keys:
+            if col not in batch:
+                raise DeviceUnsupported(f"column {col!r} missing from batch")
+        self._check_schema(batch)
+        keys_schema, input_dtypes = self._schema
+        if condition is not None:
+            compile_predicate(condition, _dry_codecs(batch, refs))
+
+        mesh = self.session.mesh
+        n_dev = mesh.devices.size
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        dev_cols: Dict[str, "jax.Array"] = {}
+        codecs: Dict[str, ColumnCodec] = {}
+        for col in sorted(set(refs) | set(agg_inputs) | set(self.group_keys)):
+            ckey = (scan_key, col, n_dev) if scan_key is not None else None
+            cached = _device_cache_get(ckey) if ckey is not None else None
+            if cached is not None and cached[2] == n:
+                dev_cols[col], codecs[col] = cached[0], cached[1]
+                continue
+            arr, codec = encode_column(batch[col])
+            if codec.kind == "string" and col in agg_inputs:
+                raise DeviceUnsupported("string aggregate inputs stay host-side")
+            padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
+            dev = jax.device_put(padded, sharding)
+            dev_cols[col] = dev
+            codecs[col] = codec
+            if ckey is not None:
+                _device_cache_put(ckey, (dev, codec, n), int(padded.nbytes))
+        for col in agg_inputs:
+            if codecs[col].kind == "string":
+                raise DeviceUnsupported("string aggregate inputs stay host-side")
+
+        if condition is not None:
+            pred_fn, lit_values = compile_predicate(condition, codecs)
+            pred_sk = predicate_skeleton(condition, codecs)
+        else:
+            pred_fn, lit_values = None, ()
+            pred_sk = "<none>"
+        key_specs = tuple(
+            (name, "f" if tag == "f" else "i")
+            for name, (tag, _, _) in zip(self.group_keys, keys_schema)
+        )
+        base_sk = (
+            f"{pred_sk}|k:{','.join(f'{n_}:{t}' for n_, t in key_specs)}"
+            f"|s:{','.join(f'{k}:{c}:{int(i)}' for k, c, i in self._slots)}"
+        )
+
+        cap = group_capacity(max(self._cap_hint, 1), self.cap_floor)
+        shapes = tuple(dev_cols[r].shape for r in sorted(dev_cols))
+        while True:
+            skeleton = f"gagg[{cap}]:{base_sk}"
+            program = _grouped_chunk_program(pred_fn, key_specs, self._slots, cap)
+            jitted = _cached_predicate_jit(skeleton, program)
+            _note_compile(skeleton, shapes)
+            n_g_dev, fs, key_out, slot_out = jitted(
+                dev_cols, lit_values, np.int64(n), np.int64(self._row_base)
+            )
+            n_g = int(n_g_dev)
+            if n_g > self.max_groups:
+                exc = GroupCapacityExceeded(
+                    f"group cardinality {n_g} exceeds maxGroups {self.max_groups}"
+                )
+                exc.folded = False  # this chunk is NOT in the running partial
+                raise exc
+            if n_g <= cap:
+                break
+            cap = group_capacity(n_g, self.cap_floor)  # one re-run, right-sized
+        self._cap_hint = max(self._cap_hint, n_g)
+
+        key_out = list(key_out)
+        for i, (name, (tag, _, _)) in enumerate(zip(self.group_keys, keys_schema)):
+            if tag == "s":
+                key_out[i] = self._remap_string_key(name, key_out[i], codecs[name], n_g, cap)
+        new = {"cap": cap, "n": n_g, "fs": fs, "keys": key_out, "slots": list(slot_out)}
+        self._row_base += n
+        if self._partial is None:
+            self._partial = new
+        else:
+            self._merge(new)
+
+    def _remap_string_key(self, name, dev_codes, codec: ColumnCodec, n_g: int, cap: int):
+        """Chunk-local dictionary codes -> global int64 codes (host remap of
+        only the per-group representatives; -1 null stays -1)."""
+        import jax
+
+        local = np.asarray(dev_codes)[:n_g]
+        mapping = self._strmaps.setdefault(name, {})
+        uniq = self._struniq.setdefault(name, [])
+        out = np.full(cap, -1, dtype=np.int64)
+        for j, code in enumerate(local):
+            if code < 0:
+                continue
+            val = codec.uniques[int(code)]
+            got = mapping.get(val)
+            if got is None:
+                got = mapping[val] = len(uniq)
+                uniq.append(val)
+            out[j] = got
+        return jax.device_put(out)
+
+    def _merge(self, new) -> None:
+        import jax
+        import time as _time
+        from hyperspace_tpu.obs import spans as obs_spans
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        a, b = self._partial, new
+        keys_schema, _ = self._schema
+        key_specs = tuple(
+            (name, "f" if tag == "f" else "i")
+            for name, (tag, _, _) in zip(self.group_keys, keys_schema)
+        )
+        cap_in = max(a["cap"], b["cap"])
+        for part in (a, b):
+            if part["cap"] != cap_in:
+                part["fs"] = _dev_pad(part["fs"], cap_in, _FS_SENTINEL)
+                part["keys"] = [_dev_pad(k, cap_in, 0 if k.dtype != np.float64 else np.nan) for k in part["keys"]]
+                part["slots"] = [_dev_pad(s, cap_in, 0) for s in part["slots"]]
+        cap_out = group_capacity(a["n"] + b["n"], self.cap_floor)
+        skeleton = (
+            f"gaggmerge[{cap_in}->{cap_out}]:k:{','.join(t for _, t in key_specs)}"
+            f"|s:{','.join(f'{k}:{int(i)}' for k, _, i in self._slots)}"
+        )
+        program = _grouped_merge_program(key_specs, self._slots, cap_in, cap_out)
+        jitted = _cached_predicate_jit(skeleton, program)
+        _note_compile(skeleton, (cap_in, cap_out))
+        t0 = _time.perf_counter()
+        with obs_spans.span("agg-merge", cat="groupagg", groups_in=a["n"] + b["n"]):
+            n_g_dev, fs, key_out, slot_out = jitted(
+                tuple(a["keys"]), tuple(b["keys"]),
+                tuple(a["slots"]), tuple(b["slots"]),
+                a["fs"], b["fs"], np.int64(a["n"]), np.int64(b["n"]),
+            )
+            n_g = int(n_g_dev)
+        REGISTRY.counter(
+            "hs_agg_merge_seconds_total",
+            "Cumulative device partial-aggregate merge time (seconds)",
+        ).inc(_time.perf_counter() - t0)
+        self._partial = {
+            "cap": cap_out, "n": n_g, "fs": fs,
+            "keys": list(key_out), "slots": list(slot_out),
+        }
+        self._cap_hint = max(self._cap_hint, n_g)
+        if n_g > self.max_groups:
+            # the merged partial is still VALID (capacity covered it) — keep
+            # it so the caller can convert to a host partial before spilling
+            exc = GroupCapacityExceeded(
+                f"group cardinality {n_g} exceeds maxGroups {self.max_groups}"
+            )
+            exc.folded = True  # the triggering chunk IS in the stored partial
+            raise exc
+
+    # -- finalization ---------------------------------------------------------
+
+    def _host_table(self):
+        """Pull the per-group table to host, appearance-ordered: decoded key
+        arrays + raw slot arrays."""
+        p = self._partial
+        if p is None:
+            raise DeviceUnsupported("no device partial to finalize")
+        n = p["n"]
+        keys_schema, input_dtypes = self._schema
+        fs = np.asarray(p["fs"])[:n]
+        order = np.argsort(fs, kind="stable")
+        key_cols = {}
+        for name, (tag, dtype, unit), dev in zip(self.group_keys, keys_schema, p["keys"]):
+            vals = np.asarray(dev)[:n][order]
+            if tag == "s":
+                uniq = self._struniq.get(name, [])
+                out = np.full(n, np.nan, dtype=object)
+                pos = vals >= 0
+                if pos.any():
+                    lut = np.asarray(uniq, dtype=object)
+                    out[pos] = lut[vals[pos].astype(np.int64)]
+                key_cols[name] = out
+            elif tag == "d":
+                key_cols[name] = vals.astype(np.int64).view(f"M8[{unit}]")
+            elif tag == "f":
+                key_cols[name] = vals.astype(dtype)
+            else:
+                key_cols[name] = vals.astype(dtype)
+        slot_cols = [np.asarray(s)[:n][order] for s in p["slots"]]
+        return n, key_cols, slot_cols
+
+    def finalize(self) -> B.Batch:
+        """Per-group final values with host-path semantics: count -> int64,
+        int sum -> int64 (exact), float sum/min/max -> NULL (NaN) when every
+        matched row was NULL, int min/max keep the input dtype, avg/stddev
+        from the decomposed states. Rows in first-appearance order, exactly
+        like pandas groupby(sort=False)."""
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        if self._hint_key is not None:
+            if len(_CAP_HINT_MEMO) >= 4096:  # bound pathological key churn
+                _CAP_HINT_MEMO.clear()
+            _CAP_HINT_MEMO[self._hint_key] = self._cap_hint
+        n, key_cols, slot_cols = self._host_table()
+        _, input_dtypes = self._schema
+        out: B.Batch = dict(key_cols)
+        for (name, fn, c), ref in zip(self.aggs, self._refs):
+            if fn == "count":
+                out[name] = slot_cols[ref[0]].astype(np.int64)
+                continue
+            dt = input_dtypes[c]
+            is_int = dt.kind in ("i", "u", "b")
+            if fn == "sum":
+                s, cnt = slot_cols[ref[0]], slot_cols[ref[1]]
+                if is_int:
+                    out[name] = s.astype(np.int64)  # int inputs have no NULLs
+                else:
+                    out[name] = np.where(cnt > 0, s.astype(np.float64), np.nan)
+            elif fn in ("min", "max"):
+                v, cnt = slot_cols[ref[0]], slot_cols[ref[1]]
+                if is_int:
+                    out[name] = v.astype(dt if dt.kind != "u" else np.int64)
+                else:
+                    out[name] = np.where(cnt > 0, v.astype(np.float64), np.nan)
+            elif fn == "avg":
+                s, cnt = slot_cols[ref[0]], slot_cols[ref[1]]
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    out[name] = np.where(cnt > 0, s / np.maximum(cnt, 1), np.nan)
+            else:  # stddev_samp
+                cnt, s, ss = (slot_cols[r] for r in ref)
+                with np.errstate(invalid="ignore", divide="ignore"):
+                    m = cnt > 1
+                    var = np.where(
+                        m,
+                        (ss - (s * s) / np.maximum(cnt, 1)) / np.maximum(cnt - 1, 1),
+                        np.nan,
+                    )
+                    out[name] = np.sqrt(np.clip(var, 0.0, None))
+        REGISTRY.counter(
+            "hs_agg_groups_total", "Groups produced by device grouped aggregation"
+        ).inc(n)
+        return out
+
+    def to_partial_frame(self, plain):
+        """The running device partial as ONE host partial frame in the
+        streaming-aggregate merge format (``__p{i}`` columns per plan-agg
+        index) — the spill path hands accumulated device state to the host
+        hash-combine without recomputing any chunk."""
+        import pandas as pd
+
+        n, key_cols, slot_cols = self._host_table()
+        _, input_dtypes = self._schema
+        frame = dict(key_cols)
+        by_name = {name: (fn, c) for name, fn, c in self.aggs}
+        refs_by_name = {name: ref for (name, _, _), ref in zip(self.aggs, self._refs)}
+        for i, name, fn, c in plain:
+            ref = refs_by_name[name]
+            p = f"__p{i}"
+            if fn == "count":
+                frame[p] = slot_cols[ref[0]].astype(np.int64)
+            elif fn in ("sum", "min", "max"):
+                v, cnt = slot_cols[ref[0]], slot_cols[ref[1]]
+                dt = input_dtypes[c]
+                if dt.kind in ("i", "u", "b"):
+                    if fn == "sum":
+                        frame[p] = v.astype(np.int64)
+                    else:
+                        frame[p] = v.astype(dt if dt.kind != "u" else np.int64)
+                else:
+                    frame[p] = np.where(cnt > 0, v.astype(np.float64), np.nan)
+            elif fn == "avg":
+                s, cnt = slot_cols[ref[0]], slot_cols[ref[1]]
+                frame[p + "_s"] = np.where(cnt > 0, s.astype(np.float64), np.nan)
+                frame[p + "_c"] = cnt.astype(np.int64)
+            else:  # stddev_samp
+                cnt, s, ss = (slot_cols[r] for r in ref)
+                frame[p + "_n"] = cnt.astype(np.int64)
+                frame[p + "_s"] = np.where(cnt > 0, s.astype(np.float64), np.nan)
+                frame[p + "_ss"] = ss.astype(np.float64)
+        return pd.DataFrame(frame)
+
+
+_CAP_HINT_MEMO: Dict[tuple, int] = {}
+
+
+def device_grouped_aggregate(
+    session,
+    batch: B.Batch,
+    condition: Optional[Expr],
+    group_keys,
+    aggs,
+    scan_key=None,
+    *,
+    max_groups: int,
+    cap_floor: int,
+) -> B.Batch:
+    """One-shot fused filter -> grouped aggregate over a materialized scan
+    batch (the non-streamed `_exec_aggregate` path). Raises DeviceUnsupported
+    outside the device language or beyond ``max_groups`` cardinality."""
+    if B.num_rows(batch) == 0:
+        raise DeviceUnsupported("empty input stays host-side")
+    stream = GroupedAggStream(
+        session,
+        group_keys,
+        aggs,
+        max_groups=max_groups,
+        cap_floor=cap_floor,
+        hint_key=scan_key,
+    )
+    stream.update(batch, condition, scan_key=scan_key)
+    return stream.finalize()
 
 
 # --------------------------------------------------------------------------

@@ -475,6 +475,145 @@ _STREAMABLE_AGGS = {
 }
 
 
+def host_aggregate(batch: B.Batch, keys: List[str], aggs) -> B.Batch:
+    """The host pandas aggregate over an in-memory batch — the semantic
+    reference every device/streamed aggregate path must reproduce byte-for-
+    byte (NULL sums via min_count=1, dropna=False grouping, appearance-
+    ordered groups via sort=False)."""
+    import pandas as pd
+
+    batch = {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
+    n = B.num_rows(batch)
+
+    def series(col_name: str) -> np.ndarray:
+        from hyperspace_tpu.plan.expr import get_column
+
+        got = batch.get(col_name)
+        if got is None:
+            got = get_column(batch, col_name)
+        if got is None:
+            raise KeyError(f"Aggregate input column {col_name!r} not found")
+        return got
+
+    _PD_FN = {"avg": "mean", "sum": "sum", "min": "min", "max": "max"}
+
+    def _global_agg(fn: str, col_name: Optional[str]):
+        if fn == "count":
+            return n if col_name is None else int(pd.Series(series(col_name)).count())
+        s = pd.Series(series(col_name))
+        if fn == "count_distinct":
+            return int(s.nunique(dropna=True))
+        if fn in ("sum_distinct", "avg_distinct"):
+            d = s.dropna().drop_duplicates()
+            return d.sum(min_count=1) if fn == "sum_distinct" else d.mean()
+        if fn == "stddev_samp":
+            return s.std(ddof=1)
+        if fn == "sum":
+            # SQL: SUM over zero rows (or all NULLs) is NULL, not 0 —
+            # pandas' min_count=0 default returns 0
+            return s.sum(min_count=1)
+        return getattr(s, _PD_FN[fn])()
+
+    if not keys:
+        out: B.Batch = {}
+        for name, fn, col_name in aggs:
+            out[name] = np.asarray([_global_agg(fn, col_name)])
+        return out
+
+    # object/string group keys factorize to int codes BEFORE entering the
+    # frame: pandas' (Arrow-backed) string column construction was the
+    # top cost of TPC-H q1's aggregate at sf=1 (0.6 s of 3.0 s), and the
+    # groupby only needs key IDENTITY — real values map back at the end.
+    # use_na_sentinel=False gives NaN its own code, matching dropna=False.
+    key_uniques = {}
+    frame_cols = {}
+    agg_inputs = {c for _, _, c in aggs if c is not None}
+    for k in keys:  # series(): dotted keys too
+        arr = series(k)
+        # a key that also feeds an aggregate (min(x) ... GROUP BY x)
+        # must keep its real values — codes order by appearance
+        if arr.dtype.kind in ("O", "U", "S") and k not in agg_inputs:
+            codes, uniques = pd.factorize(arr, use_na_sentinel=False)
+            frame_cols[k] = codes
+            key_uniques[k] = uniques
+        else:
+            frame_cols[k] = arr
+    for name, fn, col_name in aggs:
+        if col_name is not None and col_name not in frame_cols:
+            frame_cols[col_name] = series(col_name)
+    df = pd.DataFrame(frame_cols)
+    grouped = df.groupby(keys, dropna=False, sort=False)
+    out = {}
+    pieces = {}
+    for name, fn, col_name in aggs:
+        if fn == "count" and col_name is None:
+            pieces[name] = grouped.size()
+        elif fn == "count":
+            pieces[name] = grouped[col_name].count()
+        elif fn == "count_distinct":
+            pieces[name] = grouped[col_name].nunique(dropna=True)
+        elif fn == "sum_distinct":
+            pieces[name] = grouped[col_name].agg(
+                lambda s: s.dropna().drop_duplicates().sum(min_count=1)
+            )
+        elif fn == "avg_distinct":
+            pieces[name] = grouped[col_name].agg(lambda s: s.dropna().drop_duplicates().mean())
+        elif fn == "stddev_samp":
+            pieces[name] = grouped[col_name].std(ddof=1)
+        elif fn == "sum":
+            # an all-NULL group must sum to NULL (SQL), not pandas' 0
+            pieces[name] = grouped[col_name].sum(min_count=1)
+        else:
+            pieces[name] = getattr(grouped[col_name], _PD_FN[fn])()
+    result = pd.DataFrame(pieces).reset_index()
+    for k in keys:
+        vals = result[k].to_numpy()
+        uniq = key_uniques.get(k)
+        out[k] = uniq[vals] if uniq is not None else vals
+    for name, _, _ in aggs:
+        out[name] = result[name].to_numpy()
+    return out
+
+
+def aggregate_batch(session, keys, aggs, batch: B.Batch) -> B.Batch:
+    """Aggregate an already-materialized batch — the serving micro-batch
+    path's final step. Grouped shapes try the device segment-reduction
+    engine (``scan_key=None``: the batch is transient, nothing to cache);
+    everything else, and every fallback, runs the host pandas path."""
+    conf = session.conf
+    keys = list(keys)
+    aggs = list(aggs)
+    if (
+        keys
+        and conf.device_execution_enabled
+        and conf.agg_device_grouped_enabled
+        and B.num_rows(batch) >= conf.device_exec_min_rows
+    ):
+        try:
+            from hyperspace_tpu.exec import device as D
+        except ImportError:
+            D = None
+        if D is not None:
+            try:
+                got = D.device_grouped_aggregate(
+                    session,
+                    batch,
+                    None,
+                    keys,
+                    aggs,
+                    scan_key=None,
+                    max_groups=conf.agg_max_groups,
+                    cap_floor=conf.agg_capacity_floor,
+                )
+                trace.record("agg", "device-grouped-batch")
+                return got
+            except D.GroupCapacityExceeded:
+                trace.fallback("agg", "spill")
+            except D.DeviceUnsupported:
+                trace.fallback("agg", "unsupported")
+    return host_aggregate(batch, keys, aggs)
+
+
 class Executor:
     def __init__(self, session):
         self.session = session
@@ -592,13 +731,21 @@ class Executor:
             self._memo = {}
             self._shared = set()
 
-    def _stream_chunks(self, chain, leaf, groups, needed):
+    def _stream_chunks(self, chain, leaf, groups, needed, leaf_only=False, stage_extra=None):
         """Yield one executed chain batch per file group, overlapping chunk
         k+1's decode + H2D staging with chunk k's execution via ScanPipeline
         (the tentpole's stage-1/2/3 split). Pushed-down Filter conditions are
         attached to each leaf clone for row-group pruning; the serial path
         (pipeline disabled, or a chain that needs file names) executes the
-        same clones, so streamed results are identical either way."""
+        same clones, so streamed results are identical either way.
+
+        ``leaf_only=True`` yields ``(leaf_clone, chain_plan, leaf_batch)``
+        instead of executed batches: the device grouped-aggregate stream
+        consumes raw leaf chunks (the predicate fuses into its program) but
+        must still be able to run the chain over the same prefetched batch
+        when it falls back mid-stream. ``stage_extra`` names additional
+        columns (group keys, aggregate inputs) the H2D staging hook uploads
+        alongside the predicate columns."""
         conf = self.session.conf
         pushed = _chain_pushdown_condition(chain) if conf.rowgroup_pruning_enabled else None
         leaves, subs = [], []
@@ -612,8 +759,11 @@ class Executor:
         if not conf.pipeline_enabled or len(groups) < 2 or any(wfns):
             # leaf-batch prefetch can't also carry file-name columns; such
             # chains (rare: InputFileName in a filter) stay serial
-            for sub, wfn in zip(subs, wfns):
-                yield self._exec(sub, wfn)
+            for i, (sub, wfn) in enumerate(zip(subs, wfns)):
+                if leaf_only:
+                    yield leaves[i], sub, self._exec(leaves[i], False)
+                else:
+                    yield self._exec(sub, wfn)
             return
 
         try:
@@ -633,12 +783,15 @@ class Executor:
             and isinstance(leaves[0], (L.FileScan, L.IndexScan))
         ):
             dev_cond = chain[-1].condition
+        staging = D is not None and (dev_cond is not None or stage_extra)
 
         def stage(i, batch):
-            if dev_cond is None or B.num_rows(batch) < conf.device_exec_min_rows:
+            if B.num_rows(batch) < conf.device_exec_min_rows:
                 return
             key = _pruned_scan_key(_scan_identity(leaves[i]), pushed)
-            D.stage_filter_columns(self.session, batch, dev_cond, key)
+            D.stage_filter_columns(
+                self.session, batch, dev_cond, key, extra_columns=stage_extra
+            )
 
         def weigh(batch):
             return sum(int(getattr(a, "nbytes", 0)) for a in batch.values())
@@ -648,10 +801,13 @@ class Executor:
             depth=max(1, conf.pipeline_depth),
             max_buffered_bytes=conf.pipeline_max_buffered_bytes,
             weigh=weigh,
-            stage=stage if dev_cond is not None else None,
+            stage=stage if staging else None,
         )
         try:
             for i, leaf_batch in enumerate(pipe):
+                if leaf_only:
+                    yield leaves[i], subs[i], leaf_batch
+                    continue
                 prev = getattr(self, "_leaf_override", None)
                 self._leaf_override = (leaves[i], leaf_batch)
                 try:
@@ -964,31 +1120,30 @@ class Executor:
         """Predicate evaluation: device path over index/file scans when the
         session mesh is available, host numpy otherwise. ``pruned_by`` is the
         predicate whose row-group pruning produced ``child``, if any."""
-        if (
-            self.session.conf.device_execution_enabled
-            and isinstance(plan.child, (L.IndexScan, L.FileScan))
-            and B.num_rows(child) >= self.session.conf.device_exec_min_rows
+        if self.session.conf.device_execution_enabled and isinstance(
+            plan.child, (L.IndexScan, L.FileScan)
         ):
-            from hyperspace_tpu.exec import device as D
+            if B.num_rows(child) >= self.session.conf.device_exec_min_rows:
+                from hyperspace_tpu.exec import device as D
 
-            try:
-                mask = D.device_filter_mask(
-                    self.session,
-                    child,
-                    plan.condition,
-                    scan_key=_pruned_scan_key(_scan_identity(plan.child), pruned_by),
-                )
-                trace.record("filter", "device")
-                return mask
-            except D.DeviceUnsupported:
-                trace.record("filter", "host-fallback")
-                return as_bool_mask(plan.condition.eval(child))
+                try:
+                    mask = D.device_filter_mask(
+                        self.session,
+                        child,
+                        plan.condition,
+                        scan_key=_pruned_scan_key(_scan_identity(plan.child), pruned_by),
+                    )
+                    trace.record("filter", "device")
+                    return mask
+                except D.DeviceUnsupported:
+                    trace.record("filter", "host-fallback")
+                    trace.fallback("filter", "unsupported")
+                    return as_bool_mask(plan.condition.eval(child))
+            trace.fallback("filter", "min-rows")
         trace.record("filter", "host")
         return as_bool_mask(plan.condition.eval(child))
 
     def _exec_aggregate(self, plan: L.Aggregate, with_file_names: bool) -> B.Batch:
-        import pandas as pd
-
         # fused device path for global aggregates over an (optionally
         # filtered) index/file scan: predicate + reductions run in one jitted
         # program over HBM-resident columns; only scalars transfer back
@@ -1016,7 +1171,7 @@ class Executor:
                     trace.record("agg", "fused-bucketed-join")
                     return got
                 except D.DeviceUnsupported:
-                    pass
+                    trace.fallback("agg", "join-unsupported")
         # streaming check BEFORE the device-scan gate: _try_device_aggregate
         # materializes the whole scan to size its decision, which is exactly
         # what the out-of-core path exists to avoid
@@ -1025,113 +1180,25 @@ class Executor:
             if got is not None:
                 trace.record("agg", "streamed-partial")
                 return got
-        if not plan.keys and not with_file_names and self.session.conf.device_execution_enabled:
-            got, scan_batch, filter_node = self._try_device_aggregate(plan)
+        if not with_file_names and self.session.conf.device_execution_enabled:
+            got, scan_batch, filter_node, pruned = self._try_device_aggregate(plan)
             if got is not None:
-                trace.record("agg", "device-fused-scan")
+                trace.record(
+                    "agg", "device-grouped-scan" if plan.keys else "device-fused-scan"
+                )
                 return got
             if scan_batch is not None:
                 # the device gate already materialized the scan — reuse it
                 # instead of re-reading parquet on the host fallback
                 if filter_node is not None:
-                    mask = self._filter_mask(filter_node, scan_batch)
+                    mask = self._filter_mask(filter_node, scan_batch, pruned_by=pruned)
                     child = B.mask_rows(scan_batch, mask)
                 else:
                     child = scan_batch
 
         if child is None:
             child = self._exec(plan.child, with_file_names)
-        child = {k: v for k, v in child.items() if k != INPUT_FILE_NAME}
-        n = B.num_rows(child)
-
-        def series(col_name: str) -> np.ndarray:
-            from hyperspace_tpu.plan.expr import get_column
-
-            got = child.get(col_name)
-            if got is None:
-                got = get_column(child, col_name)
-            if got is None:
-                raise KeyError(f"Aggregate input column {col_name!r} not found")
-            return got
-
-        _PD_FN = {"avg": "mean", "sum": "sum", "min": "min", "max": "max"}
-
-        def _global_agg(fn: str, col_name: Optional[str]):
-            if fn == "count":
-                return n if col_name is None else int(pd.Series(series(col_name)).count())
-            s = pd.Series(series(col_name))
-            if fn == "count_distinct":
-                return int(s.nunique(dropna=True))
-            if fn in ("sum_distinct", "avg_distinct"):
-                d = s.dropna().drop_duplicates()
-                return d.sum(min_count=1) if fn == "sum_distinct" else d.mean()
-            if fn == "stddev_samp":
-                return s.std(ddof=1)
-            if fn == "sum":
-                # SQL: SUM over zero rows (or all NULLs) is NULL, not 0 —
-                # pandas' min_count=0 default returns 0
-                return s.sum(min_count=1)
-            return getattr(s, _PD_FN[fn])()
-
-        if not plan.keys:
-            out: B.Batch = {}
-            for name, fn, col_name in plan.aggs:
-                out[name] = np.asarray([_global_agg(fn, col_name)])
-            return out
-
-        # object/string group keys factorize to int codes BEFORE entering the
-        # frame: pandas' (Arrow-backed) string column construction was the
-        # top cost of TPC-H q1's aggregate at sf=1 (0.6 s of 3.0 s), and the
-        # groupby only needs key IDENTITY — real values map back at the end.
-        # use_na_sentinel=False gives NaN its own code, matching dropna=False.
-        key_uniques = {}
-        frame_cols = {}
-        agg_inputs = {c for _, _, c in plan.aggs if c is not None}
-        for k in plan.keys:  # series(): dotted keys too
-            arr = series(k)
-            # a key that also feeds an aggregate (min(x) ... GROUP BY x)
-            # must keep its real values — codes order by appearance
-            if arr.dtype.kind in ("O", "U", "S") and k not in agg_inputs:
-                codes, uniques = pd.factorize(arr, use_na_sentinel=False)
-                frame_cols[k] = codes
-                key_uniques[k] = uniques
-            else:
-                frame_cols[k] = arr
-        for name, fn, col_name in plan.aggs:
-            if col_name is not None and col_name not in frame_cols:
-                frame_cols[col_name] = series(col_name)
-        df = pd.DataFrame(frame_cols)
-        grouped = df.groupby(plan.keys, dropna=False, sort=False)
-        out = {}
-        pieces = {}
-        for name, fn, col_name in plan.aggs:
-            if fn == "count" and col_name is None:
-                pieces[name] = grouped.size()
-            elif fn == "count":
-                pieces[name] = grouped[col_name].count()
-            elif fn == "count_distinct":
-                pieces[name] = grouped[col_name].nunique(dropna=True)
-            elif fn == "sum_distinct":
-                pieces[name] = grouped[col_name].agg(
-                    lambda s: s.dropna().drop_duplicates().sum(min_count=1)
-                )
-            elif fn == "avg_distinct":
-                pieces[name] = grouped[col_name].agg(lambda s: s.dropna().drop_duplicates().mean())
-            elif fn == "stddev_samp":
-                pieces[name] = grouped[col_name].std(ddof=1)
-            elif fn == "sum":
-                # an all-NULL group must sum to NULL (SQL), not pandas' 0
-                pieces[name] = grouped[col_name].sum(min_count=1)
-            else:
-                pieces[name] = getattr(grouped[col_name], _PD_FN[fn])()
-        result = pd.DataFrame(pieces).reset_index()
-        for k in plan.keys:
-            vals = result[k].to_numpy()
-            uniq = key_uniques.get(k)
-            out[k] = uniq[vals] if uniq is not None else vals
-        for name, _, _ in plan.aggs:
-            out[name] = result[name].to_numpy()
-        return out
+        return host_aggregate(child, list(plan.keys), list(plan.aggs))
 
     def _try_streaming_aggregate(self, plan: L.Aggregate) -> Optional[B.Batch]:
         """Out-of-core aggregate: when the child is a scan chain over more
@@ -1188,9 +1255,7 @@ class Executor:
         distinct_frames = {i: [] for i, *_ in distinct}  # per-agg pair frames
         g_state: Dict[int, Any] = {}       # global plain partials
 
-        # chunks arrive through the prefetch pipeline: chunk k+1 decodes (and
-        # stages) while this loop folds chunk k's partials
-        for batch in self._stream_chunks(chain, leaf, groups, needed):
+        def fold_chunk(batch):
             batch = {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
             n = B.num_rows(batch)
 
@@ -1275,6 +1340,94 @@ class Executor:
                     u = pd.Series(series(c)).dropna().drop_duplicates()
                     distinct_frames[i].append(u.to_frame("__v"))
 
+        # device grouped streaming: fuse the chain's predicate into the
+        # grouped segment-reduction program over each RAW leaf chunk and keep
+        # the running partial-aggregate table on device, merged chunk-to-chunk
+        # — the scan never materializes on host. Any mid-stream fallback
+        # (cardinality spill, dtype drift) converts the device partial into
+        # ONE host partial frame and continues with the pandas fold below.
+        conf = self.session.conf
+        stream = None
+        fuse_cond = None
+        stage_extra = None
+        if (
+            grouped
+            and not distinct
+            and conf.device_execution_enabled
+            and conf.agg_device_grouped_enabled
+            # the per-chunk leaf CLONES are always FileScan/IndexScan
+            # (_leaf_subset converts a relation Scan), so any chain of
+            # Filters/Projects fuses; Compute/Rename rebind the namespace
+            # the fused predicate and keys are expressed in
+            and all(isinstance(nd, (L.Filter, L.Project)) for nd in chain)
+        ):
+            try:
+                from hyperspace_tpu.exec import device as D
+            except ImportError:
+                D = None
+            if D is not None:
+                fuse_cond = _chain_pushdown_condition(chain)
+                stage_extra = sorted(
+                    set(plan.keys) | {c for _, _, _, c in plain if c is not None}
+                )
+                stream = D.GroupedAggStream(
+                    self.session,
+                    list(plan.keys),
+                    list(plan.aggs),
+                    max_groups=conf.agg_max_groups,
+                    cap_floor=conf.agg_capacity_floor,
+                    # capacity hint shared across repeated runs of the same
+                    # query shape over the same file set (skips the first
+                    # chunk's right-sizing re-run once cardinality is known)
+                    hint_key=("stream",) + tuple(_leaf_files(leaf)),
+                )
+
+        # chunks arrive through the prefetch pipeline: chunk k+1 decodes (and
+        # stages) while this loop folds chunk k's partials
+        if stream is None:
+            for batch in self._stream_chunks(chain, leaf, groups, needed):
+                fold_chunk(batch)
+        else:
+            device_ok = True
+            for lf, sub, leaf_batch in self._stream_chunks(
+                chain, leaf, groups, needed, leaf_only=True, stage_extra=stage_extra
+            ):
+                if device_ok:
+                    nb = B.num_rows(leaf_batch)
+                    if nb and nb < conf.device_exec_min_rows:
+                        trace.fallback("agg", "min-rows")
+                        device_ok = False
+                    else:
+                        key = _pruned_scan_key(
+                            _scan_identity(lf), getattr(lf, "pushdown_predicate", None)
+                        )
+                        try:
+                            stream.update(leaf_batch, fuse_cond, scan_key=key)
+                            continue
+                        except D.GroupCapacityExceeded as e:
+                            trace.fallback("agg", "spill")
+                            device_ok = False
+                            if stream.has_data:
+                                partial_frames.append(stream.to_partial_frame(plain))
+                            if getattr(e, "folded", False):
+                                continue  # chunk already merged into the partial
+                        except D.DeviceUnsupported:
+                            trace.fallback("agg", "unsupported")
+                            device_ok = False
+                            if stream.has_data:
+                                partial_frames.append(stream.to_partial_frame(plain))
+                # host fold of this (and every later) chunk, executing the
+                # chain over the SAME prefetched leaf batch
+                prev = getattr(self, "_leaf_override", None)
+                self._leaf_override = (lf, leaf_batch)
+                try:
+                    fold_chunk(self._exec(sub, False))
+                finally:
+                    self._leaf_override = prev
+            if device_ok and stream.has_data:
+                trace.record("agg", "device-grouped-stream")
+                return stream.finalize()
+
         if grouped:
             merged = pd.concat(partial_frames, ignore_index=True)
             gb = merged.groupby(list(plan.keys), dropna=False, sort=False)
@@ -1355,31 +1508,56 @@ class Executor:
         return {name: out[name] for name, _, _ in plan.aggs}
 
     def _try_device_aggregate(self, plan: L.Aggregate):
-        """Returns (result, scan_batch, filter_node): result=None means the
-        caller runs the host path — reusing scan_batch (the materialized
-        scan, pre-filter) when it was already read for the gate."""
+        """Returns (result, scan_batch, filter_node, pruned_by): result=None
+        means the caller runs the host path — reusing scan_batch (the
+        materialized scan, pre-filter) when it was already read for the gate.
+        ``pruned_by`` is the scan's attached row-group-pruning predicate; the
+        caller must thread it into any further device-cache use of
+        scan_batch, or a pruned batch gets branded with an unpruned key."""
+        conf = self.session.conf
         node = plan.child
         filter_node = None
         if isinstance(node, L.Filter):
             filter_node = node
             node = node.child
         if not isinstance(node, (L.IndexScan, L.FileScan)):
-            return None, None, None
+            return None, None, None, None
+        if plan.keys and not conf.agg_device_grouped_enabled:
+            return None, None, None, None
         try:
             from hyperspace_tpu.exec import device as D
         except ImportError:
-            return None, None, None
+            return None, None, None, None
+        pruned = getattr(node, "pushdown_predicate", None)
         batch = self._exec(node, with_file_names=False)
-        if B.num_rows(batch) < self.session.conf.device_exec_min_rows:
-            return None, batch, filter_node
+        if B.num_rows(batch) < conf.device_exec_min_rows:
+            trace.fallback("agg", "min-rows")
+            return None, batch, filter_node, pruned
+        condition = filter_node.condition if filter_node is not None else None
+        scan_key = _pruned_scan_key(_scan_identity(node), pruned)
         try:
-            condition = filter_node.condition if filter_node is not None else None
-            got = D.device_filtered_aggregate(
-                self.session, batch, condition, plan.aggs, scan_key=_scan_identity(node)
-            )
-            return got, batch, filter_node
+            if plan.keys:
+                got = D.device_grouped_aggregate(
+                    self.session,
+                    batch,
+                    condition,
+                    list(plan.keys),
+                    list(plan.aggs),
+                    scan_key=scan_key,
+                    max_groups=conf.agg_max_groups,
+                    cap_floor=conf.agg_capacity_floor,
+                )
+            else:
+                got = D.device_filtered_aggregate(
+                    self.session, batch, condition, plan.aggs, scan_key=scan_key
+                )
+            return got, batch, filter_node, pruned
+        except D.GroupCapacityExceeded:
+            trace.fallback("agg", "spill")
+            return None, batch, filter_node, pruned
         except D.DeviceUnsupported:
-            return None, batch, filter_node
+            trace.fallback("agg", "unsupported")
+            return None, batch, filter_node, pruned
 
     def _exec_join(self, plan: L.Join, with_file_names: bool) -> B.Batch:
         """Generic (non-bucketed) equi-join fallback via a pandas hash merge
@@ -1397,7 +1575,7 @@ class Executor:
                 try:
                     return D.dispatch_bucketed_join(self.session, plan)
                 except D.DeviceUnsupported:
-                    pass
+                    trace.fallback("join", "unsupported")
         trace.record("join", "generic-merge")
 
         pairs = extract_equi_join_keys(plan.condition)

@@ -62,6 +62,24 @@ def record(kind: str, detail: str) -> None:
         sp.event(kind, detail)
 
 
+def fallback(op: str, reason: str) -> None:
+    """Count a device-path fallback in the process metrics registry.
+
+    Dispatch traces already *name* every fallback, but a recording must be
+    active to see them; the ``hs_device_fallback_total{op,reason}`` counter
+    makes the same decisions visible in Prometheus scrapes and query
+    profiles without one.
+    """
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_device_fallback_total",
+        "Device-path fallbacks to host execution, by operator and reason",
+        op=op,
+        reason=reason,
+    ).inc()
+
+
 def active() -> bool:
     return _events is not None
 

@@ -23,10 +23,18 @@ from hyperspace_tpu.plan.expr import as_bool_mask
 
 def shared_scan_ops(template: L.LogicalPlan) -> Optional[Tuple[List[tuple], L.LogicalPlan]]:
     """Decompose ``template`` into (root->leaf op list, scan leaf) when it is
-    a batchable linear chain with at least one Filter; None otherwise."""
+    a batchable linear chain with at least one Filter; None otherwise.
+
+    One ``Aggregate`` may cap the chain (only Projects above it): grouped
+    dashboard queries against the same covering index then share the scan
+    decode and aggregate their own masked rows afterwards — via the device
+    grouped-aggregation engine when it applies. A Filter above the Aggregate
+    (HAVING) would mask aggregated rows with conditions the per-chunk walk
+    below the aggregate cannot evaluate, so that shape stays unbatched."""
     ops: List[tuple] = []
     p = template
     n_filters = 0
+    seen_agg = False
     while True:
         if isinstance(p, (L.Scan, L.FileScan, L.IndexScan)):
             if n_filters == 0:
@@ -38,6 +46,10 @@ def shared_scan_ops(template: L.LogicalPlan) -> Optional[Tuple[List[tuple], L.Lo
         elif isinstance(p, L.Filter):
             ops.append(("filter", None))
             n_filters += 1
+            p = p.child
+        elif isinstance(p, L.Aggregate) and not seen_agg and n_filters == 0:
+            ops.append(("aggregate", (list(p.keys), list(p.aggs))))
+            seen_agg = True
             p = p.child
         else:
             return None
@@ -66,9 +78,18 @@ def execute_shared_scan(
 
     The leaf streams through ``execute_stream`` (so multi-chunk leaves ride
     the prefetch pipeline: chunk k+1 decodes while chunk k's request masks
-    evaluate); every op here is row-wise, so per-chunk application followed
-    by concatenation is exactly the materialized result."""
-    from hyperspace_tpu.exec.executor import Executor
+    evaluate); every op below an Aggregate is row-wise, so per-chunk
+    application followed by concatenation is exactly the materialized
+    result. An Aggregate op (and any Projects above it) applies once per
+    request over its concatenated masked rows, dispatching through
+    ``aggregate_batch`` so grouped shapes hit the device segment-reduction
+    engine."""
+    from hyperspace_tpu.exec.executor import Executor, aggregate_batch
+
+    split = next((i for i, (kind, _) in enumerate(ops) if kind == "aggregate"), None)
+    above = ops[:split] if split is not None else []
+    agg = ops[split][1] if split is not None else None
+    below = ops[split + 1:] if split is not None else ops
 
     per_request_conds = [_bound_conditions(bound) for bound in bound_plans]
     pieces: List[List[B.Batch]] = [[] for _ in bound_plans]
@@ -76,11 +97,21 @@ def execute_shared_scan(
         for r, conds in enumerate(per_request_conds):
             ci = len(conds)
             batch = base
-            for kind, payload in reversed(ops):  # leaf -> root
+            for kind, payload in reversed(below):  # leaf -> root
                 if kind == "filter":
                     ci -= 1
                     batch = B.mask_rows(batch, as_bool_mask(conds[ci].eval(batch)))
                 else:
                     batch = B.select(batch, payload)
             pieces[r].append(batch)
-    return [ps[0] if len(ps) == 1 else B.concat(ps) for ps in pieces]
+    results = [ps[0] if len(ps) == 1 else B.concat(ps) for ps in pieces]
+    if agg is not None:
+        keys, aggs = agg
+        out = []
+        for batch in results:
+            batch = aggregate_batch(session, keys, aggs, batch)
+            for kind, payload in reversed(above):  # projects over the result
+                batch = B.select(batch, payload)
+            out.append(batch)
+        results = out
+    return results
