@@ -209,10 +209,15 @@ def serve_main() -> None:
 def obs_main() -> None:
     """``python bench.py --obs-overhead``: observability overhead benchmark.
 
-    Three measurements on the --serve workload shape:
+    Four measurements on the --serve workload shape:
 
-    - ``qps_off``   — tracing disabled (the default production stance);
+    - ``qps_off``   — tracing disabled, the **default production stance**:
+      the query intelligence layer (fingerprint profile history + SLO
+      accounting, both on by default) folds every completion;
     - ``qps_on``    — tracing enabled (every request grows a full span tree);
+    - ``qps_bare``  — tracing off AND intelligence off (history disabled,
+      SLO target 0), isolating the enabled-path cost of the per-request
+      history/SLO folds;
     - ``null_span_ns`` — nanoseconds per ``spans.span(...)`` enter/exit on the
       disabled path (the cost each instrumentation point adds to untraced
       code).
@@ -221,7 +226,8 @@ def obs_main() -> None:
     second time (A/B of identical configs) so run-to-run noise is visible;
     the acceptance bar (<= 3%) is ``vs_baseline >= 0.97`` where vs_baseline =
     qps_off / qps_off_again — i.e. tracing-off throughput is indistinguishable
-    from itself, and the *enabled* cost is reported separately for honesty.
+    from itself, and the *enabled* costs (span trees; intelligence folds) are
+    reported separately for honesty.
     """
     _honor_cpu_request()
     _backend_watchdog()
@@ -257,8 +263,10 @@ def obs_main() -> None:
             for i in range(16)
         ]
 
-        def run(tracing: bool):
+        def run(tracing: bool, intelligence: bool = True):
             sess.conf.set(hst.keys.OBS_TRACING_ENABLED, tracing)
+            sess.conf.set(hst.keys.OBS_HISTORY_ENABLED, intelligence)
+            sess.conf.set(hst.keys.OBS_SLO_TARGET_MS, 1000.0 if intelligence else 0.0)
             srv = QueryServer(sess, workers=2, queue_depth=65536).start()
             try:
                 for q in queries:  # warm compile + io cache
@@ -282,6 +290,7 @@ def obs_main() -> None:
         qps_off, _ = run(False)
         qps_on, spans_per_request = run(True)
         qps_off_again, _ = run(False)
+        qps_bare, _ = run(False, intelligence=False)
 
         # disabled-path microbench: one contextvar read + shared null CM —
         # the cost each instrumentation point adds to an untraced query
@@ -311,6 +320,10 @@ def obs_main() -> None:
             "off_run_noise": round(1.0 - worst_off / best_off, 4),
             "qps_tracing_on": round(qps_on, 1),
             "tracing_on_overhead": round(1.0 - qps_on / best_off, 4),
+            # enabled-path cost of the default-on intelligence layer: the
+            # per-request history/SLO folds vs the same run with both off
+            "qps_intelligence_off": round(qps_bare, 1),
+            "intelligence_on_overhead": round(1.0 - best_off / max(qps_bare, best_off), 4),
             "spans_per_request": round(spans_per_request, 1),
             "null_span_ns": round(null_span_ns, 1),
         }

@@ -86,6 +86,21 @@ class keys:
     OBS_METRICS_ENABLED = "hyperspace.obs.metrics.enabled"
     OBS_PROFILE_HISTORY = "hyperspace.obs.profile.history"
     OBS_PROFILE_WHY_NOT = "hyperspace.obs.profile.whyNot"
+    # Query intelligence (obs/history.py, obs/slo.py, obs/export.py):
+    # fingerprint-keyed profile history + cost estimates, the slow-query
+    # flight recorder, latency-SLO burn-rate tracking, and the HTTP
+    # telemetry endpoint.
+    OBS_HISTORY_ENABLED = "hyperspace.obs.history.enabled"
+    OBS_HISTORY_MAX_FINGERPRINTS = "hyperspace.obs.history.maxFingerprints"
+    OBS_HISTORY_PERSIST = "hyperspace.obs.history.persist"
+    OBS_SLOW_QUERY_MS = "hyperspace.obs.slowQueryMs"
+    OBS_SLOW_QUERY_MAX_ENTRIES = "hyperspace.obs.slowQuery.maxEntries"
+    OBS_SLOW_QUERY_DIR = "hyperspace.obs.slowQuery.dir"
+    OBS_SLO_TARGET_MS = "hyperspace.obs.slo.targetMs"
+    OBS_SLO_OBJECTIVE = "hyperspace.obs.slo.objective"
+    OBS_SLO_WINDOWS_SECONDS = "hyperspace.obs.slo.windowsSeconds"
+    OBS_HTTP_PORT = "hyperspace.obs.http.port"
+    OBS_HTTP_HOST = "hyperspace.obs.http.host"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -227,6 +242,36 @@ DEFAULTS: Dict[str, Any] = {
     # Run the why-not analysis on traced queries (extra optimizer passes per
     # query — diagnostic sessions only).
     keys.OBS_PROFILE_WHY_NOT: False,
+    # Fold every completed query into the fingerprint-keyed ProfileHistory
+    # (streaming stats + cost estimates). O(1) per query, bounded memory —
+    # on by default; tracing is NOT required (latency/rows fold regardless).
+    keys.OBS_HISTORY_ENABLED: True,
+    # LRU bound on distinct fingerprints retained by a history instance.
+    keys.OBS_HISTORY_MAX_FINGERPRINTS: 512,
+    # Append one JSON line per completed query to
+    # <system.path>/_telemetry/profile_history.jsonl (the workload log the
+    # index advisor replays). Off by default: it is per-query disk IO.
+    keys.OBS_HISTORY_PERSIST: False,
+    # Flight-record queries slower than this many milliseconds (and every
+    # errored/rejected request). 0 disables the recorder entirely.
+    keys.OBS_SLOW_QUERY_MS: 0.0,
+    # Bound on the flight recorder's in-memory and on-disk rings.
+    keys.OBS_SLOW_QUERY_MAX_ENTRIES: 32,
+    # On-disk ring directory; None derives <system.path>/_telemetry/slow
+    # when a system path is configured, "" keeps entries memory-only.
+    keys.OBS_SLOW_QUERY_DIR: None,
+    # Latency-SLO target per served request, in milliseconds; 0 disables
+    # SLO tracking. Good/bad counters and burn-rate gauges are per-tenant.
+    keys.OBS_SLO_TARGET_MS: 1000.0,
+    # Fraction of requests that must meet the target (error budget = 1-x).
+    keys.OBS_SLO_OBJECTIVE: 0.999,
+    # Comma-separated burn-rate window lengths in seconds.
+    keys.OBS_SLO_WINDOWS_SECONDS: "300,3600",
+    # Port for the HTTP telemetry endpoint (/metrics, /statusz, /profilez)
+    # a QueryServer starts alongside itself. None disables; 0 binds an
+    # ephemeral port (read it from server.telemetry.port).
+    keys.OBS_HTTP_PORT: None,
+    keys.OBS_HTTP_HOST: "127.0.0.1",
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -497,6 +542,69 @@ class HyperspaceConf:
     @property
     def obs_profile_why_not(self) -> bool:
         return bool(self.get(keys.OBS_PROFILE_WHY_NOT))
+
+    @property
+    def obs_history_enabled(self) -> bool:
+        return bool(self.get(keys.OBS_HISTORY_ENABLED))
+
+    @property
+    def obs_history_max_fingerprints(self) -> int:
+        return int(self.get(keys.OBS_HISTORY_MAX_FINGERPRINTS))
+
+    @property
+    def obs_history_persist(self) -> bool:
+        return bool(self.get(keys.OBS_HISTORY_PERSIST))
+
+    @property
+    def obs_slow_query_ms(self) -> float:
+        return float(self.get(keys.OBS_SLOW_QUERY_MS))
+
+    @property
+    def obs_slow_query_max_entries(self) -> int:
+        return int(self.get(keys.OBS_SLOW_QUERY_MAX_ENTRIES))
+
+    @property
+    def obs_slow_query_dir(self) -> Optional[str]:
+        v = self.get(keys.OBS_SLOW_QUERY_DIR)
+        return None if v is None else str(v)
+
+    @property
+    def obs_slo_target_ms(self) -> float:
+        return float(self.get(keys.OBS_SLO_TARGET_MS))
+
+    @property
+    def obs_slo_objective(self) -> float:
+        return float(self.get(keys.OBS_SLO_OBJECTIVE))
+
+    @property
+    def obs_slo_windows_seconds(self) -> tuple:
+        raw = str(self.get(keys.OBS_SLO_WINDOWS_SECONDS))
+        out = []
+        for part in raw.split(","):
+            part = part.strip()
+            if part:
+                out.append(float(part))
+        return tuple(out) or (300.0, 3600.0)
+
+    @property
+    def obs_http_port(self) -> Optional[int]:
+        v = self.get(keys.OBS_HTTP_PORT)
+        return None if v is None else int(v)
+
+    @property
+    def obs_http_host(self) -> str:
+        return str(self.get(keys.OBS_HTTP_HOST))
+
+    def deltas(self) -> Dict[str, Any]:
+        """Explicitly-set keys whose value differs from the centralized
+        default — the "what is non-standard about this session" record the
+        flight recorder stamps on every captured query."""
+        out: Dict[str, Any] = {}
+        for k, v in self._conf.items():
+            default = DEFAULTS.get(k)
+            if _coerce(v, default) != default:
+                out[k] = v
+        return out
 
     def __repr__(self) -> str:
         return f"HyperspaceConf({self._conf!r})"

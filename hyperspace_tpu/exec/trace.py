@@ -116,3 +116,15 @@ def summarize(events: List) -> str:
     counts = Counter(events)
     lines = [f"{kind}: {detail} x{n}" for (kind, detail), n in sorted(counts.items())]
     return "\n".join(lines) if lines else "(no dispatch events)"
+
+
+def summarize_span_events(root) -> str:
+    """Dispatch summary of one finished span tree: the same counted form
+    :func:`summarize` produces for a recording, but sourced from the
+    per-request events :func:`record` annotated onto obs spans. This is how
+    the slow-query flight recorder shows "which physical paths this request
+    took" without a process-global recording."""
+    events: List = []
+    for sp in root.walk():
+        events.extend(sp.events)
+    return summarize(events)

@@ -8,7 +8,14 @@ Three layers on one substrate (see docs/observability.md):
   registry (counters/gauges/histograms) with Prometheus text exposition;
 - :mod:`hyperspace_tpu.obs.profile` — the per-query ``QueryProfile``
   joining span timings with plan facts (indexes applied, rows/bytes,
-  why-not reasons).
+  why-not reasons);
+- :mod:`hyperspace_tpu.obs.history` — fingerprint-keyed streaming profile
+  statistics + cost estimates (``ProfileHistory``) and the slow-query
+  flight recorder (``FlightRecorder``);
+- :mod:`hyperspace_tpu.obs.slo` — per-tenant latency-SLO accounting with
+  multi-window burn-rate gauges;
+- :mod:`hyperspace_tpu.obs.export` — the stdlib HTTP telemetry endpoint
+  (``/metrics``, ``/statusz``, ``/profilez``).
 
 Import of this package is stdlib-only: no jax, no numpy (the library's
 import-side-effect contract, tests/test_import_side_effects.py).
@@ -22,7 +29,16 @@ from hyperspace_tpu.obs.metrics import (
     MetricsRegistry,
     default_registry,
 )
+from hyperspace_tpu.obs.export import TelemetryEndpoint
+from hyperspace_tpu.obs.history import (
+    CostEstimate,
+    FlightEntry,
+    FlightRecorder,
+    ProfileHistory,
+    load_history,
+)
 from hyperspace_tpu.obs.profile import QueryProfile, build_profile
+from hyperspace_tpu.obs.slo import SloTracker
 from hyperspace_tpu.obs.spans import (
     NULL_SPAN,
     Span,
@@ -46,6 +62,13 @@ __all__ = [
     "default_registry",
     "QueryProfile",
     "build_profile",
+    "CostEstimate",
+    "FlightEntry",
+    "FlightRecorder",
+    "ProfileHistory",
+    "load_history",
+    "SloTracker",
+    "TelemetryEndpoint",
     "NULL_SPAN",
     "Span",
     "Trace",

@@ -159,11 +159,23 @@ class Histogram:
         return out
 
 
+def _escape_label_value(v: str) -> str:
+    """Escape a label value per the Prometheus text exposition format 0.0.4:
+    backslash, double-quote, and line feed must be escaped (in this order —
+    escaping the backslash first keeps the other escapes unambiguous)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP lines escape backslash and line feed (quotes are legal there)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: Tuple[Tuple[str, str], ...], extra: Optional[Tuple[Tuple[str, str], ...]] = None) -> str:
     items = list(labels) + list(extra or ())
     if not items:
         return ""
-    body = ",".join(f'{k}="{str(v)}"' for k, v in items)
+    body = ",".join(f'{k}="{_escape_label_value(str(v))}"' for k, v in items)
     return "{" + body + "}"
 
 
@@ -268,7 +280,7 @@ class MetricsRegistry:
             kind = kinds.get(name, "untyped")
             h = helps.get(name, "")
             if h:
-                lines.append(f"# HELP {name} {h}")
+                lines.append(f"# HELP {name} {_escape_help(h)}")
             lines.append(f"# TYPE {name} {kind}")
             for labels, m in by_name[name]:
                 if isinstance(m, (Counter, Gauge)):

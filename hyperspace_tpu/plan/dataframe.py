@@ -181,6 +181,16 @@ class DataFrame:
                     except Exception:
                         pass
                 self.session._last_profile = profile
+                history = self.session.profile_history
+                if history is not None:
+                    try:
+                        from hyperspace_tpu.serving.fingerprint import plan_fingerprint
+
+                        history.record_profile(
+                            plan_fingerprint(self.plan).structure, profile
+                        )
+                    except Exception:
+                        pass  # the cost model must never fail a query
 
     def to_local_iterator(self):
         """Yield the result as a stream of column batches (dict of numpy

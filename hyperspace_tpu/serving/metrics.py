@@ -47,6 +47,7 @@ class ServingMetrics:
             window=int(latency_window),
             **labels,
         )
+        self._labels = labels
 
     # original counter surface, preserved for existing callers/tests
     @property
@@ -65,9 +66,19 @@ class ServingMetrics:
     def batched_requests(self) -> int:
         return int(self._batched.value)
 
-    def observe(self, latency_s: float, error: bool = False) -> None:
+    def observe(self, latency_s: float, error: bool = False, tenant: Optional[str] = None) -> None:
         self._latency.observe(float(latency_s))
         (self._errors if error else self._completed).inc()
+        if tenant:
+            # per-tenant attribution rides separate label series so the
+            # unlabeled totals above stay cheap and cardinality-stable
+            self.registry.counter(
+                "hs_serving_tenant_requests_total",
+                "requests completed, by tenant and outcome",
+                tenant=tenant,
+                outcome="error" if error else "ok",
+                **self._labels,
+            ).inc()
 
     def observe_batch(self, n_requests: int) -> None:
         self._batches.inc()

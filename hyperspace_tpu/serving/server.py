@@ -50,10 +50,11 @@ _server_seq = itertools.count()
 class _Request:
     __slots__ = (
         "plan", "fp", "token", "enabled", "future", "deadline", "submitted_at",
-        "root",
+        "root", "tenant", "query_text",
     )
 
-    def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline, root=None):
+    def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline, root=None,
+                 tenant: str = "default", query_text: str = ""):
         self.plan = plan
         self.fp = fp
         self.token = token
@@ -64,6 +65,8 @@ class _Request:
         # per-request span-tree root (None when obs tracing is off); workers
         # attach() it so each request's spans land in its own disjoint tree
         self.root = root
+        self.tenant = tenant
+        self.query_text = query_text
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -122,6 +125,50 @@ class QueryServer:
         self.tracing_enabled = bool(conf.obs_tracing_enabled)
         self._trace_max_spans = conf.obs_trace_max_spans
         self._profiles: "deque" = deque(maxlen=max(1, conf.obs_profile_history))
+
+        # query intelligence: fingerprint history, SLO tracking, slow-query
+        # flight recorder, optional HTTP telemetry endpoint (obs/history.py,
+        # obs/slo.py, obs/export.py) — each behind its own conf key
+        self.history = None
+        if conf.obs_history_enabled:
+            from hyperspace_tpu.obs.history import ProfileHistory
+
+            self.history = ProfileHistory(
+                max_fingerprints=conf.obs_history_max_fingerprints,
+                persist_path=self._telemetry_path("profile_history.jsonl")
+                if conf.obs_history_persist else None,
+                registry=self.registry,
+                server=self.server_name,
+            )
+        self.slo = None
+        if conf.obs_slo_target_ms > 0:
+            from hyperspace_tpu.obs.slo import SloTracker
+
+            self.slo = SloTracker(
+                target_ms=conf.obs_slo_target_ms,
+                objective=conf.obs_slo_objective,
+                windows_s=conf.obs_slo_windows_seconds,
+                registry=self.registry,
+                server=self.server_name,
+            )
+        self.flight = None
+        self._slow_s = None
+        if conf.obs_slow_query_ms > 0:
+            from hyperspace_tpu.obs.history import FlightRecorder
+
+            self._slow_s = conf.obs_slow_query_ms / 1000.0
+            slow_dir = conf.obs_slow_query_dir
+            if slow_dir is None:
+                slow_dir = self._telemetry_path("slow")
+            self.flight = FlightRecorder(
+                max_entries=conf.obs_slow_query_max_entries,
+                directory=slow_dir or None,
+                registry=self.registry,
+                server=self.server_name,
+            )
+        self.telemetry = None
+        self._telemetry_port = conf.obs_http_port
+        self._telemetry_host = conf.obs_http_host
         if overrides:
             raise TypeError(f"Unknown QueryServer options: {sorted(overrides)}")
 
@@ -133,11 +180,23 @@ class QueryServer:
         self._closed = False
         self._prev_bucket_cache = None
 
+    def _telemetry_path(self, *parts) -> Optional[str]:
+        """A path under ``<system.path>/_telemetry`` (the index log
+        directory's sibling telemetry area), or None without a system path."""
+        import os
+
+        base = self.session.conf.system_path
+        if not base:
+            return None
+        return os.path.join(base, "_telemetry", *parts)
+
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "QueryServer":
         if self._started:
             return self
         self._started = True
+        if self._telemetry_port is not None and self.telemetry is None:
+            self.serve_telemetry(port=self._telemetry_port, host=self._telemetry_host)
         # the process-global dispatch recorder cannot disambiguate concurrent
         # requests — exec.trace.recording() refuses to start while we serve
         from hyperspace_tpu.exec import trace as exec_trace
@@ -169,6 +228,11 @@ class QueryServer:
                 req.future.set_exception(ServerClosed("server shut down"))
         self.bucket_cache.shutdown()
         self.session.bucket_cache = self._prev_bucket_cache
+        if self.telemetry is not None:
+            self.telemetry.close()
+            self.telemetry = None
+        if self.history is not None:
+            self.history.close()  # flush/close the JSONL workload log
         if self._started:
             from hyperspace_tpu.exec import trace as exec_trace
 
@@ -181,26 +245,31 @@ class QueryServer:
         self.shutdown()
 
     # -- submission ----------------------------------------------------------
-    def submit(self, query: Any, timeout: Optional[float] = None) -> "Future":
+    def submit(self, query: Any, timeout: Optional[float] = None, tenant: str = "default") -> "Future":
         """Admit a query (SQL text or DataFrame) and return a Future yielding
         the collected batch (dict of numpy arrays, like ``collect()``).
         Raises :class:`AdmissionRejected` immediately when the queue is full
-        and :class:`ServerClosed` after shutdown."""
+        and :class:`ServerClosed` after shutdown. ``tenant`` labels the
+        request's SLO accounting and per-tenant completion counters."""
         if self._closed or not self._started:
             raise ServerClosed("server is not running (call start() or use as a context manager)")
         enabled = bool(self.session.hyperspace_enabled)
+        query_text = query if isinstance(query, str) else type(query).__name__
         root = None
         if self.tracing_enabled:
             root = spans.start_trace(
                 "request",
                 max_spans=self._trace_max_spans,
                 server=self.server_name,
-                query=(query if isinstance(query, str) else type(query).__name__),
+                query=query_text,
             )
         with spans.attach(root):
             plan, fp = self._parse(query)
         token = session_token(self.session, enabled)
-        req = _Request(plan, fp, token, enabled, self.admission.deadline_for(timeout), root=root)
+        req = _Request(
+            plan, fp, token, enabled, self.admission.deadline_for(timeout),
+            root=root, tenant=tenant, query_text=query_text,
+        )
         try:
             self.admission.submit(req)  # raises AdmissionRejected on overflow
         except AdmissionRejected:
@@ -212,15 +281,27 @@ class QueryServer:
                     queue_depth=self.admission.depth, queued=self.admission.queued
                 ),
             )
+            # a rejection is an SLO bad event and a flight-recorder capture:
+            # load shedding must show up in the telemetry it will one day
+            # be driven by
+            if self.slo is not None:
+                self.slo.record(0.0, error=True, tenant=tenant)
+            if self.history is not None:
+                self.history.record(fp.structure, 0.0, error=True, query=query_text)
+            if self.flight is not None:
+                self.flight.record(
+                    "rejected", 0.0, fingerprint=fp.structure, query=query_text,
+                    tenant=tenant, conf_deltas=self.session.conf.deltas(),
+                )
             raise
         req.future.request_root = root  # span tree visible to the caller
         if self.prefetch_enabled:
             self._prefetch_hint(token, fp)
         return req.future
 
-    def query(self, query: Any, timeout: Optional[float] = None) -> Dict[str, Any]:
+    def query(self, query: Any, timeout: Optional[float] = None, tenant: str = "default") -> Dict[str, Any]:
         """Blocking convenience wrapper around :meth:`submit`."""
-        fut = self.submit(query, timeout=timeout)
+        fut = self.submit(query, timeout=timeout, tenant=tenant)
         t = self.admission.default_timeout if timeout is None else timeout
         # Future.result timeout is a backstop; the worker resolves the future
         # with RequestTimeout at the deadline itself
@@ -410,31 +491,114 @@ class QueryServer:
             batch = {c: batch[c] for c in r.fp.output_columns}
         if not r.future.done():
             r.future.set_result(batch)
-            self.metrics.observe(time.monotonic() - r.submitted_at)
-            self._seal(r)
+            rows = 0
+            if batch:
+                rows = int(len(next(iter(batch.values()))))
+            self.metrics.observe(time.monotonic() - r.submitted_at, tenant=r.tenant)
+            self._seal(r, rows=rows)
 
     def _fail(self, r: _Request, exc: BaseException) -> None:
         if not r.future.done():
             r.future.set_exception(exc)
-            self.metrics.observe(time.monotonic() - r.submitted_at, error=True)
+            self.metrics.observe(time.monotonic() - r.submitted_at, error=True, tenant=r.tenant)
             self._seal(r, error=type(exc).__name__)
 
-    def _seal(self, r: _Request, error: Optional[str] = None) -> None:
-        """Finish the request's span tree and publish its QueryProfile (on
-        the future as ``.profile`` and in the bounded server history)."""
-        if r.root is None:
-            return
-        profile = build_profile(
-            r.root, query=str(r.root.attrs.get("query", "")), error=error
-        )
-        r.future.profile = profile
-        self._profiles.append(profile)
+    def _seal(self, r: _Request, error: Optional[str] = None, rows: Optional[int] = None) -> None:
+        """Completion hook: finish the request's span tree, publish its
+        QueryProfile (on the future as ``.profile`` and in the bounded server
+        history), fold it into the fingerprint-keyed ProfileHistory, account
+        the SLO event, and flight-record slow/errored requests. Runs for
+        every sealed request, traced or not — the intelligence layer does not
+        require span tracing."""
+        latency = time.monotonic() - r.submitted_at
+        profile = None
+        if r.root is not None:
+            profile = build_profile(
+                r.root, query=str(r.root.attrs.get("query", "")), error=error
+            )
+            r.future.profile = profile
+            self._profiles.append(profile)
+        if self.history is not None:
+            self.history.record(
+                r.fp.structure,
+                latency,
+                rows=rows,
+                bytes=(profile.total("bytes") or None) if profile is not None else None,
+                error=error is not None,
+                query=r.query_text,
+            )
+        if self.slo is not None:
+            self.slo.record(latency, error=error is not None, tenant=r.tenant)
+        if self.flight is not None and (
+            error is not None or (self._slow_s is not None and latency >= self._slow_s)
+        ):
+            self.flight.record(
+                "error" if error is not None else "slow",
+                latency,
+                fingerprint=r.fp.structure,
+                query=r.query_text,
+                tenant=r.tenant,
+                profile=profile,
+                conf_deltas=self.session.conf.deltas(),
+            )
 
     # -- observability -------------------------------------------------------
     def last_profiles(self) -> List:
         """Most recent per-request ``QueryProfile``s (bounded by
         ``hyperspace.obs.profile.history``), oldest first."""
         return list(self._profiles)
+
+    def last_slow_queries(self) -> List:
+        """Flight-recorder entries (slow/errored/rejected requests), oldest
+        first; empty when ``hyperspace.obs.slowQueryMs`` is 0."""
+        return [] if self.flight is None else self.flight.last_slow_queries()
+
+    def estimate_cost(self, query: Any):
+        """Learned cost estimate for a query, SQL text, DataFrame, or
+        fingerprint-structure hash: ``CostEstimate(latency_s, confidence,
+        samples)`` from the fingerprint history, or None when the history is
+        disabled or has never seen the fingerprint."""
+        if self.history is None:
+            return None
+        if isinstance(query, str) and len(query) == 40 and all(
+            c in "0123456789abcdef" for c in query
+        ):
+            return self.history.estimate_cost(query)  # already a structure hash
+        _, fp = self._parse(query)
+        return self.history.estimate_cost(fp.structure)
+
+    def serve_telemetry(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start (or return) the HTTP telemetry endpoint for this server:
+        ``/metrics`` (Prometheus 0.0.4), ``/statusz`` (JSON), ``/profilez``
+        (fingerprint drill-down). ``port=0`` binds an ephemeral port —
+        read ``server.telemetry.port``."""
+        if self.telemetry is None:
+            from hyperspace_tpu.obs.export import TelemetryEndpoint
+
+            self.telemetry = TelemetryEndpoint(
+                self.registry,
+                host=host,
+                port=port,
+                status_fn=self.statusz,
+                history=self.history,
+                flight=self.flight,
+            ).start()
+        return self.telemetry
+
+    def statusz(self) -> dict:
+        """The ``/statusz`` body: serving stats + cache hit rates + SLO state
+        + intelligence-layer summaries, one JSON-able dict."""
+        out = {"server": self.server_name, "serving": self.stats()}
+        if self.slo is not None:
+            out["slo"] = self.slo.state()
+        if self.history is not None:
+            out["profileHistory"] = {
+                "fingerprints": len(self.history),
+                "evicted": self.history.evicted,
+            }
+        if self.flight is not None:
+            out["slowQueries"] = self.flight.snapshot()
+        return out
 
     def prometheus_text(self) -> str:
         """Prometheus exposition of this server's registry (the process-wide

@@ -53,6 +53,9 @@ class Session:
         self._temp_views: Dict[str, Any] = {}
         # most recent QueryProfile from a traced collect() (obs tracing on)
         self._last_profile = None
+        # lazily-built fingerprint-keyed ProfileHistory for ad-hoc queries
+        # (QueryServer instances own their own, registry-labeled per server)
+        self._profile_history = None
 
     # --- reading data ------------------------------------------------------
     def read(self, paths, file_format: str, **options) -> "DataFrame":  # noqa: F821
@@ -169,6 +172,32 @@ class Session:
         this session, or None. Requires ``hyperspace.obs.tracing.enabled``;
         see docs/observability.md."""
         return self._last_profile
+
+    @property
+    def profile_history(self):
+        """The session's fingerprint-keyed :class:`ProfileHistory` (traced
+        ad-hoc ``collect()`` calls fold into it), or None when
+        ``hyperspace.obs.history.enabled`` is false."""
+        if self._profile_history is None and self.conf.obs_history_enabled:
+            from hyperspace_tpu.obs.history import ProfileHistory
+
+            self._profile_history = ProfileHistory(
+                max_fingerprints=self.conf.obs_history_max_fingerprints
+            )
+        return self._profile_history
+
+    def estimate_cost(self, query):
+        """Learned latency estimate for a SQL string or DataFrame from this
+        session's profile history (see ``ProfileHistory.estimate_cost``);
+        None when the history is disabled or the fingerprint is unseen."""
+        history = self.profile_history
+        if history is None:
+            return None
+        from hyperspace_tpu.serving.fingerprint import plan_fingerprint
+
+        df = self.sql(query) if isinstance(query, str) else query
+        fp = plan_fingerprint(getattr(df, "plan", df))
+        return history.estimate_cost(fp.structure)
 
     # --- profiling ----------------------------------------------------------
     # The reference delegates runtime profiling to the Spark UI (SURVEY.md

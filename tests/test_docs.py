@@ -1,11 +1,17 @@
 """Docs honesty: every config key must be documented with its default
-(ref: docs/_docs/02-ug-configuration.md documents the reference's full table)."""
+(ref: docs/_docs/02-ug-configuration.md documents the reference's full table),
+and the metric-family reference in docs/observability.md must stay in
+lockstep with the instruments the code actually registers."""
 
+import glob
 import os
+import re
 
 from hyperspace_tpu import config
 
 DOCS = os.path.join(os.path.dirname(__file__), "..", "docs", "configuration.md")
+OBS_DOCS = os.path.join(os.path.dirname(__file__), "..", "docs", "observability.md")
+PKG = os.path.join(os.path.dirname(__file__), "..", "hyperspace_tpu")
 
 
 def test_every_config_key_documented():
@@ -26,6 +32,37 @@ def test_documented_defaults_match_code():
             assert f"`{str(default).lower()}`" in text or key in (), key
         elif isinstance(default, int) and default >= 100:
             assert f"`{default}`" in text, f"{key} default {default} not documented"
+
+
+def _registered_metric_families():
+    """Every hs_* family name at a registry registration site. The pattern
+    anchors on the ``counter(``/``gauge(``/``histogram(`` call so incidental
+    hs_-prefixed strings (contextvar names, column prefixes) don't count."""
+    pat = re.compile(
+        r"""(?:counter|gauge|histogram)\(\s*["'](hs_[a-z0-9_]+)["']""", re.DOTALL
+    )
+    fams = set()
+    for path in glob.glob(os.path.join(PKG, "**", "*.py"), recursive=True):
+        fams |= set(pat.findall(open(path).read()))
+    return fams
+
+
+def test_metric_families_documented_and_no_doc_drift():
+    code = _registered_metric_families()
+    assert len(code) > 20  # the regex found the registration sites at all
+    text = open(OBS_DOCS).read()
+    doc = set(re.findall(r"\bhs_[a-z0-9_]+[a-z0-9]", text))
+    # histogram expositions add _bucket/_sum/_count series; the doc may show
+    # them, but they document their base family
+    doc_base = {
+        re.sub(r"_(bucket|sum|count)$", "", f) if
+        re.sub(r"_(bucket|sum|count)$", "", f) in code else f
+        for f in doc
+    }
+    undocumented = sorted(code - doc_base)
+    assert not undocumented, f"metric families missing from docs/observability.md: {undocumented}"
+    phantom = sorted(doc_base - code)
+    assert not phantom, f"docs/observability.md documents families the code never registers: {phantom}"
 
 
 def test_doc_files_referenced_in_code_exist():

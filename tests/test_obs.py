@@ -264,6 +264,25 @@ def test_prometheus_text_exposition():
     assert text.endswith("\n")
 
 
+def test_prometheus_label_value_escaping():
+    # exposition format 0.0.4: label values escape backslash, double-quote,
+    # and newline — a raw query fragment in a label must not corrupt a scrape
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("hs_esc_total", "escaping", q='he said "hi"\npath\\x').inc()
+    text = reg.prometheus_text()
+    assert 'q="he said \\"hi\\"\\npath\\\\x"' in text
+    # one series line per metric: the newline stayed escaped, not literal
+    (line,) = [l for l in text.splitlines() if l.startswith("hs_esc_total{")]
+    assert line.endswith("} 1")
+
+
+def test_prometheus_help_escaping():
+    reg = obs_metrics.MetricsRegistry()
+    reg.counter("hs_help_total", "line1\nline2 with \\ backslash").inc()
+    text = reg.prometheus_text()
+    assert "# HELP hs_help_total line1\\nline2 with \\\\ backslash\n" in text
+
+
 def test_registry_snapshot_shape():
     reg = obs_metrics.MetricsRegistry()
     reg.counter("hs_c", "c", k="v").inc(2)

@@ -1,0 +1,439 @@
+"""Query intelligence layer: profile history, SLO tracking, flight recorder,
+and the HTTP telemetry endpoint (hyperspace_tpu/obs/{history,slo,export}.py).
+
+Covers the P² sketch against exact percentiles, the cost-model acceptance
+bar (estimate within 2x of the true median after >= 20 samples), LRU
+bounding, JSONL persistence round-trips, SLO burn-rate windows under an
+injected clock, and the endpoint contract (GET /metrics byte-identical to
+``registry.prometheus_text()``). All HTTP tests bind port 0.
+"""
+
+import json
+import random
+import statistics
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.obs import spans
+from hyperspace_tpu.obs.export import PROMETHEUS_CONTENT_TYPE, TelemetryEndpoint
+from hyperspace_tpu.obs.history import (
+    FlightRecorder,
+    P2Quantile,
+    ProfileHistory,
+    StreamStat,
+    load_history,
+)
+from hyperspace_tpu.obs.metrics import MetricsRegistry
+from hyperspace_tpu.obs.profile import build_profile
+from hyperspace_tpu.obs.slo import SloTracker
+from hyperspace_tpu.serving import QueryServer
+
+pytestmark = pytest.mark.obshist
+
+FP = "a" * 40  # structure-hash shaped fingerprint
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as r:
+        return r.status, r.headers.get("Content-Type"), r.read()
+
+
+# --- P² quantile sketch ------------------------------------------------------
+
+
+def test_p2_exact_below_five_samples():
+    q = P2Quantile(0.5)
+    assert q.value is None
+    for v in (5.0, 1.0, 3.0):
+        q.add(v)
+    assert q.value == 3.0  # sorts what it has
+
+
+@pytest.mark.parametrize("p", [0.5, 0.95])
+def test_p2_tracks_true_quantile(p):
+    rng = random.Random(42)
+    q = P2Quantile(p)
+    vals = [rng.lognormvariate(0.0, 0.5) for _ in range(2000)]
+    for v in vals:
+        q.add(v)
+    true = float(np.percentile(vals, p * 100))
+    assert q.value == pytest.approx(true, rel=0.15)
+
+
+def test_stream_stat_summary():
+    s = StreamStat()
+    for v in (1.0, 2.0, 3.0, 4.0, 5.0, 6.0):
+        s.add(v)
+    assert s.n == 6
+    assert s.mean == pytest.approx(3.5)
+    assert s.min == 1.0 and s.max == 6.0
+    assert s.ema is not None and 1.0 < s.ema < 6.0
+    j = s.to_json()
+    assert set(j) == {"n", "mean", "ema", "min", "max", "p50", "p95"}
+    json.dumps(j)
+
+
+# --- ProfileHistory ----------------------------------------------------------
+
+
+def test_history_unseen_fingerprint_is_none():
+    h = ProfileHistory()
+    assert h.estimate_cost("f" * 40) is None
+    assert h.get("f" * 40) is None
+
+
+def test_history_estimate_within_2x_of_median():
+    # the acceptance bar: a deterministic noisy workload, >= 20 samples,
+    # predicted latency within 2x of the true median
+    rng = random.Random(7)
+    h = ProfileHistory()
+    lats = [0.05 * rng.lognormvariate(0.0, 0.4) for _ in range(40)]
+    for lat in lats:
+        h.record(FP, lat, rows=100)
+    est = h.estimate_cost(FP)
+    assert est is not None and est.samples >= 20
+    med = statistics.median(lats)
+    assert med / 2.0 <= est.latency_s <= med * 2.0
+    assert 0.0 < est.confidence <= 1.0
+
+
+def test_history_confidence_grows_with_samples():
+    h = ProfileHistory()
+    h.record(FP, 0.1)
+    c1 = h.estimate_cost(FP).confidence
+    for _ in range(30):
+        h.record(FP, 0.1)
+    c2 = h.estimate_cost(FP).confidence
+    assert c2 > c1
+    assert c2 == pytest.approx(1.0)  # zero dispersion, saturated samples
+
+
+def test_history_errors_not_folded_into_latency():
+    # a fast failure must not teach the cost model the fingerprint is cheap
+    h = ProfileHistory()
+    for _ in range(10):
+        h.record(FP, 1.0)
+    for _ in range(10):
+        h.record(FP, 0.001, error=True)
+    e = h.get(FP)
+    assert e["count"] == 20 and e["errors"] == 10
+    assert e["latencySeconds"]["n"] == 10
+    assert h.estimate_cost(FP).latency_s == pytest.approx(1.0)
+
+
+def test_history_lru_bound_and_eviction():
+    h = ProfileHistory(max_fingerprints=3)
+    for i in range(5):
+        h.record(f"{i:040d}", 0.1)
+    assert len(h) == 3 and h.evicted == 2
+    # touching an entry protects it from the next eviction
+    h.record("0" * 39 + "2", 0.1)
+    h.record("9" * 40, 0.1)
+    assert ("0" * 39 + "2") in h.fingerprints()
+
+
+def test_history_registry_gauge_and_counter():
+    reg = MetricsRegistry()
+    h = ProfileHistory(registry=reg, server="qs9")
+    h.record(FP, 0.1)
+    h.record("b" * 40, 0.2)
+    snap = reg.snapshot()
+    (g,) = snap["hs_profile_history_fingerprints"]["series"]
+    assert g["value"] == 2
+    (c,) = snap["hs_profile_history_folds_total"]["series"]
+    assert c["value"] == 2 and c["labels"] == {"server": "qs9"}
+
+
+def test_history_jsonl_roundtrip(tmp_path):
+    path = str(tmp_path / "hist" / "workload.jsonl")
+    h = ProfileHistory(persist_path=path)
+    for i in range(25):
+        h.record(FP, 0.1 + 0.001 * (i % 5), rows=50, query="SELECT 1")
+    h.record("b" * 40, 0.5, error=True)
+    before = h.estimate_cost(FP)
+    h.close()
+    h2 = load_history(path)
+    assert sorted(h2.fingerprints()) == sorted(h.fingerprints())
+    e = h2.get(FP)
+    assert e["count"] == 25 and e["query"] == "SELECT 1"
+    assert h2.get("b" * 40)["errors"] == 1
+    after = h2.estimate_cost(FP)
+    assert after.samples == before.samples
+    assert after.latency_s == pytest.approx(before.latency_s)
+
+
+def test_load_history_skips_corrupt_lines(tmp_path):
+    path = tmp_path / "w.jsonl"
+    good = json.dumps({"fp": FP, "latencySeconds": 0.2})
+    path.write_text(f"{good}\nnot json at all\n{{\"latencySeconds\": 1}}\n{good}\n")
+    h = load_history(str(path))
+    assert h.get(FP)["count"] == 2
+
+
+def test_history_snapshot_is_jsonable():
+    h = ProfileHistory()
+    h.record(FP, 0.1, rows=10, bytes=1000)
+    snap = h.snapshot()
+    assert snap["fingerprints"] == 1
+    (e,) = snap["entries"]
+    assert e["fingerprint"] == FP and e["estimate"]["samples"] == 1
+    json.dumps(snap)
+
+
+# --- FlightRecorder ----------------------------------------------------------
+
+
+def _traced_profile(query="SELECT x"):
+    with spans.trace("request") as root:
+        with spans.span("execute", cat="exec") as sp:
+            sp.set(rows=10)
+    return build_profile(root, query=query)
+
+
+def test_flight_recorder_ring_and_chrome_trace(tmp_path):
+    reg = MetricsRegistry()
+    fr = FlightRecorder(max_entries=2, directory=str(tmp_path / "slow"), registry=reg)
+    for i in range(4):
+        fr.record("slow", 0.5 + i, fingerprint=FP, query=f"q{i}",
+                  tenant="t", profile=_traced_profile(f"q{i}"))
+    entries = fr.last_slow_queries()
+    assert [e.query for e in entries] == ["q2", "q3"]  # ring keeps the newest
+    e = entries[-1]
+    assert e.profile is not None and e.profile.root.find("execute")
+    ct = e.chrome_trace()
+    assert ct and ct["traceEvents"]
+    out = e.save_chrome_trace(str(tmp_path / "t.json"))
+    assert json.load(open(out))["traceEvents"]
+    # on-disk ring pruned to max_entries, each file self-contained
+    files = sorted((tmp_path / "slow").glob("slow-*.json"))
+    assert len(files) == 2
+    body = json.load(open(files[-1]))
+    assert body["query"] == "q3" and body["chromeTrace"]["traceEvents"]
+    snap = reg.snapshot()
+    (c,) = snap["hs_slow_queries_total"]["series"]
+    assert c["value"] == 4 and c["labels"] == {"reason": "slow"}
+
+
+def test_flight_recorder_without_profile_or_disk():
+    fr = FlightRecorder(max_entries=4)
+    e = fr.record("rejected", 0.0, fingerprint=FP, conf_deltas={"k": 1})
+    assert e.chrome_trace() is None and e.path is None
+    assert fr.snapshot()[0]["reason"] == "rejected"
+    assert fr.snapshot()[0]["confDeltas"] == {"k": "1"}
+
+
+# --- SLO tracking ------------------------------------------------------------
+
+
+def test_slo_objective_validation():
+    with pytest.raises(ValueError):
+        SloTracker(target_ms=100, objective=1.0)
+
+
+def test_slo_good_bad_and_burn_rate_windows():
+    clk = [1000.0]
+    reg = MetricsRegistry()
+    slo = SloTracker(target_ms=100.0, objective=0.9, windows_s=(60.0, 600.0),
+                     registry=reg, server="qs1", clock=lambda: clk[0])
+    assert slo.record(0.05) is True
+    assert slo.record(0.2) is False  # slow
+    assert slo.record(0.01, error=True) is False  # errored
+    # 2 bad / 3 total over a 10% budget -> burn rate 6.67
+    assert slo.burn_rate(60.0) == pytest.approx((2 / 3) / 0.1)
+    # age the events out of the short window but not the long one
+    clk[0] += 120.0
+    slo.record(0.05)
+    assert slo.burn_rate(60.0) == 0.0
+    assert slo.burn_rate(600.0) == pytest.approx((2 / 4) / 0.1)
+    st = slo.state()
+    t = st["tenants"]["default"]
+    assert t["good"] == 2 and t["bad"] == 2 and t["compliance"] == 0.5
+    assert t["burnRates"]["60s"] == 0.0
+    # the registry carries the same truth, per tenant + server + window
+    snap = reg.snapshot()
+    labels = {s["labels"]["window"]: s["value"]
+              for s in snap["hs_slo_burn_rate"]["series"]}
+    assert labels["60s"] == 0.0 and labels["600s"] == pytest.approx(5.0)
+    (good,) = snap["hs_slo_good_total"]["series"]
+    assert good["value"] == 2
+    assert good["labels"] == {"tenant": "default", "server": "qs1"}
+
+
+def test_slo_tenants_are_isolated():
+    slo = SloTracker(target_ms=100.0, objective=0.99)
+    slo.record(0.5, tenant="noisy")
+    slo.record(0.01, tenant="quiet")
+    assert slo.burn_rate(300.0, tenant="noisy") == pytest.approx(100.0)
+    assert slo.burn_rate(300.0, tenant="quiet") == 0.0
+    assert slo.burn_rate(300.0, tenant="absent") == 0.0
+
+
+# --- HTTP telemetry endpoint -------------------------------------------------
+
+
+def test_endpoint_metrics_byte_identical_to_registry():
+    reg = MetricsRegistry()
+    reg.counter("hs_served_total", "served", server="qs1").inc(3)
+    reg.gauge("hs_depth", "queue depth").set(2)
+    with TelemetryEndpoint(reg, port=0) as ep:
+        status, ctype, body = _get(ep.url + "/metrics")
+    assert status == 200 and ctype == PROMETHEUS_CONTENT_TYPE
+    # the acceptance bar: the wire bytes ARE the registry exposition
+    assert body == reg.prometheus_text().encode("utf-8")
+    assert b'hs_served_total{server="qs1"} 3' in body
+
+
+def test_endpoint_statusz_and_404():
+    reg = MetricsRegistry()
+    with TelemetryEndpoint(reg, port=0, status_fn=lambda: {"ok": True}) as ep:
+        status, ctype, body = _get(ep.url + "/statusz")
+        assert status == 200 and json.loads(body) == {"ok": True}
+        assert ctype.startswith("application/json")
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(ep.url + "/nope")
+        assert ei.value.code == 404
+        assert "/metrics" in json.loads(ei.value.read())["endpoints"]
+    # requests were counted per path
+    paths = {s["labels"]["path"] for s in reg.snapshot()["hs_http_requests_total"]["series"]}
+    assert {"/statusz", "/nope"} <= paths
+
+
+def test_endpoint_profilez_overview_and_drilldown():
+    reg = MetricsRegistry()
+    hist = ProfileHistory()
+    for _ in range(5):
+        hist.record(FP, 0.1, query="SELECT a")
+    fr = FlightRecorder(max_entries=4)
+    fr.record("slow", 0.9, fingerprint=FP, query="SELECT a")
+    fr.record("slow", 0.9, fingerprint="b" * 40, query="SELECT b")
+    with TelemetryEndpoint(reg, port=0, history=hist, flight=fr) as ep:
+        _, _, body = _get(ep.url + "/profilez")
+        overview = json.loads(body)
+        assert overview["fingerprints"] == 1
+        _, _, body = _get(ep.url + f"/profilez?fingerprint={FP}")
+        detail = json.loads(body)
+        assert detail["count"] == 5 and detail["estimate"]["samples"] == 5
+        # slow-query drill-down is filtered to this fingerprint
+        assert [e["query"] for e in detail["slowQueries"]] == ["SELECT a"]
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(ep.url + "/profilez?fingerprint=" + "c" * 40)
+        assert ei.value.code == 404
+
+
+def test_endpoint_profilez_404_when_history_disabled():
+    with TelemetryEndpoint(MetricsRegistry(), port=0) as ep:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(ep.url + "/profilez")
+        assert ei.value.code == 404
+
+
+# --- QueryServer integration -------------------------------------------------
+
+
+@pytest.fixture()
+def served(tmp_path):
+    n = 400
+    pq.write_table(
+        pa.table({
+            "id": np.arange(n, dtype=np.int64),
+            "price": (np.arange(n, dtype=np.int64) * 7) % 100,
+        }),
+        str(tmp_path / "t.parquet"),
+    )
+    sess = hst.Session(conf={
+        hst.keys.SYSTEM_PATH: str(tmp_path / "_indexes"),
+        hst.keys.OBS_TRACING_ENABLED: True,
+        hst.keys.OBS_SLOW_QUERY_MS: 0.000001,  # everything is "slow"
+        hst.keys.OBS_SLO_TARGET_MS: 50.0,
+        hst.keys.OBS_HISTORY_PERSIST: True,
+    })
+    sess.read_parquet(str(tmp_path / "t.parquet")).create_or_replace_temp_view("t")
+    return sess
+
+
+def test_server_folds_completions_into_intelligence(served):
+    q = "SELECT id FROM t WHERE price > 45"
+    with QueryServer(served) as srv:
+        for _ in range(21):
+            srv.query(q, tenant="acme")
+    # shutdown joined the workers, so every completion hook has run
+    est = srv.estimate_cost(q)
+    assert est is not None and est.samples >= 20
+    # cost model: learned, sampled, and within the 2x bar against the
+    # server's own observed median
+    p50 = srv.metrics.latency_percentiles()["p50"]
+    assert p50 / 2.0 <= est.latency_s <= p50 * 2.0
+    # the structure hash itself also resolves
+    fp = srv.history.fingerprints()[0]
+    assert srv.estimate_cost(fp).samples == est.samples
+    # flight recorder: every query tripped the 1us threshold, span
+    # trees intact and exportable
+    slow = srv.last_slow_queries()
+    assert slow and slow[0].reason == "slow"
+    assert slow[0].profile.root.find("execute")
+    assert slow[0].chrome_trace()["traceEvents"]
+    assert slow[0].tenant == "acme"
+    # SLO + tenant series landed in the server's registry
+    snap = srv.registry.snapshot()
+    assert "hs_slo_good_total" in snap or "hs_slo_bad_total" in snap
+    tenants = {s["labels"]["tenant"]
+               for s in snap["hs_serving_tenant_requests_total"]["series"]
+               if s["labels"].get("server") == srv.server_name}
+    assert tenants == {"acme"}
+    st = srv.statusz()
+    assert st["slo"]["tenants"]["acme"]["good"] + st["slo"]["tenants"]["acme"]["bad"] == 21
+    assert st["profileHistory"]["fingerprints"] == 1
+    # the workload log survives shutdown and replays into an equal history
+    h2 = load_history(srv.history._persist_path)
+    assert h2.get(fp)["count"] == 21
+
+
+def test_server_telemetry_endpoint_end_to_end(served):
+    with QueryServer(served) as srv:
+        srv.query("SELECT id FROM t WHERE price > 45")
+        ep = srv.serve_telemetry(port=0)
+        status, _, body = _get(ep.url + "/metrics")
+        assert status == 200
+        assert body == srv.registry.prometheus_text().encode("utf-8")
+        _, _, body = _get(ep.url + "/statusz")
+        st = json.loads(body)
+        assert st["server"] == srv.server_name
+        assert st["serving"]["completed"] == 1
+        _, _, body = _get(ep.url + "/profilez")
+        assert json.loads(body)["fingerprints"] == 1
+    assert srv.telemetry is None  # shutdown closed it
+
+
+def test_session_estimate_cost_from_traced_collects(tmp_path):
+    n = 200
+    pq.write_table(pa.table({"a": np.arange(n, dtype=np.int64)}),
+                   str(tmp_path / "d.parquet"))
+    sess = hst.Session(conf={hst.keys.OBS_TRACING_ENABLED: True})
+    df = sess.read_parquet(str(tmp_path / "d.parquet")).filter(hst.col("a") < 50)
+    assert sess.estimate_cost(df) is None  # nothing folded yet
+    for _ in range(3):
+        df.collect()
+    est = sess.estimate_cost(df)
+    assert est is not None and est.samples == 3 and est.latency_s > 0
+    # a different plan shape is a different fingerprint: still unseen
+    assert sess.estimate_cost(sess.read_parquet(str(tmp_path / "d.parquet"))) is None
+
+
+def test_history_disabled_by_conf(tmp_path):
+    n = 50
+    pq.write_table(pa.table({"a": np.arange(n, dtype=np.int64)}),
+                   str(tmp_path / "d.parquet"))
+    sess = hst.Session(conf={hst.keys.OBS_HISTORY_ENABLED: False})
+    assert sess.profile_history is None
+    assert sess.estimate_cost(sess.read_parquet(str(tmp_path / "d.parquet"))) is None
+    with QueryServer(sess) as srv:
+        assert srv.history is None
+        sess.read_parquet(str(tmp_path / "d.parquet")).create_or_replace_temp_view("v")
+        srv.query("SELECT a FROM v")  # completion path tolerates the absence
+        assert srv.estimate_cost("SELECT a FROM v") is None

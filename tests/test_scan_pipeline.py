@@ -220,6 +220,27 @@ class TestScanPipelineUnit:
         assert len(first) == 2
         it.close()  # must not raise, deadlock, or leave workers running
 
+    def test_abandoned_stream_leaks_no_open_spans(self, tmp_path):
+        # a caller that walks away mid-stream must not leave per-chunk
+        # "execute" spans dangling: GeneratorExit unwinds the with-blocks,
+        # so everything under the trace root is finished and the contextvar
+        # is back where it was
+        from hyperspace_tpu.obs import spans
+
+        data = _write_files(str(tmp_path / "data"))
+        sess = _mk_session(tmp_path, **{hst.keys.OBS_TRACING_ENABLED: True})
+        df = sess.read_parquet(data)
+        q = df.filter(hst.col("k") < 400).select("k", "v")
+        with spans.trace("stream-abandon") as root:
+            it = q.to_local_iterator()
+            next(it)
+            assert spans.current_span() is root  # nothing left attached
+            it.close()
+            open_spans = [s for s in root.walk() if s is not root and s.t1 is None]
+            assert open_spans == []
+            assert root.find("execute")  # the consumed chunk WAS traced
+        assert spans.current_span() is None
+
     def test_byte_budget_limits_lookahead(self):
         order = []
 
