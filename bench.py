@@ -21,6 +21,11 @@ unconditional; only their cost must vanish).
 ``--scan-pipeline`` runs the pipelined scan engine benchmark (cold-cache
 streamed filter scan, pipelined vs serial, byte-identity and XLA-compile-count
 checks) and writes BENCH_scan_pipeline.json. Bar: >= 1.4x.
+
+``--slo-serve`` runs the SLO-aware serving benchmark (interactive p99 under a
+heavy flood, FIFO vs cost-aware scheduler, plus result-cache vs
+plan-cache-only throughput) and writes BENCH_slo.json. Bars: >= 2x p99, >= 3x
+hit-path throughput at >= 95% hit rate.
 """
 
 from __future__ import annotations
@@ -200,6 +205,143 @@ def serve_main() -> None:
         }
         line = json.dumps(out)
         with open("BENCH_serving.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def slo_serve_main() -> None:
+    """``python bench.py --slo-serve``: SLO-aware serving benchmark.
+
+    Two measurements, one JSON line (stdout AND BENCH_slo.json):
+
+    - **scheduler**: a burst of heavy group-by queries from a flooding
+      ``batch`` tenant followed immediately by interactive point filters from
+      a ``web`` tenant, served FIFO vs by the cost-aware scheduler (cost model
+      warmed first so the classes are confident). Bar: interactive-class p99
+      latency >= 2x better under the scheduler at equal total throughput.
+    - **result cache**: the same repeated-query workload with the result
+      cache on vs plan-cache-only. Bar: >= 3x hit-path throughput at a
+      >= 95% hit rate.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    num_rows = int(os.environ.get("BENCH_SLO_ROWS", 120_000))
+    n_heavy = max(4, int(os.environ.get("BENCH_SLO_HEAVY", 48)))
+    n_inter = max(4, int(os.environ.get("BENCH_SLO_INTERACTIVE", 24)))
+    rc_reps = max(2, int(os.environ.get("BENCH_SLO_CACHE_REPS", 20)))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_slo_")
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.serving import QueryServer
+
+        data_dir = os.path.join(tmp, "sales")
+        os.makedirs(data_dir)
+        names = list("abcdefgh")
+        cols = {
+            c: (np.arange(num_rows, dtype=np.int64) * (3 + i)) % (997 + 131 * i)
+            for i, c in enumerate(names)
+        }
+        cols["v"] = (np.arange(num_rows, dtype=np.int64) * 31) % 10_000
+        pq.write_table(pa.table(cols), os.path.join(data_dir, "part-0.parquet"))
+
+        sess = hst.Session()
+        hst.set_session(sess)
+        sess.read_parquet(data_dir).create_or_replace_temp_view("sales")
+
+        heavy_q = "SELECT b, SUM(v), SUM(a), SUM(c) FROM sales GROUP BY b"
+        inter_qs = [
+            f"SELECT a, v FROM sales WHERE b > {300 + i} AND c > 5 AND d < 900"
+            for i in range(4)
+        ]
+
+        def burst(sched: bool):
+            """Interactive-class p99 seconds + total qps for one mixed burst."""
+            srv = QueryServer(
+                sess, workers=2, sched_enabled=sched, queue_depth=65536,
+                # class thresholds scaled to this workload (CPU smoke runs
+                # measure milliseconds, not the production half-second)
+                sched_interactive_ms=10.0, sched_heavy_ms=40.0,
+            ).start()
+            try:
+                # warm: io cache AND the cost model (the scheduler needs
+                # confident per-class estimates to beat FIFO)
+                for _ in range(25):
+                    srv.query(heavy_q)
+                    for q in inter_qs:
+                        srv.query(q)
+                lat: dict = {}
+
+                def done_cb(i, t_sub):
+                    def cb(_f, i=i, t_sub=t_sub):
+                        lat[i] = time.perf_counter() - t_sub
+
+                    return cb
+
+                futs = []
+                t0 = time.perf_counter()
+                for i in range(n_heavy):  # the flood arrives first
+                    futs.append(srv.submit(heavy_q, tenant="batch"))
+                for i in range(n_inter):
+                    f = srv.submit(inter_qs[i % len(inter_qs)], tenant="web")
+                    f.add_done_callback(done_cb(i, time.perf_counter()))
+                    futs.append(f)
+                for f in futs:
+                    f.result(timeout=600)
+                dt = time.perf_counter() - t0
+                p99 = float(np.percentile(sorted(lat.values()), 99))
+                return p99, len(futs) / dt
+            finally:
+                srv.shutdown()
+
+        fifo_p99, fifo_qps = burst(sched=False)
+        sched_p99, sched_qps = burst(sched=True)
+
+        def cache_run(result_cache: bool):
+            srv = QueryServer(
+                sess, workers=2, result_cache_enabled=result_cache, queue_depth=65536
+            ).start()
+            try:
+                for q in inter_qs:  # warm: every later rep is a potential hit
+                    srv.query(q)
+                futs = []
+                t0 = time.perf_counter()
+                for _ in range(rc_reps):
+                    for q in inter_qs:
+                        futs.append(srv.submit(q))
+                for f in futs:
+                    f.result(timeout=600)
+                dt = time.perf_counter() - t0
+                stats = srv.stats()
+                hit_rate = stats.get("resultCache", {}).get("hitRate", 0.0)
+                return len(futs) / dt, hit_rate
+            finally:
+                srv.shutdown()
+
+        plan_qps, _ = cache_run(result_cache=False)
+        rc_qps, rc_hit_rate = cache_run(result_cache=True)
+
+        p99_speedup = fifo_p99 / max(sched_p99, 1e-9)
+        out = {
+            "metric": "slo_serving_interactive_p99_speedup",
+            "value": round(p99_speedup, 2),
+            "unit": "x",
+            "vs_baseline": round(p99_speedup / 2.0, 4),  # bar: >= 2x
+            "interactive_p99_s": {"fifo": round(fifo_p99, 4), "sched": round(sched_p99, 4)},
+            "total_qps": {"fifo": round(fifo_qps, 1), "sched": round(sched_qps, 1)},
+            "result_cache": {
+                "qps": round(rc_qps, 1),
+                "plan_cache_only_qps": round(plan_qps, 1),
+                "speedup": round(rc_qps / plan_qps, 2),  # bar: >= 3x
+                "hit_rate": round(rc_hit_rate, 4),  # bar: >= 0.95
+            },
+        }
+        line = json.dumps(out)
+        with open("BENCH_slo.json", "w") as f:
             f.write(line + "\n")
         print(line)
     finally:
@@ -674,6 +816,8 @@ def main() -> None:
 if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         serve_main()
+    elif "--slo-serve" in sys.argv[1:]:
+        slo_serve_main()
     elif "--obs-overhead" in sys.argv[1:]:
         obs_main()
     elif "--scan-pipeline" in sys.argv[1:]:

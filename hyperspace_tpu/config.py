@@ -78,6 +78,25 @@ class keys:
     SERVING_BUCKET_CACHE_BYTES = "hyperspace.serving.bucketCache.bytes"
     SERVING_PREFETCH_ENABLED = "hyperspace.serving.prefetch.enabled"
     SERVING_PREFETCH_WORKERS = "hyperspace.serving.prefetch.workers"
+    # Cost-aware scheduling (serving/scheduler.py): tenant-fair dispatch
+    # ordered by predicted-cost class + deadline slack, predicted-work load
+    # shedding, per-tenant token buckets, SLO-burn-driven priority.
+    SERVING_SCHED_ENABLED = "hyperspace.serving.sched.enabled"
+    SERVING_SCHED_INTERACTIVE_MS = "hyperspace.serving.sched.interactiveMs"
+    SERVING_SCHED_HEAVY_MS = "hyperspace.serving.sched.heavyMs"
+    SERVING_SCHED_MIN_CONFIDENCE = "hyperspace.serving.sched.minConfidence"
+    SERVING_SCHED_MAX_QUEUED_SECONDS = "hyperspace.serving.sched.maxQueuedSeconds"
+    SERVING_SCHED_TENANT_WEIGHTS = "hyperspace.serving.sched.tenantWeights"
+    SERVING_SCHED_TENANT_RATE = "hyperspace.serving.sched.tenantRatePerSecond"
+    SERVING_SCHED_TENANT_BURST = "hyperspace.serving.sched.tenantBurst"
+    SERVING_SCHED_BURN_THRESHOLD = "hyperspace.serving.sched.burnBoostThreshold"
+    SERVING_SCHED_BURN_FACTOR = "hyperspace.serving.sched.burnBoostFactor"
+    # Semantic result cache (serving/result_cache.py): version-branded
+    # byte-budgeted LRU above the plan cache (exact + subsumed-predicate hits).
+    SERVING_RESULT_CACHE_ENABLED = "hyperspace.serving.resultCache.enabled"
+    SERVING_RESULT_CACHE_BYTES = "hyperspace.serving.resultCache.bytes"
+    SERVING_RESULT_CACHE_MAX_ENTRY_BYTES = "hyperspace.serving.resultCache.maxEntryBytes"
+    SERVING_RESULT_CACHE_SUBSUMPTION = "hyperspace.serving.resultCache.subsumption"
     # Observability (hyperspace_tpu/obs/): span tracing, metrics registry,
     # query profiles. Tracing is opt-in; metrics are always-on (bumping a
     # counter is cheaper than checking whether to).
@@ -230,6 +249,36 @@ DEFAULTS: Dict[str, Any] = {
     keys.SERVING_BUCKET_CACHE_BYTES: 1 << 30,
     keys.SERVING_PREFETCH_ENABLED: True,
     keys.SERVING_PREFETCH_WORKERS: 2,
+    # Cost-aware scheduler. Off by default: with both sched and resultCache
+    # disabled the server is byte-for-byte the FIFO runtime above.
+    keys.SERVING_SCHED_ENABLED: False,
+    # Predicted-latency class cut points: under interactiveMs -> interactive,
+    # over heavyMs -> heavy, between -> standard. Estimates whose confidence
+    # is below minConfidence classify as "unknown" (scheduled after standard
+    # but before heavy — unknown shapes must not starve, nor jump the line).
+    keys.SERVING_SCHED_INTERACTIVE_MS: 50.0,
+    keys.SERVING_SCHED_HEAVY_MS: 500.0,
+    keys.SERVING_SCHED_MIN_CONFIDENCE: 0.3,
+    # Shed when the confident predicted work already queued exceeds this many
+    # seconds (0 = depth-only shedding, the FIFO discipline).
+    keys.SERVING_SCHED_MAX_QUEUED_SECONDS: 0.0,
+    # "tenantA=4,tenantB=1" weighted fair shares; unlisted tenants weigh 1.
+    keys.SERVING_SCHED_TENANT_WEIGHTS: "",
+    # Per-tenant token-bucket admission rate (requests/s); 0 = unlimited.
+    keys.SERVING_SCHED_TENANT_RATE: 0.0,
+    keys.SERVING_SCHED_TENANT_BURST: 32,
+    # A tenant whose own SLO burn rate >= threshold gets its weight
+    # multiplied by factor (recovery boost); a tenant hogging the most work
+    # while ANOTHER tenant burns gets its weight divided by factor.
+    keys.SERVING_SCHED_BURN_THRESHOLD: 2.0,
+    keys.SERVING_SCHED_BURN_FACTOR: 2.0,
+    # Semantic result cache. Off by default (see sched.enabled note).
+    keys.SERVING_RESULT_CACHE_ENABLED: False,
+    keys.SERVING_RESULT_CACHE_BYTES: 256 * 1024 * 1024,
+    keys.SERVING_RESULT_CACHE_MAX_ENTRY_BYTES: 16 * 1024 * 1024,
+    # Serve a request whose predicate provably implies a cached superset
+    # predicate by re-filtering the cached batch.
+    keys.SERVING_RESULT_CACHE_SUBSUMPTION: True,
     # Span tracing is opt-in: when off, each instrumentation point costs one
     # contextvar read (bench.py --obs-overhead pins the bar at <= 3%).
     keys.OBS_TRACING_ENABLED: False,
@@ -521,6 +570,75 @@ class HyperspaceConf:
     @property
     def serving_prefetch_workers(self) -> int:
         return int(self.get(keys.SERVING_PREFETCH_WORKERS))
+
+    @property
+    def serving_sched_enabled(self) -> bool:
+        return bool(self.get(keys.SERVING_SCHED_ENABLED))
+
+    @property
+    def serving_sched_interactive_ms(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_INTERACTIVE_MS))
+
+    @property
+    def serving_sched_heavy_ms(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_HEAVY_MS))
+
+    @property
+    def serving_sched_min_confidence(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_MIN_CONFIDENCE))
+
+    @property
+    def serving_sched_max_queued_seconds(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_MAX_QUEUED_SECONDS))
+
+    @property
+    def serving_sched_tenant_weights(self) -> Dict[str, float]:
+        raw = str(self.get(keys.SERVING_SCHED_TENANT_WEIGHTS) or "")
+        out: Dict[str, float] = {}
+        for part in raw.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, w = part.partition("=")
+            try:
+                out[name.strip()] = float(w)
+            except ValueError:
+                raise ValueError(
+                    f"bad tenant weight {part!r} in {keys.SERVING_SCHED_TENANT_WEIGHTS}"
+                ) from None
+        return out
+
+    @property
+    def serving_sched_tenant_rate(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_TENANT_RATE))
+
+    @property
+    def serving_sched_tenant_burst(self) -> int:
+        return int(self.get(keys.SERVING_SCHED_TENANT_BURST))
+
+    @property
+    def serving_sched_burn_threshold(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_BURN_THRESHOLD))
+
+    @property
+    def serving_sched_burn_factor(self) -> float:
+        return float(self.get(keys.SERVING_SCHED_BURN_FACTOR))
+
+    @property
+    def serving_result_cache_enabled(self) -> bool:
+        return bool(self.get(keys.SERVING_RESULT_CACHE_ENABLED))
+
+    @property
+    def serving_result_cache_bytes(self) -> int:
+        return int(self.get(keys.SERVING_RESULT_CACHE_BYTES))
+
+    @property
+    def serving_result_cache_max_entry_bytes(self) -> int:
+        return int(self.get(keys.SERVING_RESULT_CACHE_MAX_ENTRY_BYTES))
+
+    @property
+    def serving_result_cache_subsumption(self) -> bool:
+        return bool(self.get(keys.SERVING_RESULT_CACHE_SUBSUMPTION))
 
     # Observability ----------------------------------------------------------
     @property

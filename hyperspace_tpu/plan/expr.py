@@ -538,6 +538,48 @@ def as_bool_mask(x) -> np.ndarray:
     return np.asarray(x, dtype=bool)
 
 
+def split_conjuncts(e: "Expr") -> List["Expr"]:
+    """Flatten a tree of AND nodes into its conjunct list (a non-AND
+    expression is its own single conjunct)."""
+    if isinstance(e, BinaryOp) and e.op == "AND":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+#: comparison operators a predicate atom may carry (plus "in" for IN-lists)
+_ATOM_OPS = {"=", "!=", "<", "<=", ">", ">="}
+
+_FLIP_OP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}
+
+
+def comparison_atom(e: "Expr"):
+    """``(column, op, value)`` for a simple comparison conjunct — a
+    column-vs-literal comparison (normalized to column-on-the-left) or an
+    IN-list over literals, which yields ``(column, "in", frozenset)``.
+    None for anything else: the caller must treat the conjunct as opaque.
+    Used by the serving result cache to decide predicate subsumption."""
+    if isinstance(e, BinaryOp) and e.op in _ATOM_OPS:
+        if isinstance(e.left, Col) and isinstance(e.right, Lit):
+            return (e.left.name, e.op, _atom_value(e.right.value))
+        if isinstance(e.left, Lit) and isinstance(e.right, Col):
+            return (e.right.name, _FLIP_OP[e.op], _atom_value(e.left.value))
+        return None
+    if isinstance(e, In) and isinstance(e.child, Col) and all(
+        isinstance(v, Lit) for v in e.values
+    ):
+        try:
+            return (e.child.name, "in", frozenset(_atom_value(v.value) for v in e.values))
+        except TypeError:
+            return None  # unhashable literal: opaque
+    return None
+
+
+def _atom_value(v):
+    """Unwrap numpy scalars so atom values compare with plain Python
+    semantics."""
+    return v.item() if isinstance(v, np.generic) else v
+
+
 def _kleene_not(x):
     if isinstance(x, NullableBool):
         return NullableBool(~x.value, x.unknown)

@@ -30,6 +30,13 @@ from hyperspace_tpu.serving.fingerprint import (
 )
 from hyperspace_tpu.serving.metrics import ServingMetrics
 from hyperspace_tpu.serving.plan_cache import CompiledPlan, PlanCache, session_token
+from hyperspace_tpu.serving.result_cache import ResultCache, version_brand
+from hyperspace_tpu.serving.scheduler import (
+    COST_CLASSES,
+    CostAwareScheduler,
+    TokenBucket,
+    classify_cost,
+)
 from hyperspace_tpu.serving.server import QueryServer
 
 __all__ = [
@@ -48,4 +55,10 @@ __all__ = [
     "bind_literals",
     "Unparameterizable",
     "session_token",
+    "CostAwareScheduler",
+    "TokenBucket",
+    "classify_cost",
+    "COST_CLASSES",
+    "ResultCache",
+    "version_brand",
 ]

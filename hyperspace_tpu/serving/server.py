@@ -40,6 +40,8 @@ from hyperspace_tpu.serving.bucket_cache import BucketCache
 from hyperspace_tpu.serving.fingerprint import Fingerprint, plan_fingerprint
 from hyperspace_tpu.serving.metrics import ServingMetrics
 from hyperspace_tpu.serving.plan_cache import CompiledPlan, PlanCache, session_token
+from hyperspace_tpu.serving.result_cache import ResultCache, version_brand
+from hyperspace_tpu.serving.scheduler import CostAwareScheduler, classify_cost
 
 __all__ = ["QueryServer", "AdmissionRejected", "RequestTimeout", "ServerClosed"]
 
@@ -50,11 +52,13 @@ _server_seq = itertools.count()
 class _Request:
     __slots__ = (
         "plan", "fp", "token", "enabled", "future", "deadline", "submitted_at",
-        "root", "tenant", "query_text",
+        "root", "tenant", "query_text", "cost_class", "brand", "dequeued_at",
+        "sched_charge",
     )
 
     def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline, root=None,
-                 tenant: str = "default", query_text: str = ""):
+                 tenant: str = "default", query_text: str = "",
+                 cost_class: str = "unknown", brand: Optional[str] = None):
         self.plan = plan
         self.fp = fp
         self.token = token
@@ -67,6 +71,14 @@ class _Request:
         self.root = root
         self.tenant = tenant
         self.query_text = query_text
+        # scheduling/caching context: predicted cost class for priority and
+        # wait-time labels, the submit-time data-version brand for the result
+        # cache, and the dispatch bookkeeping the fair scheduler corrects
+        # against at completion
+        self.cost_class = cost_class
+        self.brand = brand
+        self.dequeued_at: Optional[float] = None
+        self.sched_charge = 0.0
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -84,7 +96,12 @@ class QueryServer:
     ``default_timeout``, ``plan_cache_enabled``, ``plan_cache_max_entries``,
     ``micro_batch_enabled``, ``micro_batch_max_requests``,
     ``micro_batch_max_wait_ms``, ``bucket_cache_bytes``,
-    ``prefetch_enabled``, ``prefetch_workers``.
+    ``prefetch_enabled``, ``prefetch_workers``, ``sched_enabled``,
+    ``sched_interactive_ms``, ``sched_heavy_ms``, ``sched_min_confidence``,
+    ``sched_max_queued_seconds``, ``sched_tenant_weights``,
+    ``sched_tenant_rate``, ``sched_tenant_burst``, ``sched_burn_threshold``,
+    ``sched_burn_factor``, ``result_cache_enabled``, ``result_cache_bytes``,
+    ``result_cache_max_entry_bytes``, ``result_cache_subsumption``.
     """
 
     def __init__(self, session, **overrides):
@@ -102,10 +119,49 @@ class QueryServer:
         self.micro_batch_wait_s = float(opt("micro_batch_max_wait_ms", conf.serving_micro_batch_max_wait_ms)) / 1000.0
         self.prefetch_enabled = bool(opt("prefetch_enabled", conf.serving_prefetch_enabled))
 
-        self.admission = AdmissionController(
-            depth=int(opt("queue_depth", conf.serving_queue_depth)),
-            default_timeout=opt("default_timeout", conf.serving_default_timeout_seconds),
-        )
+        depth = int(opt("queue_depth", conf.serving_queue_depth))
+        default_timeout = opt("default_timeout", conf.serving_default_timeout_seconds)
+        self.sched_enabled = bool(opt("sched_enabled", conf.serving_sched_enabled))
+        self._interactive_s = float(opt("sched_interactive_ms", conf.serving_sched_interactive_ms)) / 1000.0
+        self._heavy_s = float(opt("sched_heavy_ms", conf.serving_sched_heavy_ms)) / 1000.0
+        self._min_confidence = float(opt("sched_min_confidence", conf.serving_sched_min_confidence))
+        sched_max_queued_s = float(opt("sched_max_queued_seconds", conf.serving_sched_max_queued_seconds))
+        sched_weights = opt("sched_tenant_weights", conf.serving_sched_tenant_weights)
+        sched_rate = float(opt("sched_tenant_rate", conf.serving_sched_tenant_rate))
+        sched_burst = float(opt("sched_tenant_burst", conf.serving_sched_tenant_burst))
+        sched_burn_threshold = float(opt("sched_burn_threshold", conf.serving_sched_burn_threshold))
+        sched_burn_factor = float(opt("sched_burn_factor", conf.serving_sched_burn_factor))
+        if self.sched_enabled:
+            self.admission: AdmissionController = CostAwareScheduler(
+                depth=depth,
+                default_timeout=default_timeout,
+                interactive_s=self._interactive_s,
+                heavy_s=self._heavy_s,
+                min_confidence=self._min_confidence,
+                max_queued_seconds=sched_max_queued_s,
+                tenant_weights=sched_weights,
+                tenant_rate=sched_rate,
+                tenant_burst=sched_burst,
+                burn_threshold=sched_burn_threshold,
+                burn_factor=sched_burn_factor,
+                cost_fn=self._sched_cost,
+                burn_rate_fn=self._sched_burn,
+            )
+        else:
+            self.admission = AdmissionController(depth=depth, default_timeout=default_timeout)
+        # eagerly-expired queued requests still get their telemetry sealed
+        self.admission.on_expired = self._expire_seal
+        self.result_cache = None
+        rc_enabled = bool(opt("result_cache_enabled", conf.serving_result_cache_enabled))
+        rc_bytes = int(opt("result_cache_bytes", conf.serving_result_cache_bytes))
+        rc_entry_bytes = int(opt("result_cache_max_entry_bytes", conf.serving_result_cache_max_entry_bytes))
+        rc_subsumption = bool(opt("result_cache_subsumption", conf.serving_result_cache_subsumption))
+        if rc_enabled:
+            self.result_cache = ResultCache(
+                max_bytes=rc_bytes,
+                max_entry_bytes=rc_entry_bytes,
+                subsumption=rc_subsumption,
+            )
         self.plan_cache = PlanCache(int(opt("plan_cache_max_entries", conf.serving_plan_cache_max_entries)))
         self.bucket_cache = BucketCache(
             int(opt("bucket_cache_bytes", conf.serving_bucket_cache_bytes)),
@@ -122,6 +178,8 @@ class QueryServer:
         self.admission.bind_registry(self.registry, server=self.server_name)
         self.plan_cache.bind_registry(self.registry, server=self.server_name)
         self.bucket_cache.bind_registry(self.registry, server=self.server_name)
+        if self.result_cache is not None:
+            self.result_cache.bind_registry(self.registry, server=self.server_name)
         self.tracing_enabled = bool(conf.obs_tracing_enabled)
         self._trace_max_spans = conf.obs_trace_max_spans
         self._profiles: "deque" = deque(maxlen=max(1, conf.obs_profile_history))
@@ -189,6 +247,24 @@ class QueryServer:
         if not base:
             return None
         return os.path.join(base, "_telemetry", *parts)
+
+    # -- scheduler wiring ----------------------------------------------------
+    def _sched_cost(self, item):
+        """Scheduler cost hook: the fingerprint history's learned estimate
+        for the request's structure (None without history / unseen shape)."""
+        if self.history is None:
+            return None
+        return self.history.estimate_cost(item.fp.structure)
+
+    def _sched_burn(self, tenant: str) -> float:
+        """Scheduler burn hook: the tenant's SLO burn rate over the shortest
+        configured window (the fastest-reacting signal)."""
+        if self.slo is None:
+            return 0.0
+        return self.slo.burn_rate(min(self.slo.windows_s), tenant)
+
+    def _expire_seal(self, r: "_Request") -> None:
+        self._seal(r, error="RequestTimeout")
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "QueryServer":
@@ -266,10 +342,35 @@ class QueryServer:
         with spans.attach(root):
             plan, fp = self._parse(query)
         token = session_token(self.session, enabled)
+        cost_class = "unknown"
+        if self.history is not None:
+            cost_class = classify_cost(
+                self.history.estimate_cost(fp.structure),
+                self._interactive_s, self._heavy_s, self._min_confidence,
+            )
+        brand = None
+        if self.result_cache is not None:
+            # submit-time data-version brand: index-log roster + source
+            # snapshots; None (unsignable) bypasses the cache entirely
+            brand = version_brand(self.session, plan, enabled)
         req = _Request(
             plan, fp, token, enabled, self.admission.deadline_for(timeout),
             root=root, tenant=tenant, query_text=query_text,
+            cost_class=cost_class, brand=brand,
         )
+        if brand is not None:
+            hit = self.result_cache.get(fp, brand, plan=plan)
+            if hit is not None:
+                # serve from cache without entering the queue: counts toward
+                # serving metrics and the SLO but NOT the profile history —
+                # cache hits would corrupt the cost model's latency estimates
+                req.future.set_result(hit)
+                req.future.request_root = root
+                latency = time.monotonic() - req.submitted_at
+                self.metrics.observe(latency, tenant=tenant)
+                if self.slo is not None:
+                    self.slo.record(latency, error=False, tenant=tenant)
+                return req.future
         try:
             self.admission.submit(req)  # raises AdmissionRejected on overflow
         except AdmissionRejected:
@@ -370,6 +471,14 @@ class QueryServer:
             self._process_group(group)
 
     def _process_group(self, group: List[_Request]) -> None:
+        now = time.monotonic()
+        for r in group:
+            r.dequeued_at = now
+            self.registry.histogram(
+                "hs_admission_wait_seconds",
+                "seconds a request waited in the admission queue before dispatch",
+                tenant=r.tenant, cost_class=r.cost_class, server=self.server_name,
+            ).observe(now - r.submitted_at)
         # coalesce by (token, structure); order within a key is preserved
         by_key: Dict[tuple, List[_Request]] = {}
         for r in group:
@@ -381,10 +490,7 @@ class QueryServer:
         live = []
         for r in reqs:
             if r.expired():
-                self.admission.record_timeout()
-                if not r.future.done():
-                    r.future.set_exception(RequestTimeout("deadline expired in queue"))
-                    self._seal(r, error="RequestTimeout")
+                self.admission.expire(r)  # exactly-once timeout + seal
             else:
                 live.append(r)
         if not live:
@@ -438,10 +544,7 @@ class QueryServer:
 
         for r, bound, entry in resolved:
             if r.expired():
-                self.admission.record_timeout()
-                if not r.future.done():
-                    r.future.set_exception(RequestTimeout("deadline expired before execution"))
-                    self._seal(r, error="RequestTimeout")
+                self.admission.expire(r)  # exactly-once timeout + seal
                 continue
             try:
                 with spans.attach(r.root), spans.span("execute", cat="serving"):
@@ -490,18 +593,31 @@ class QueryServer:
         else:
             batch = {c: batch[c] for c in r.fp.output_columns}
         if not r.future.done():
-            r.future.set_result(batch)
+            if self.result_cache is not None and r.brand is not None:
+                # store under the request's submit-time brand; arrays are
+                # frozen by the cache, so the live result is read-only too —
+                # a caller mutating served bytes now raises instead of
+                # silently corrupting future hits
+                self.result_cache.put(r.fp, r.brand, batch, plan=r.plan)
             rows = 0
             if batch:
                 rows = int(len(next(iter(batch.values()))))
-            self.metrics.observe(time.monotonic() - r.submitted_at, tenant=r.tenant)
-            self._seal(r, rows=rows)
+            # account BEFORE resolving the future: once query() returns, every
+            # registry series for this request is already published, so a
+            # caller may scrape /metrics immediately and see consistent state
+            try:
+                self.metrics.observe(time.monotonic() - r.submitted_at, tenant=r.tenant)
+                self._seal(r, rows=rows)
+            finally:
+                r.future.set_result(batch)
 
     def _fail(self, r: _Request, exc: BaseException) -> None:
         if not r.future.done():
-            r.future.set_exception(exc)
-            self.metrics.observe(time.monotonic() - r.submitted_at, error=True, tenant=r.tenant)
-            self._seal(r, error=type(exc).__name__)
+            try:
+                self.metrics.observe(time.monotonic() - r.submitted_at, error=True, tenant=r.tenant)
+                self._seal(r, error=type(exc).__name__)
+            finally:
+                r.future.set_exception(exc)
 
     def _seal(self, r: _Request, error: Optional[str] = None, rows: Optional[int] = None) -> None:
         """Completion hook: finish the request's span tree, publish its
@@ -511,6 +627,12 @@ class QueryServer:
         every sealed request, traced or not — the intelligence layer does not
         require span tracing."""
         latency = time.monotonic() - r.submitted_at
+        if self.sched_enabled and r.dequeued_at is not None:
+            # replace the predicted charge taken at dispatch with the actual
+            # service seconds so fair-share accounting self-corrects
+            self.admission.observe_completion(
+                r.tenant, time.monotonic() - r.dequeued_at, charged_s=r.sched_charge
+            )
         profile = None
         if r.root is not None:
             profile = build_profile(
@@ -611,6 +733,8 @@ class QueryServer:
             plan_cache=self.plan_cache if self.plan_cache_enabled else None,
             bucket_cache=self.bucket_cache,
         )
+        if self.result_cache is not None:
+            snap["resultCache"] = self.result_cache.stats()
         if emit:
             from hyperspace_tpu.telemetry.events import ServingStatsEvent, emit_event
 

@@ -199,6 +199,18 @@ class Session:
         fp = plan_fingerprint(getattr(df, "plan", df))
         return history.estimate_cost(fp.structure)
 
+    def data_version_brand(self, query):
+        """The data-version brand a served result of ``query`` (SQL string or
+        DataFrame) would be cached under: a digest of the session's ACTIVE
+        index roster + rewrite conf + every scan leaf's source snapshot
+        signature. None when any source cannot be signed. Two calls returning
+        the same brand are guaranteed to observe the same data version — the
+        invariant the serving result cache is keyed on (docs/serving.md)."""
+        from hyperspace_tpu.serving.result_cache import version_brand
+
+        df = self.sql(query) if isinstance(query, str) else query
+        return version_brand(self, getattr(df, "plan", df), bool(self.hyperspace_enabled))
+
     # --- profiling ----------------------------------------------------------
     # The reference delegates runtime profiling to the Spark UI (SURVEY.md
     # §5.1); here the XLA profiler is the equivalent surface: traces cover the

@@ -1,0 +1,461 @@
+"""SLO-aware serving tests: cost-aware scheduler, tenant fairness, predicted
+load shedding, eager queue expiry, and the version-branded result cache.
+
+The scheduler is exercised as a pure policy object with injected clocks,
+cost models, and burn-rate signals — no wall-clock sleeps, no worker threads
+— then end-to-end through QueryServer against ``collect()`` ground truth.
+The result-cache tests enforce the tentpole invariant directly: no test can
+observe a result computed from a stale data version.
+"""
+
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.obs.history import CostEstimate
+from hyperspace_tpu.serving import (
+    AdmissionController,
+    AdmissionRejected,
+    CostAwareScheduler,
+    QueryServer,
+    RequestTimeout,
+    ResultCache,
+    TokenBucket,
+    classify_cost,
+    plan_fingerprint,
+    version_brand,
+)
+from hyperspace_tpu.serving.result_cache import atoms_imply, chain_atoms
+
+pytestmark = pytest.mark.sched
+
+
+class Item:
+    """Minimal schedulable request double: tenant + optional deadline/class."""
+
+    def __init__(self, tenant="default", cost_class=None, deadline=None, dead=False):
+        self.tenant = tenant
+        if cost_class is not None:
+            self.cost_class = cost_class
+        self.deadline = deadline
+        self.future = Future()
+        self.sched_charge = 0.0
+        self._dead = dead
+
+    def expired(self):
+        return self._dead
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def simple(tmp_path):
+    n = 500
+    pq.write_table(
+        pa.table(
+            {
+                "id": np.arange(n, dtype=np.int64),
+                "name": np.array([f"n{i % 11}" for i in range(n)]),
+                "price": (np.arange(n, dtype=np.int64) * 7) % 100,
+            }
+        ),
+        str(tmp_path / "t.parquet"),
+    )
+    sess = hst.Session()
+    sess.read_parquet(str(tmp_path / "t.parquet")).create_or_replace_temp_view("t")
+    return sess
+
+
+# --- cost classification -----------------------------------------------------
+
+
+def test_classify_cost_thresholds():
+    assert classify_cost(None, 0.05, 0.5, 0.3) == "unknown"
+    low_conf = CostEstimate(latency_s=0.01, confidence=0.1, samples=2)
+    assert classify_cost(low_conf, 0.05, 0.5, 0.3) == "unknown"
+    fast = CostEstimate(latency_s=0.01, confidence=0.9, samples=50)
+    assert classify_cost(fast, 0.05, 0.5, 0.3) == "interactive"
+    mid = CostEstimate(latency_s=0.2, confidence=0.9, samples=50)
+    assert classify_cost(mid, 0.05, 0.5, 0.3) == "standard"
+    slow = CostEstimate(latency_s=2.0, confidence=0.9, samples=50)
+    assert classify_cost(slow, 0.05, 0.5, 0.3) == "heavy"
+
+
+def test_token_bucket_refill_with_injected_clock():
+    clk = FakeClock()
+    b = TokenBucket(rate=1.0, burst=2.0, clock=clk)
+    assert b.try_acquire() and b.try_acquire()
+    assert not b.try_acquire()  # burst exhausted
+    clk.t += 1.5  # refills 1.5 tokens
+    assert b.try_acquire()
+    assert not b.try_acquire()  # 0.5 < 1
+
+
+# --- scheduler: priority and fairness ---------------------------------------
+
+
+def test_interactive_dispatches_before_heavy_within_tenant():
+    sched = CostAwareScheduler(depth=16, default_timeout=None)
+    heavy = Item(cost_class="heavy")
+    inter = Item(cost_class="interactive")
+    std = Item(cost_class="standard")
+    sched.submit(heavy)
+    sched.submit(std)
+    sched.submit(inter)
+    order = [sched.take_nowait() for _ in range(3)]
+    assert order == [inter, std, heavy]
+    assert sched.take_nowait() is None
+
+
+def test_flooding_tenant_cannot_starve_light_tenant():
+    sched = CostAwareScheduler(depth=64, default_timeout=None)
+    flood = [Item(tenant="flood", cost_class="standard") for _ in range(10)]
+    light = [Item(tenant="light", cost_class="standard") for _ in range(2)]
+    for it in flood:
+        sched.submit(it)
+    for it in light:
+        sched.submit(it)
+    # simulate the worker loop: dispatch, then report actual service seconds
+    # (flood requests are 100x more expensive than light ones)
+    positions = {}
+    for i in range(12):
+        it = sched.take_nowait()
+        positions.setdefault(it.tenant, []).append(i)
+        sched.observe_completion(it.tenant, 1.0 if it.tenant == "flood" else 0.01)
+    # both light requests dispatch among the first three slots: after one
+    # flood second is consumed, the light tenant's deficit dominates
+    assert max(positions["light"]) <= 2, positions
+
+
+def test_tenant_weights_bias_dispatch_share():
+    sched = CostAwareScheduler(
+        depth=64, default_timeout=None, tenant_weights={"gold": 3.0, "free": 1.0}
+    )
+    for _ in range(8):
+        sched.submit(Item(tenant="gold", cost_class="standard"))
+        sched.submit(Item(tenant="free", cost_class="standard"))
+    gold_in_first_8 = 0
+    for _ in range(8):
+        it = sched.take_nowait()
+        if it.tenant == "gold":
+            gold_in_first_8 += 1
+        sched.observe_completion(it.tenant, 1.0)  # equal actual cost
+    # 3:1 weights => gold gets ~3/4 of the first window
+    assert gold_in_first_8 >= 5, gold_in_first_8
+
+
+def test_burn_rate_boost_and_deprioritization():
+    burns = {"x": 0.0, "y": 3.0}
+    sched = CostAwareScheduler(
+        depth=16, default_timeout=None,
+        burn_threshold=2.0, burn_factor=2.0,
+        burn_rate_fn=lambda t: burns.get(t, 0.0),
+    )
+    sched.submit(Item(tenant="x", cost_class="standard"))
+    sched.submit(Item(tenant="y", cost_class="standard"))
+    # equal consumed work: without burn, alphabetical tie-break would pick x
+    sched.observe_completion("x", 1.0)
+    sched.observe_completion("y", 1.0)
+    assert sched.take_nowait().tenant == "y"  # burning tenant boosted
+    # x hogs the most work while y burns -> x's effective weight is halved
+    sched.observe_completion("x", 1.0)
+    st_x = sched._tenants["x"]
+    st_y = sched._tenants["y"]
+    assert sched._effective_weight(st_x) == pytest.approx(0.5)
+    assert sched._effective_weight(st_y) == pytest.approx(2.0)
+
+
+def test_idle_tenant_does_not_burst_on_wake():
+    sched = CostAwareScheduler(depth=64, default_timeout=None)
+    for _ in range(4):
+        sched.submit(Item(tenant="busy", cost_class="standard"))
+    it = sched.take_nowait()
+    sched.observe_completion(it.tenant, 5.0)
+    # a brand-new tenant wakes: its consumed floor is normalized to the
+    # busiest active minimum, not zero-since-forever
+    sched.submit(Item(tenant="fresh", cost_class="standard"))
+    assert sched._tenants["fresh"].consumed >= sched._min_consumed_locked()
+
+
+# --- scheduler: load shedding ------------------------------------------------
+
+
+def test_shed_by_predicted_work_with_depth_fallback():
+    confident = CostEstimate(latency_s=1.0, confidence=0.9, samples=50)
+    sched = CostAwareScheduler(
+        depth=100, default_timeout=None, max_queued_seconds=2.5,
+        cost_fn=lambda item: confident,
+    )
+    sched.submit(Item())
+    sched.submit(Item())
+    with pytest.raises(AdmissionRejected):
+        sched.submit(Item())  # 3.0s predicted > 2.5s bound
+    assert sched.stats()["shed"] == {"predicted-work": 1}
+    assert sched.stats()["queuedWorkSeconds"] == pytest.approx(2.0)
+
+    # without a confident model the same bound degrades to depth-only
+    blind = CostAwareScheduler(depth=2, default_timeout=None, max_queued_seconds=2.5)
+    blind.submit(Item())
+    blind.submit(Item())
+    with pytest.raises(AdmissionRejected):
+        blind.submit(Item())
+    assert blind.stats()["shed"] == {"depth": 1}
+
+
+def test_tenant_rate_limit_sheds_and_refills():
+    clk = FakeClock()
+    sched = CostAwareScheduler(
+        depth=16, default_timeout=None, tenant_rate=1.0, tenant_burst=1.0, clock=clk
+    )
+    sched.submit(Item(tenant="spammer"))
+    with pytest.raises(AdmissionRejected):
+        sched.submit(Item(tenant="spammer"))
+    assert sched.stats()["shed"] == {"rate": 1}
+    clk.t += 1.0
+    sched.submit(Item(tenant="spammer"))  # bucket refilled
+    assert sched.stats()["submitted"] == 2
+
+
+# --- eager queue expiry ------------------------------------------------------
+
+
+def test_full_queue_of_expired_requests_admits_new_work():
+    adm = AdmissionController(depth=2, default_timeout=None)
+    sealed = []
+    adm.on_expired = sealed.append
+    dead = [Item(dead=True), Item(dead=True)]
+    for it in dead:
+        adm.submit(it)
+    live = Item()
+    adm.submit(live)  # sweeps the dead entries instead of rejecting
+    assert adm.stats()["timeouts"] == 2
+    assert adm.stats()["rejected"] == 0
+    assert sealed == dead
+    for it in dead:
+        with pytest.raises(RequestTimeout):
+            it.future.result(timeout=0)
+    assert adm.take_nowait() is live
+
+
+def test_expire_is_exactly_once():
+    adm = AdmissionController(depth=2, default_timeout=None)
+    sealed = []
+    adm.on_expired = sealed.append
+    it = Item(dead=True)
+    it.future = Future()
+    assert adm.expire(it) is True
+    assert adm.expire(it) is False  # future already resolved
+    assert adm.stats()["timeouts"] == 1
+    assert len(sealed) == 1
+
+
+def test_plain_items_without_futures_are_never_purged():
+    adm = AdmissionController(depth=2, default_timeout=None)
+    adm.submit("a")
+    adm.submit("b")
+    with pytest.raises(AdmissionRejected):
+        adm.submit("c")  # strings carry no deadline: queue is genuinely full
+
+
+def test_scheduler_sweeps_expired_on_submit():
+    sched = CostAwareScheduler(depth=2, default_timeout=None)
+    dead = [Item(dead=True), Item(dead=True)]
+    for it in dead:
+        sched.submit(it)
+    live = Item()
+    sched.submit(live)  # depth reached, but both queued entries are dead
+    assert sched.stats()["timeouts"] == 2
+    assert sched.take_nowait() is live
+
+
+def test_scheduler_skips_expired_at_dispatch():
+    sched = CostAwareScheduler(depth=16, default_timeout=None)
+    it = Item()
+    sched.submit(it)
+    it._dead = True  # expires while queued
+    assert sched.take_nowait() is None
+    assert sched.stats()["timeouts"] == 1
+
+
+# --- result cache: subsumption algebra ---------------------------------------
+
+
+def test_chain_atoms_extracts_conjuncts(simple):
+    plan = simple.sql("SELECT id FROM t WHERE price > 5 AND price < 90").plan
+    got = chain_atoms(plan)
+    assert got is not None
+    _, atoms = got
+    assert ("price", ">", 5) in atoms and ("price", "<", 90) in atoms
+
+
+def test_chain_atoms_rejects_unsupported_shapes(simple):
+    agg = simple.sql("SELECT name, COUNT(id) FROM t GROUP BY name").plan
+    assert chain_atoms(agg) is None
+
+
+def test_atoms_imply_directional():
+    assert atoms_imply([("p", ">", 7)], [("p", ">", 5)])
+    assert not atoms_imply([("p", ">", 3)], [("p", ">", 5)])
+    assert atoms_imply([("p", ">=", 5)], [("p", ">=", 5)])
+    assert not atoms_imply([("p", ">=", 5)], [("p", ">", 5)])
+    assert atoms_imply([("p", "<", 4)], [("p", "<=", 4)])
+    assert atoms_imply([("p", "=", 5)], [("p", ">", 4)]) is False  # conservative
+    assert atoms_imply([("p", "in", frozenset({1, 2}))], [("p", "in", frozenset({1, 2, 3}))])
+    assert not atoms_imply([("p", "in", frozenset({1, 9}))], [("p", "in", frozenset({1, 2, 3}))])
+    # extra request atoms only narrow; missing cached atoms break implication
+    assert atoms_imply([("p", ">", 7), ("q", "=", 1)], [("p", ">", 5)])
+    assert not atoms_imply([("p", ">", 7)], [("p", ">", 5), ("q", "=", 1)])
+
+
+# --- result cache: correctness -----------------------------------------------
+
+
+def test_result_cache_exact_hit_bytes_identical(simple):
+    with QueryServer(simple, workers=1, result_cache_enabled=True) as srv:
+        q = "SELECT id, price FROM t WHERE price > 50"
+        fresh = srv.query(q)
+        hit = srv.query(q)
+        assert set(fresh) == set(hit)
+        for c in fresh:
+            np.testing.assert_array_equal(fresh[c], hit[c])
+        rc = srv.stats()["resultCache"]
+        assert rc["hits"] == 1 and rc["misses"] == 1
+        # served arrays are frozen: corruption of future hits must raise
+        with pytest.raises((ValueError, RuntimeError)):
+            hit["price"][0] = -1
+
+
+def test_result_cache_subsumed_hit_matches_fresh_execution(simple):
+    with QueryServer(simple, workers=1, result_cache_enabled=True) as srv:
+        srv.query("SELECT id, price FROM t WHERE price > 50")  # cached superset
+        sub = srv.query("SELECT id, price FROM t WHERE price > 60")
+        assert srv.stats()["resultCache"]["subsumedHits"] == 1
+    with QueryServer(simple, workers=1) as srv2:
+        fresh = srv2.query("SELECT id, price FROM t WHERE price > 60")
+    order_s, order_f = np.argsort(sub["id"]), np.argsort(fresh["id"])
+    np.testing.assert_array_equal(sub["id"][order_s], fresh["id"][order_f])
+    np.testing.assert_array_equal(sub["price"][order_s], fresh["price"][order_f])
+
+
+def test_result_cache_never_serves_stale_version(simple, tmp_path):
+    q = "SELECT id, price FROM t WHERE price > 50"
+    with QueryServer(
+        simple, workers=1, result_cache_enabled=True, bucket_cache_bytes=1
+    ) as srv:
+        before = srv.query(q)
+        assert len(before["id"]) == len(srv.query(q)["id"])  # warm exact hit
+        # the source file is rewritten with different contents: a new data
+        # version the brand must observe
+        n = 40
+        path = str(tmp_path / "t.parquet")
+        pq.write_table(
+            pa.table(
+                {
+                    "id": np.arange(n, dtype=np.int64),
+                    "name": np.array(["x"] * n),
+                    "price": np.full(n, 99, dtype=np.int64),
+                }
+            ),
+            path,
+        )
+        os.utime(path, (time.time() + 10, time.time() + 10))
+        after = srv.query(q)
+        # every row of the new version matches the predicate: a stale serve
+        # would return the old 245-row result
+        assert len(after["id"]) == n
+        assert np.all(after["price"] == 99)
+        assert srv.stats()["resultCache"]["invalidations"] >= 1
+
+
+def test_version_brand_tracks_flag_and_sources(simple):
+    q = "SELECT id FROM t WHERE price > 5"
+    plan = simple.sql(q).plan
+    on = version_brand(simple, plan, True)
+    off = version_brand(simple, plan, False)
+    assert on is not None and off is not None and on != off
+    assert simple.data_version_brand(q) in (on, off)
+
+    class Unsignable:
+        def signature(self):
+            raise NotImplementedError
+
+    from hyperspace_tpu.plan import logical as L
+
+    assert version_brand(simple, L.Scan(relation=Unsignable()), True) is None
+
+
+def test_result_cache_byte_budget_evicts_lru(simple):
+    rc = ResultCache(max_bytes=4096, max_entry_bytes=4096)
+    fp = plan_fingerprint(simple.sql("SELECT id FROM t WHERE price > 5").plan)
+    big = {"id": np.arange(300, dtype=np.int64)}  # 2400 bytes
+    assert rc.put(fp, "brandA", big)
+    fp2 = plan_fingerprint(simple.sql("SELECT id FROM t WHERE price > 6").plan)
+    assert rc.put(fp2, "brandA", {"id": np.arange(300, dtype=np.int64)})
+    assert rc.stats()["evictions"] == 1  # 4800 > 4096: the older entry left
+    assert rc.put(fp, "brandA", {"id": np.arange(900, dtype=np.int64)}) is False  # over entry cap
+
+
+# --- default-off: byte-for-byte FIFO ----------------------------------------
+
+
+def test_defaults_are_plain_fifo_and_no_result_cache(simple):
+    with QueryServer(simple, workers=1) as srv:
+        assert type(srv.admission) is AdmissionController
+        assert srv.result_cache is None
+        got = srv.query("SELECT id FROM t WHERE price > 50")
+        want = simple.sql("SELECT id FROM t WHERE price > 50").collect()
+        np.testing.assert_array_equal(np.sort(got["id"]), np.sort(want["id"]))
+        assert "resultCache" not in srv.stats()
+
+
+def test_conf_keys_enable_scheduler_and_cache(tmp_path):
+    n = 50
+    pq.write_table(
+        pa.table({"id": np.arange(n, dtype=np.int64), "v": np.arange(n, dtype=np.int64)}),
+        str(tmp_path / "c.parquet"),
+    )
+    sess = hst.Session(
+        conf={
+            "hyperspace.serving.sched.enabled": "true",
+            "hyperspace.serving.resultCache.enabled": "true",
+            "hyperspace.serving.sched.tenantWeights": "gold=4,free=1",
+        }
+    )
+    sess.read_parquet(str(tmp_path / "c.parquet")).create_or_replace_temp_view("c")
+    with QueryServer(sess, workers=1) as srv:
+        assert isinstance(srv.admission, CostAwareScheduler)
+        assert srv.admission.tenant_weights == {"gold": 4.0, "free": 1.0}
+        assert srv.result_cache is not None
+        srv.query("SELECT id FROM c WHERE v > 10", tenant="gold")
+        srv.query("SELECT id FROM c WHERE v > 10", tenant="gold")
+        assert srv.stats()["resultCache"]["hits"] == 1
+        text = srv.prometheus_text()
+        assert "hs_admission_wait_seconds" in text
+        assert "hs_result_cache_hits_total" in text
+
+
+def test_sched_end_to_end_with_tenants_and_metrics(simple):
+    # result cache off: hits would bypass the queue and never register their
+    # tenant with the scheduler (the fast path is the point of the cache)
+    with QueryServer(simple, workers=2, sched_enabled=True) as srv:
+        futs = [
+            srv.submit("SELECT id, price FROM t WHERE price > 50", tenant=f"t{i % 3}")
+            for i in range(12)
+        ]
+        for f in futs:
+            assert len(f.result(timeout=30)["id"]) == 245
+        st = srv.stats()["queue"]
+        assert set(st["tenants"]) == {"t0", "t1", "t2"}
+        assert st["timeouts"] == 0 and st["rejected"] == 0
