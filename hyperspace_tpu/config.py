@@ -45,6 +45,14 @@ class keys:
     TPU_JOIN_DEVICE_MATERIALIZE = "hyperspace.tpu.join.deviceMaterialize"
     TPU_JOIN_DEVICE_MATERIALIZE_MAX_BYTES = "hyperspace.tpu.join.deviceMaterializeMaxBytes"
     TPU_JOIN_DEVICE_SPAN_MAX_BYTES = "hyperspace.tpu.join.deviceSpanMaxBytes"
+    # Mesh-sharded execution (hyperspace_tpu/parallel/): shard_map scans and
+    # collective-merged grouped aggregates over a 1-D ("buckets",) mesh, and
+    # the distributed index-build exchange. Default-off: with the master
+    # switch false every path compiles the single-logical-device programs.
+    PARALLEL_ENABLED = "hyperspace.parallel.enabled"
+    PARALLEL_MESH_DEVICES = "hyperspace.parallel.mesh.devices"
+    PARALLEL_MIN_ROWS = "hyperspace.parallel.minRows"
+    PARALLEL_BUILD_ENABLED = "hyperspace.parallel.build.enabled"
     # Out-of-core execution (round-5): thresholds routing large operators
     # onto the streaming paths so no operator materializes a full table
     # (the reference inherits this from Spark's streaming executors).
@@ -161,6 +169,21 @@ DEFAULTS: Dict[str, Any] = {
     # multi-device mesh; single-device meshes always use the fused one-chip
     # program regardless.
     keys.TPU_BUILD_DISTRIBUTED_MIN_ROWS: 0,
+    # Mesh-sharded execution master switch. Off by default: behavior is
+    # byte-identical to the single-device programs, and turning it on only
+    # changes WHERE the same math runs (per-shard via shard_map, partials
+    # merged with collectives). Requires a >1-device runtime to take effect.
+    keys.PARALLEL_ENABLED: False,
+    # 0 = span the whole local runtime; N > 0 = shard over the first N
+    # devices (must not oversubscribe — make_mesh raises).
+    keys.PARALLEL_MESH_DEVICES: 0,
+    # Below this many rows a chunk is not worth sharding: per-shard padding
+    # and the collective merge dominate. Gates the query-side sharded paths
+    # only; the build gate stays hyperspace.tpu.build.distributedMinRows.
+    keys.PARALLEL_MIN_ROWS: 1 << 16,
+    # Subordinate switch for the distributed index build (bucketize -> one
+    # all_to_all -> per-device sort); only consulted when parallel.enabled.
+    keys.PARALLEL_BUILD_ENABLED: True,
     keys.TPU_QUERY_DEVICE_EXECUTION: True,
     # Below this many rows a host<->device round trip costs more than the
     # compute it offloads; the executor keeps small batches on host. Tune to 0
@@ -456,6 +479,22 @@ class HyperspaceConf:
     @property
     def distributed_build_min_rows(self) -> int:
         return int(self.get(keys.TPU_BUILD_DISTRIBUTED_MIN_ROWS))
+
+    @property
+    def parallel_enabled(self) -> bool:
+        return bool(self.get(keys.PARALLEL_ENABLED))
+
+    @property
+    def parallel_mesh_devices(self) -> int:
+        return int(self.get(keys.PARALLEL_MESH_DEVICES))
+
+    @property
+    def parallel_min_rows(self) -> int:
+        return int(self.get(keys.PARALLEL_MIN_ROWS))
+
+    @property
+    def parallel_build_enabled(self) -> bool:
+        return bool(self.get(keys.PARALLEL_BUILD_ENABLED))
 
     @property
     def device_execution_enabled(self) -> bool:

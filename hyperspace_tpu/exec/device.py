@@ -581,6 +581,22 @@ def _cached_predicate_jit(skeleton: str, fn):
     return jitted
 
 
+def _mesh_fp(mesh) -> str:
+    from hyperspace_tpu.parallel.mesh import mesh_fingerprint
+
+    return mesh_fingerprint(mesh)
+
+
+def _program_key(skeleton: str, mesh, sharded: bool = False) -> str:
+    """Program-cache key: (program skeleton, mesh fingerprint, execution
+    mode). The shape bucket is the jit cache's own shape signature, so the
+    full identity is (skeleton, shape bucket, mesh fingerprint) — one cache
+    serves the single-device (GSPMD jit) and sharded (shard_map) paths
+    without executables ever aliasing across meshes or modes."""
+    mode = "shmap" if sharded else "spmd"
+    return f"{skeleton}@{_mesh_fp(mesh)}/{mode}"
+
+
 def _dry_codecs(batch: B.Batch, refs) -> Dict[str, ColumnCodec]:
     """Dtype-kind-only codecs for the pre-transfer support check (string
     bounds resolve to 0; values are discarded)."""
@@ -598,14 +614,16 @@ def _dry_codecs(batch: B.Batch, refs) -> Dict[str, ColumnCodec]:
     return out
 
 
-def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) -> np.ndarray:
+def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None, parallel=None) -> np.ndarray:
     """Evaluate ``condition`` on device over the referenced columns of
     ``batch``; returns the host bool mask. Raises DeviceUnsupported when the
     predicate is outside the device language.
 
     ``scan_key`` identifies an immutable file set (IndexScan bucket files);
     when given, encoded predicate columns are kept resident on device across
-    queries."""
+    queries. ``parallel`` (a ``ShardedExecutor``) switches compilation from
+    GSPMD jit to an explicit shard_map over the executor's mesh; the device
+    cache is shared between the two modes (same fingerprint, same layout)."""
     ensure_x64()
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -618,16 +636,17 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
     if n == 0:
         return np.zeros(0, dtype=bool)
 
-    mesh = session.mesh
+    mesh = parallel.mesh if parallel is not None else session.mesh
     n_dev = mesh.devices.size
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
+    fp = _mesh_fp(mesh)  # device-cache key part shared by both modes
 
     dev_cols: Dict[str, "jax.Array"] = {}
     codecs: Dict[str, ColumnCodec] = {}
     missing: List[str] = []
     for r in refs:
-        ckey = (scan_key, r, n_dev) if scan_key is not None else None
+        ckey = (scan_key, r, fp) if scan_key is not None else None
         cached = _device_cache_get(ckey) if ckey is not None else None
         if cached is not None and cached[2] == n:
             dev_cols[r], codecs[r] = cached[0], cached[1]
@@ -647,17 +666,23 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None) 
             dev_cols[r] = dev
             codecs[r] = codec
             if scan_key is not None:
-                _device_cache_put((scan_key, r, n_dev), (dev, codec, n), int(padded.nbytes))
+                _device_cache_put((scan_key, r, fp), (dev, codec, n), int(padded.nbytes))
 
     fn, lit_values = compile_predicate(condition, codecs)
     skeleton = predicate_skeleton(condition, codecs)
-    jitted = _cached_predicate_jit(skeleton, fn)
-    _note_compile(skeleton, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
+    if parallel is not None:
+        from hyperspace_tpu.parallel import collectives as _collectives
+
+        fn = _collectives.sharded_elementwise(mesh, axis, fn)
+        parallel.note_op("filter")
+    key = _program_key(skeleton, mesh, sharded=parallel is not None)
+    jitted = _cached_predicate_jit(key, fn)
+    _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
     mask = jitted(dev_cols, lit_values)
     return np.asarray(mask)[:n]
 
 
-def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], scan_key, extra_columns=None) -> None:
+def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], scan_key, extra_columns=None, parallel=None) -> None:
     """H2D staging hook for the scan pipeline (stage 2 of 3): encode,
     bucket-pad and ``device_put`` ``condition``'s columns into the device
     cache on the prefetch thread, so the consumer's ``device_filter_mask``
@@ -684,12 +709,13 @@ def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], sca
 
         if condition is not None:
             compile_predicate(condition, _dry_codecs(batch, refs))
-        mesh = session.mesh
+        mesh = parallel.mesh if parallel is not None else session.mesh
         n_dev = mesh.devices.size
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        fp = _mesh_fp(mesh)
         with obs_spans.span("h2d-stage", cat="pipeline", rows=n):
             for r in cols:
-                ckey = (scan_key, r, n_dev)
+                ckey = (scan_key, r, fp)
                 cached = _device_cache_get(ckey)
                 if cached is not None and cached[2] == n:
                     continue
@@ -751,6 +777,7 @@ def device_filtered_aggregate(
     n_dev = mesh.devices.size
     axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
+    fp = _mesh_fp(mesh)
 
     # dry-check the predicate before any upload
     if condition is not None:
@@ -759,7 +786,7 @@ def device_filtered_aggregate(
     dev_cols: Dict[str, "jax.Array"] = {}
     codecs: Dict[str, ColumnCodec] = {}
     for r in sorted(set(refs) | set(agg_inputs)):
-        ckey = (scan_key, r, n_dev) if scan_key is not None else None
+        ckey = (scan_key, r, fp) if scan_key is not None else None
         cached = _device_cache_get(ckey) if ckey is not None else None
         if cached is not None and cached[2] == n:
             dev_cols[r], codecs[r] = cached[0], cached[1]
@@ -823,8 +850,9 @@ def device_filtered_aggregate(
                     outs.append(jnp.where(m, x.astype(jnp.float64), -jnp.inf).max())
         return tuple(outs), tuple(valids)
 
-    jitted = _cached_predicate_jit(skeleton, program)
-    _note_compile(skeleton, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
+    key = _program_key(skeleton, mesh)
+    jitted = _cached_predicate_jit(key, program)
+    _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
     outs, valids = jitted(dev_cols, lit_values, np.int64(n))
     outs = [np.asarray(o) for o in outs]
     valids = [int(v) for v in valids]
@@ -1020,49 +1048,64 @@ def _grouped_chunk_program(pred_fn, key_specs, slot_specs, cap):
     return program
 
 
-def _grouped_merge_program(key_specs, slot_specs, cap_in, cap_out):
-    """Merge two partial-aggregate tables (each padded to ``cap_in`` rows) on
-    device: concatenate, re-rank-compress the keys, and segment-reduce the
-    states with each slot's merge op (cnt/sum/sumsq add, min/max fold)."""
+def _merge_concat_parts(key_specs, slot_specs, cap_out, kcat, slots_cat, fs_cat, mask):
+    """Merge CONCATENATED partial-aggregate parts on device — the core shared
+    by the pairwise chunk merge (``_grouped_merge_program``) and the sharded
+    all-gather merge (parallel/collectives.py): re-rank-compress the keys and
+    segment-reduce the states with each slot's merge op (cnt/sum/sumsq add,
+    min/max fold).
+
+    Contract: parts must be concatenated in ascending global-row-range order,
+    so a group's minimum concat position is a row from the part where it first
+    appeared — the key representatives gathered from it match what a single
+    sequential pass would have produced."""
     import jax.numpy as jnp
     from jax import ops as jops
 
+    total = mask.shape[0]
+    codes = [_key_code(k, tag) for k, (_, tag) in zip(kcat, key_specs)]
+    order, ms, n_groups, segs = _segment_ids(codes, mask, cap_out)
+    rep = jops.segment_min(
+        jnp.where(ms, order.astype(jnp.int64), jnp.int64(total)),
+        segs, num_segments=cap_out, indices_are_sorted=True,
+    )
+    repc = jnp.clip(rep, 0, total - 1)
+    key_out = tuple(k[repc] for k in kcat)
+    # values fed to the segment ops must follow the SORTED row order that
+    # ``segs`` is defined over (the keys above gather by concat position
+    # instead, so they stay unsorted)
+    fs = jops.segment_min(
+        jnp.where(ms, fs_cat[order], _FS_SENTINEL), segs,
+        num_segments=cap_out, indices_are_sorted=True,
+    )
+    slot_out = []
+    for (kind, _, _), v in zip(slot_specs, slots_cat):
+        v = v[order]
+        if kind in ("cntm", "cnt", "sum", "sumsq"):
+            slot_out.append(jops.segment_sum(jnp.where(ms, v, v.dtype.type(0)), segs, num_segments=cap_out, indices_are_sorted=True))
+        elif kind == "min":
+            big = jnp.iinfo(jnp.int64).max if jnp.issubdtype(v.dtype, jnp.integer) else jnp.inf
+            slot_out.append(jops.segment_min(jnp.where(ms, v, big), segs, num_segments=cap_out, indices_are_sorted=True))
+        else:  # max
+            low = jnp.iinfo(jnp.int64).min if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf
+            slot_out.append(jops.segment_max(jnp.where(ms, v, low), segs, num_segments=cap_out, indices_are_sorted=True))
+    return n_groups, fs, key_out, tuple(slot_out)
+
+
+def _grouped_merge_program(key_specs, slot_specs, cap_in, cap_out):
+    """Merge two partial-aggregate tables (each padded to ``cap_in`` rows) on
+    device. The running partial occupies the first concat half and its groups
+    were first seen no later than the incoming chunk's (row bases ascend), so
+    the concat satisfies ``_merge_concat_parts``'s ordering contract."""
+    import jax.numpy as jnp
+
     def program(keys_a, keys_b, slots_a, slots_b, fs_a, fs_b, n_a, n_b):
-        two = 2 * cap_in
         idx = jnp.arange(cap_in)
         mask = jnp.concatenate([idx < n_a, idx < n_b])
-        kcat = [jnp.concatenate([a, b]) for a, b in zip(keys_a, keys_b)]
-        codes = [_key_code(k, tag) for k, (_, tag) in zip(kcat, key_specs)]
-        order, ms, n_groups, segs = _segment_ids(codes, mask, cap_out)
-        # the running partial occupies the first half, and its groups were
-        # first seen no later than the incoming chunk's (row bases ascend),
-        # so min concat position == min first-seen representative
-        rep = jops.segment_min(
-            jnp.where(ms, order.astype(jnp.int64), jnp.int64(two)),
-            segs, num_segments=cap_out, indices_are_sorted=True,
-        )
-        repc = jnp.clip(rep, 0, two - 1)
-        key_out = tuple(k[repc] for k in kcat)
-        # values fed to the segment ops must follow the SORTED row order that
-        # ``segs`` is defined over (the keys above gather by concat position
-        # instead, so they stay unsorted)
-        fscat = jnp.concatenate([fs_a, fs_b])[order]
-        fs = jops.segment_min(
-            jnp.where(ms, fscat, _FS_SENTINEL), segs,
-            num_segments=cap_out, indices_are_sorted=True,
-        )
-        slot_out = []
-        for (kind, _, _), va, vb in zip(slot_specs, slots_a, slots_b):
-            v = jnp.concatenate([va, vb])[order]
-            if kind in ("cntm", "cnt", "sum", "sumsq"):
-                slot_out.append(jops.segment_sum(jnp.where(ms, v, v.dtype.type(0)), segs, num_segments=cap_out, indices_are_sorted=True))
-            elif kind == "min":
-                big = jnp.iinfo(jnp.int64).max if jnp.issubdtype(v.dtype, jnp.integer) else jnp.inf
-                slot_out.append(jops.segment_min(jnp.where(ms, v, big), segs, num_segments=cap_out, indices_are_sorted=True))
-            else:  # max
-                low = jnp.iinfo(jnp.int64).min if jnp.issubdtype(v.dtype, jnp.integer) else -jnp.inf
-                slot_out.append(jops.segment_max(jnp.where(ms, v, low), segs, num_segments=cap_out, indices_are_sorted=True))
-        return n_groups, fs, key_out, tuple(slot_out)
+        kcat = tuple(jnp.concatenate([a, b]) for a, b in zip(keys_a, keys_b))
+        slots_cat = tuple(jnp.concatenate([va, vb]) for va, vb in zip(slots_a, slots_b))
+        fs_cat = jnp.concatenate([fs_a, fs_b])
+        return _merge_concat_parts(key_specs, slot_specs, cap_out, kcat, slots_cat, fs_cat, mask)
 
     return program
 
@@ -1097,11 +1140,16 @@ class GroupedAggStream:
     """
 
     def __init__(
-        self, session, group_keys, aggs, *, max_groups: int, cap_floor: int, hint_key=None
+        self, session, group_keys, aggs, *, max_groups: int, cap_floor: int, hint_key=None,
+        parallel=None,
     ):
         if not group_keys:
             raise DeviceUnsupported("global aggregates take the fused-scalar path")
         self.session = session
+        # a ShardedExecutor switches the chunk program from GSPMD jit to an
+        # explicit shard_map whose per-shard partials merge on-device via
+        # all-gather (parallel/collectives.py) instead of the host loop
+        self._parallel = parallel
         self.group_keys = list(group_keys)
         self.aggs = [(name, fn, c) for name, fn, c in aggs]
         self.max_groups = int(max_groups)
@@ -1188,13 +1236,14 @@ class GroupedAggStream:
         if condition is not None:
             compile_predicate(condition, _dry_codecs(batch, refs))
 
-        mesh = self.session.mesh
+        mesh = self._parallel.mesh if self._parallel is not None else self.session.mesh
         n_dev = mesh.devices.size
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        fp = _mesh_fp(mesh)
         dev_cols: Dict[str, "jax.Array"] = {}
         codecs: Dict[str, ColumnCodec] = {}
         for col in sorted(set(refs) | set(agg_inputs) | set(self.group_keys)):
-            ckey = (scan_key, col, n_dev) if scan_key is not None else None
+            ckey = (scan_key, col, fp) if scan_key is not None else None
             cached = _device_cache_get(ckey) if ckey is not None else None
             if cached is not None and cached[2] == n:
                 dev_cols[col], codecs[col] = cached[0], cached[1]
@@ -1229,14 +1278,28 @@ class GroupedAggStream:
 
         cap = group_capacity(max(self._cap_hint, 1), self.cap_floor)
         shapes = tuple(dev_cols[r].shape for r in sorted(dev_cols))
+        sharded = self._parallel is not None
         while True:
-            skeleton = f"gagg[{cap}]:{base_sk}"
-            program = _grouped_chunk_program(pred_fn, key_specs, self._slots, cap)
-            jitted = _cached_predicate_jit(skeleton, program)
-            _note_compile(skeleton, shapes)
-            n_g_dev, fs, key_out, slot_out = jitted(
-                dev_cols, lit_values, np.int64(n), np.int64(self._row_base)
-            )
+            if sharded:
+                from hyperspace_tpu.parallel import collectives as _collectives
+
+                program = _collectives.sharded_grouped_chunk_program(
+                    mesh, mesh.axis_names[0], pred_fn, key_specs, self._slots, cap
+                )
+            else:
+                program = _grouped_chunk_program(pred_fn, key_specs, self._slots, cap)
+            key = _program_key(f"gagg[{cap}]:{base_sk}", mesh, sharded=sharded)
+            jitted = _cached_predicate_jit(key, program)
+            _note_compile(key, shapes)
+            if sharded:
+                n_g_dev, fs, key_out, slot_out = self._parallel.timed_call(
+                    "grouped-agg", jitted,
+                    dev_cols, lit_values, np.int64(n), np.int64(self._row_base),
+                )
+            else:
+                n_g_dev, fs, key_out, slot_out = jitted(
+                    dev_cols, lit_values, np.int64(n), np.int64(self._row_base)
+                )
             n_g = int(n_g_dev)
             if n_g > self.max_groups:
                 exc = GroupCapacityExceeded(
@@ -1299,13 +1362,15 @@ class GroupedAggStream:
                 part["keys"] = [_dev_pad(k, cap_in, 0 if k.dtype != np.float64 else np.nan) for k in part["keys"]]
                 part["slots"] = [_dev_pad(s, cap_in, 0) for s in part["slots"]]
         cap_out = group_capacity(a["n"] + b["n"], self.cap_floor)
+        mesh = self._parallel.mesh if self._parallel is not None else self.session.mesh
         skeleton = (
             f"gaggmerge[{cap_in}->{cap_out}]:k:{','.join(t for _, t in key_specs)}"
             f"|s:{','.join(f'{k}:{int(i)}' for k, _, i in self._slots)}"
         )
+        key = _program_key(skeleton, mesh)
         program = _grouped_merge_program(key_specs, self._slots, cap_in, cap_out)
-        jitted = _cached_predicate_jit(skeleton, program)
-        _note_compile(skeleton, (cap_in, cap_out))
+        jitted = _cached_predicate_jit(key, program)
+        _note_compile(key, (cap_in, cap_out))
         t0 = _time.perf_counter()
         with obs_spans.span("agg-merge", cat="groupagg", groups_in=a["n"] + b["n"]):
             n_g_dev, fs, key_out, slot_out = jitted(
@@ -1468,6 +1533,7 @@ def device_grouped_aggregate(
     *,
     max_groups: int,
     cap_floor: int,
+    parallel=None,
 ) -> B.Batch:
     """One-shot fused filter -> grouped aggregate over a materialized scan
     batch (the non-streamed `_exec_aggregate` path). Raises DeviceUnsupported
@@ -1481,6 +1547,7 @@ def device_grouped_aggregate(
         max_groups=max_groups,
         cap_floor=cap_floor,
         hint_key=scan_key,
+        parallel=parallel,
     )
     stream.update(batch, condition, scan_key=scan_key)
     return stream.finalize()

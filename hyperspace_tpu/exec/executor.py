@@ -47,6 +47,20 @@ def _scan_identity(scan):
     return tuple(parts)
 
 
+def _maybe_parallel(session, n_rows: Optional[int] = None):
+    """The session's ``ShardedExecutor`` when ``hyperspace.parallel.enabled``
+    is on (and, when a row count is known, the chunk clears
+    ``hyperspace.parallel.minRows``); None routes to the single-device path."""
+    if not session.conf.parallel_enabled:
+        return None
+    from hyperspace_tpu.parallel.executor import ShardedExecutor
+
+    px = ShardedExecutor.maybe(session)
+    if px is not None and n_rows is not None and not px.rows_ok(n_rows):
+        return None
+    return px
+
+
 def _plan_needs_file_names(plan: L.LogicalPlan) -> bool:
     def expr_has(e: Expr) -> bool:
         if isinstance(e, InputFileName):
@@ -604,6 +618,7 @@ def aggregate_batch(session, keys, aggs, batch: B.Batch) -> B.Batch:
                     scan_key=None,
                     max_groups=conf.agg_max_groups,
                     cap_floor=conf.agg_capacity_floor,
+                    parallel=_maybe_parallel(session, B.num_rows(batch)),
                 )
                 trace.record("agg", "device-grouped-batch")
                 return got
@@ -789,8 +804,12 @@ class Executor:
             if B.num_rows(batch) < conf.device_exec_min_rows:
                 return
             key = _pruned_scan_key(_scan_identity(leaves[i]), pushed)
+            # stage onto the mesh the consumer will execute over, so the
+            # sharded path's device-cache lookups (keyed by mesh fingerprint)
+            # hit the columns placed here
             D.stage_filter_columns(
-                self.session, batch, dev_cond, key, extra_columns=stage_extra
+                self.session, batch, dev_cond, key, extra_columns=stage_extra,
+                parallel=_maybe_parallel(self.session, B.num_rows(batch)),
             )
 
         def weigh(batch):
@@ -1126,14 +1145,16 @@ class Executor:
             if B.num_rows(child) >= self.session.conf.device_exec_min_rows:
                 from hyperspace_tpu.exec import device as D
 
+                px = _maybe_parallel(self.session, B.num_rows(child))
                 try:
                     mask = D.device_filter_mask(
                         self.session,
                         child,
                         plan.condition,
                         scan_key=_pruned_scan_key(_scan_identity(plan.child), pruned_by),
+                        parallel=px,
                     )
-                    trace.record("filter", "device")
+                    trace.record("filter", "device-sharded" if px is not None else "device")
                     return mask
                 except D.DeviceUnsupported:
                     trace.record("filter", "host-fallback")
@@ -1380,6 +1401,9 @@ class Executor:
                     # query shape over the same file set (skips the first
                     # chunk's right-sizing re-run once cardinality is known)
                     hint_key=("stream",) + tuple(_leaf_files(leaf)),
+                    # per-stream mode decision (chunk sizes aren't known yet):
+                    # minRows gates the one-shot ops, not stream chunks
+                    parallel=_maybe_parallel(self.session),
                 )
 
         # chunks arrive through the prefetch pipeline: chunk k+1 decodes (and
@@ -1425,7 +1449,12 @@ class Executor:
                 finally:
                     self._leaf_override = prev
             if device_ok and stream.has_data:
-                trace.record("agg", "device-grouped-stream")
+                trace.record(
+                    "agg",
+                    "device-grouped-stream-sharded"
+                    if getattr(stream, "_parallel", None) is not None
+                    else "device-grouped-stream",
+                )
                 return stream.finalize()
 
         if grouped:
@@ -1546,6 +1575,7 @@ class Executor:
                     scan_key=scan_key,
                     max_groups=conf.agg_max_groups,
                     cap_floor=conf.agg_capacity_floor,
+                    parallel=_maybe_parallel(self.session, B.num_rows(batch)),
                 )
             else:
                 got = D.device_filtered_aggregate(

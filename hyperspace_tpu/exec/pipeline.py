@@ -7,7 +7,11 @@ Stage model (the classic accelerator input pipeline):
   2. **H2D staging** — an optional ``stage`` hook runs right after decode on
      the same worker, typically ``device.stage_filter_columns``: encode, pad
      to a shape bucket, and ``jax.device_put`` the chunk's filter columns so
-     the device cache is warm before the consumer asks;
+     the device cache is warm before the consumer asks. When the mesh-sharded
+     path is on (``hyperspace.parallel.enabled``) the hook places columns
+     with the executor mesh's ``NamedSharding`` and brands the cache entries
+     with its fingerprint, so the consumer's shard_map programs hit the same
+     staged columns;
   3. **device compute** — the consumer thread executes chunk k's jitted
      program while stages 1–2 of chunk k+1 proceed concurrently.
 

@@ -451,10 +451,16 @@ def write_bucketed(
         m = session.mesh
         # streaming callers pass the true total (``table`` is only the first
         # chunk there); distributedMinRows gates on the BUILD size, not the
-        # chunk size
-        if m.devices.size > 1 and (
-            _total_rows if _total_rows is not None else n
-        ) >= session.conf.distributed_build_min_rows:
+        # chunk size. The whole distributed build sits behind the default-off
+        # hyperspace.parallel.* master switch: off means the byte-identical
+        # single-logical-device build below.
+        if (
+            session.conf.parallel_enabled
+            and session.conf.parallel_build_enabled
+            and m.devices.size > 1
+            and (_total_rows if _total_rows is not None else n)
+            >= session.conf.distributed_build_min_rows
+        ):
             mesh = m
             capacity_factor = session.conf.rebucket_capacity_factor
 

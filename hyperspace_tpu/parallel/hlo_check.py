@@ -60,3 +60,27 @@ def assert_collectives(hlo_text: str, expect: Dict[str, int], context: str = "")
             f"{context or 'program'}: expected {want} x {op} in compiled HLO, "
             f"found {got[op]} (all counts: {got})"
         )
+
+
+# ops that move row data between devices: their absence is the reference's
+# shuffle-freedom claim (ref: JoinIndexRule.scala:604-618). all-reduce stays
+# out of this set — a scalar reduction is not a data shuffle.
+SHUFFLE_OPS = ("all-to-all", "all-gather", "collective-permute", "reduce-scatter")
+
+
+def assert_shuffle_free(hlo_text: str, context: str = "") -> None:
+    """Assert the compiled program exchanges NO row data between devices
+    (no all-to-all / all-gather / collective-permute / reduce-scatter)."""
+    got = collective_counts(hlo_text)
+    bad = {op: got[op] for op in SHUFFLE_OPS if got[op]}
+    assert not bad, (
+        f"{context or 'program'}: expected a shuffle-free program but the "
+        f"compiled HLO contains data-movement collectives {bad} "
+        f"(all counts: {got})"
+    )
+
+
+def hlo_text_of(jitted, *args, **kwargs) -> str:
+    """Compiled HLO text of a jitted callable for the given example
+    arguments — the artifact the assertions above inspect."""
+    return jitted.lower(*args, **kwargs).compile().as_text()

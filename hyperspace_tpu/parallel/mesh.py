@@ -30,10 +30,35 @@ def get_shard_map():
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = DEFAULT_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` local devices (all by default).
+
+    Raises ``ValueError`` when the request oversubscribes the runtime — a
+    silently truncated mesh would shard programs across fewer devices than
+    the caller planned capacity for."""
     devices = jax.devices()
     if n_devices is not None:
+        if n_devices < 1:
+            raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested a {n_devices}-device mesh but only {len(devices)} "
+                f"devices are available ({devices[0].platform}); on CPU, raise the "
+                "count with XLA_FLAGS=--xla_force_host_platform_device_count=N"
+            )
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def mesh_fingerprint(mesh: Mesh) -> str:
+    """Stable identity of a mesh for program-cache keys: platform, device
+    grid shape, and axis names. Two meshes with the same fingerprint compile
+    to interchangeable executables (same partitioning), so single-device and
+    sharded paths can share one skeleton cache keyed on
+    ``(program skeleton, shape bucket, mesh fingerprint)``."""
+    first = next(iter(mesh.devices.flat), None)
+    platform = getattr(first, "platform", "none")
+    shape = "x".join(str(s) for s in mesh.devices.shape)
+    return f"{platform}:{shape}:{','.join(mesh.axis_names)}"
 
 
 def device_of_bucket(bucket: int, n_devices: int) -> int:

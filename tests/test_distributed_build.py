@@ -83,7 +83,13 @@ def data(tmp_path):
 def _fresh_session(tmp_path, tag, num_buckets=16, **conf):
     sysp = tmp_path / f"idx_{tag}"
     sysp.mkdir()
-    merged = {hst.keys.SYSTEM_PATH: str(sysp), hst.keys.NUM_BUCKETS: num_buckets}
+    merged = {
+        hst.keys.SYSTEM_PATH: str(sysp),
+        hst.keys.NUM_BUCKETS: num_buckets,
+        # the distributed build sits behind the default-off parallel master
+        # switch; these tests exist to exercise the mesh path, so opt in
+        hst.keys.PARALLEL_ENABLED: True,
+    }
     merged.update(conf)
     return hst.Session(conf=merged)
 

@@ -1,0 +1,325 @@
+"""Sharded ≡ single-device oracle tests for the mesh-sharded execution engine.
+
+``hyperspace.parallel.enabled`` switches the fused filter and grouped-agg
+programs from GSPMD jit to explicit shard_map over an 8-way emulated host
+mesh (conftest.py forces ``--xla_force_host_platform_device_count=8``). The
+invariant these tests pin: the sharded path is BYTE-IDENTICAL to the
+single-device path wherever the math is order-independent (bool masks, int
+counts/sums/min/max, keys, exactly-representable float sums), and within
+1e-9 where cross-shard summation order legitimately differs (messy floats —
+same bar the single-device groupagg oracle uses).
+
+Also covered: the default-off conf gate, the distributed index-build gate,
+``make_mesh``/``make_mesh_2d`` error paths, and mesh fingerprints.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.exec import trace
+
+pytestmark = pytest.mark.mesh
+
+FLOAT_RTOL = 1e-9
+
+N = 24_000  # rows; large enough that 8-way shards stay non-trivial
+
+
+@pytest.fixture()
+def dataset(tmp_path):
+    """q1-shaped data with an exact-float twist: ``price4`` holds quarter
+    units (k/4 — dyadic rationals whose sums are exact in float64 regardless
+    of order, so sharded sums must match byte-for-byte), ``messy`` holds
+    arbitrary uniforms (tolerance only), and ``fkey`` is a float group key
+    with NULLs (NaN keys form one group)."""
+    d = tmp_path / "mesh_src"
+    d.mkdir()
+    rng = np.random.default_rng(7)
+    rf = rng.choice(["A", "N", "R"], N).astype(object)
+    ls = rng.choice(["O", "F"], N).astype(object)
+    rf[5] = None
+    rf[777] = None
+    qty = rng.integers(1, 51, N).astype(np.int64)
+    price4 = rng.integers(0, 400_000, N).astype(np.float64) / 4.0
+    messy = rng.uniform(900.0, 105_000.0, N)
+    messy[rng.choice(N, 200, replace=False)] = np.nan
+    fkey = rng.integers(0, 5, N).astype(np.float64)
+    fkey[rng.choice(N, 300, replace=False)] = np.nan
+    ship = rng.integers(0, 2500, N).astype(np.int64)
+    per = N // 4
+    for i in range(4):
+        sl = slice(i * per, (i + 1) * per)
+        pq.write_table(
+            pa.table(
+                {
+                    "rf": rf[sl], "ls": ls[sl], "qty": qty[sl],
+                    "price4": price4[sl], "messy": messy[sl],
+                    "fkey": fkey[sl], "ship": ship[sl],
+                }
+            ),
+            d / f"p{i}.parquet",
+        )
+    return str(d)
+
+
+def _session(tmp_path, tag, **conf):
+    sysp = tmp_path / f"sys_{tag}"
+    sysp.mkdir(exist_ok=True)
+    merged = {
+        hst.keys.SYSTEM_PATH: str(sysp),
+        hst.keys.TPU_QUERY_DEVICE_MIN_ROWS: 0,
+        hst.keys.PARALLEL_MIN_ROWS: 0,
+    }
+    merged.update(conf)
+    return hst.Session(conf=merged)
+
+
+def _prepared(tmp_path, dataset, tag, index=None, **conf):
+    """Session + dataframe; with ``index=(indexed, included)`` a covering
+    index is built and hyperspace enabled, so filters land on an IndexScan
+    (the plan shape the device filter/grouped-agg paths require)."""
+    s = _session(tmp_path, tag, **conf)
+    df = s.read_parquet(dataset)
+    if index is not None:
+        indexed, included = index
+        hst.Hyperspace(s).create_index(
+            df, hst.CoveringIndexConfig(f"mIdx_{tag}", list(indexed), list(included))
+        )
+        s.enable_hyperspace()
+    return s, df
+
+
+def _collect_modes(tmp_path, dataset, make_query, index=None, **conf):
+    """(sharded result, single-device result, sharded trace summary)."""
+    _, df_on = _prepared(
+        tmp_path, dataset, "on", index=index,
+        **{hst.keys.PARALLEL_ENABLED: True, **conf},
+    )
+    with trace.recording() as events:
+        got = make_query(df_on).collect()
+    _, df_off = _prepared(tmp_path, dataset, "off", index=index, **conf)
+    want = make_query(df_off).collect()
+    return got, want, trace.summarize(events)
+
+
+def assert_tables_equal(got, want, float_cols=()):
+    assert sorted(got.keys()) == sorted(want.keys())
+    for k in got:
+        a, b = np.asarray(got[k]), np.asarray(want[k])
+        assert a.shape == b.shape, k
+        if k in float_cols:
+            np.testing.assert_allclose(a, b, rtol=FLOAT_RTOL, equal_nan=True, err_msg=k)
+        elif a.dtype == object or b.dtype == object:
+            assert all(
+                (not isinstance(x, str) and not isinstance(y, str)) or x == y
+                for x, y in zip(a, b)
+            ), k
+        else:
+            assert a.dtype == b.dtype, k
+            np.testing.assert_array_equal(a, b, err_msg=k)
+
+
+class TestShardedFilterScan:
+    def test_filter_scan_byte_identical(self, tmp_path, dataset):
+        got, want, summary = _collect_modes(
+            tmp_path, dataset,
+            lambda df: df.filter(hst.col("ship") <= 1200).select("qty", "price4"),
+            index=(["ship"], ["qty", "price4"]),
+        )
+        assert_tables_equal(got, want)
+        assert "filter: device-sharded" in summary, summary
+
+    def test_filter_metrics_attributed(self, tmp_path, dataset):
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        before = REGISTRY.counter(
+            "hs_mesh_sharded_ops_total", op="filter"
+        ).value
+        got, want, _ = _collect_modes(
+            tmp_path, dataset,
+            lambda df: df.filter(hst.col("qty") > 25).select("ship"),
+            index=(["qty"], ["ship"]),
+        )
+        assert_tables_equal(got, want)
+        after = REGISTRY.counter("hs_mesh_sharded_ops_total", op="filter").value
+        assert after > before
+
+
+class TestShardedGroupedAgg:
+    def q1(self, df):
+        return (
+            df.filter(hst.col("ship") <= 2400)
+            .group_by("rf", "ls")
+            .agg(
+                sum_qty=("qty", "sum"),
+                sum_price=("price4", "sum"),
+                avg_qty=("qty", "avg"),
+                sd_messy=("messy", "stddev_samp"),
+                avg_messy=("messy", "avg"),
+                n=("*", "count"),
+                nm=("messy", "count"),
+                lo=("price4", "min"),
+                hi=("qty", "max"),
+            )
+        )
+
+    def test_q1_shape_multi_key(self, tmp_path, dataset):
+        """Multi-key q1 shape: keys, counts, int sums/max, float min, and the
+        dyadic-rational float sum are byte-identical; messy-float reductions
+        agree to 1e-9 (cross-shard summation order)."""
+        got, want, summary = _collect_modes(
+            tmp_path, dataset, self.q1,
+            index=(["ship"], ["rf", "ls", "qty", "price4", "messy"]),
+        )
+        assert_tables_equal(
+            got, want, float_cols=("sd_messy", "avg_messy", "avg_qty")
+        )
+        assert "device-grouped" in summary, summary
+
+    def test_null_float_group_keys(self, tmp_path, dataset):
+        # no filter -> no index rewrite; stream the chunks so the grouped
+        # device (and sharded) path still runs over FileScan subsets
+        got, want, summary = _collect_modes(
+            tmp_path, dataset,
+            lambda df: df.group_by("fkey").agg(
+                n=("*", "count"), s=("qty", "sum"), m=("messy", "avg")
+            ),
+            **{
+                hst.keys.EXEC_STREAM_AGG_MIN_BYTES: 1,
+                hst.keys.EXEC_STREAM_CHUNK_BYTES: 1,  # one file per chunk
+            },
+        )
+        assert "sharded" in summary, summary
+        assert_tables_equal(got, want, float_cols=("m",))
+        # NaN keys form exactly one group on both paths
+        fk = np.asarray(got["fkey"], dtype=np.float64)
+        assert int(np.isnan(fk).sum()) == 1
+
+    def test_streamed_grouped_agg(self, tmp_path, dataset):
+        """The streaming (chunk-at-a-time) grouped path with sharded chunk
+        programs: per-shard partials merge on device via all-gather, then
+        chunk partials merge pairwise — result identical to single-device."""
+        conf = {
+            hst.keys.EXEC_STREAM_AGG_MIN_BYTES: 1,
+            hst.keys.EXEC_STREAM_CHUNK_BYTES: 1,  # one file per chunk
+        }
+        got, want, summary = _collect_modes(tmp_path, dataset, self.q1, **conf)
+        assert_tables_equal(
+            got, want, float_cols=("sd_messy", "avg_messy", "avg_qty")
+        )
+        assert "device-grouped-stream-sharded" in summary, summary
+
+
+class TestDefaultOffGate:
+    def test_gate_is_off_by_default(self, tmp_path, dataset):
+        from hyperspace_tpu.exec.executor import _maybe_parallel
+        from hyperspace_tpu.parallel import ShardedExecutor
+
+        s = _session(tmp_path, "gate")
+        assert s.conf.parallel_enabled is False
+        assert ShardedExecutor.maybe(s) is None
+        assert _maybe_parallel(s) is None
+        with trace.recording() as events:
+            s.read_parquet(dataset).filter(hst.col("qty") > 25).select("ship").collect()
+        summary = trace.summarize(events)
+        assert "sharded" not in summary, summary
+
+    def test_min_rows_gates_one_shot_ops(self, tmp_path, dataset):
+        from hyperspace_tpu.exec.executor import _maybe_parallel
+
+        s = _session(
+            tmp_path, "minrows",
+            **{hst.keys.PARALLEL_ENABLED: True, hst.keys.PARALLEL_MIN_ROWS: 10**9},
+        )
+        assert _maybe_parallel(s) is not None
+        assert _maybe_parallel(s, 1000) is None
+
+
+class TestShardedIndexBuild:
+    def test_build_parity_behind_parallel_gate(self, tmp_path, dataset):
+        """write_bucketed with the parallel gate on (8-device exchange) is
+        byte-identical to the host/single-device build, and the gate keeps
+        the exchange off by default."""
+        from hyperspace_tpu.indexes.covering import bucket_of_file, write_bucketed
+        import hyperspace_tpu.ops.bucketize as bz
+
+        t = pq.read_table(glob.glob(os.path.join(dataset, "*.parquet"))[0])
+        t = t.select(["ship", "qty", "price4"])
+
+        s_on = _session(tmp_path, "bon", **{hst.keys.PARALLEL_ENABLED: True})
+        d_mesh, d_host = str(tmp_path / "bm"), str(tmp_path / "bh")
+        write_bucketed(t, ["ship"], 16, d_mesh, session=s_on)
+        write_bucketed(t, ["ship"], 16, d_host, session=None)
+
+        def buckets(d):
+            out = {}
+            for p in sorted(glob.glob(os.path.join(d, "*.parquet"))):
+                out.setdefault(bucket_of_file(p), []).append(pq.read_table(p))
+            return {b: pa.concat_tables(ts) for b, ts in out.items()}
+
+        mesh_b, host_b = buckets(d_mesh), buckets(d_host)
+        assert set(mesh_b) == set(host_b)
+        for b in host_b:
+            assert mesh_b[b].equals(host_b[b]), f"bucket {b} differs"
+
+    def test_build_gate_default_off(self, tmp_path, dataset, monkeypatch):
+        from hyperspace_tpu.indexes.covering import write_bucketed
+        import hyperspace_tpu.ops.bucketize as bz
+
+        called = {"n": 0}
+        real = bz.distributed_bucket_sort_build
+
+        def spy(*a, **k):
+            called["n"] += 1
+            return real(*a, **k)
+
+        monkeypatch.setattr(bz, "distributed_bucket_sort_build", spy)
+        t = pq.read_table(glob.glob(os.path.join(dataset, "*.parquet"))[0]).select(
+            ["ship", "qty"]
+        )
+        s = _session(tmp_path, "boff")  # parallel.enabled defaults to False
+        write_bucketed(t, ["ship"], 16, str(tmp_path / "bo"), session=s)
+        assert called["n"] == 0
+
+
+class TestMeshHelpers:
+    def test_make_mesh_rejects_oversubscription(self):
+        from hyperspace_tpu.parallel import make_mesh
+
+        with pytest.raises(ValueError, match="9-device mesh but only 8"):
+            make_mesh(9)
+
+    def test_make_mesh_rejects_nonpositive(self):
+        from hyperspace_tpu.parallel import make_mesh
+
+        with pytest.raises(ValueError, match=">= 1"):
+            make_mesh(0)
+        with pytest.raises(ValueError, match=">= 1"):
+            make_mesh(-2)
+
+    def test_make_mesh_2d_rejects_nondivisible(self):
+        from hyperspace_tpu.parallel import make_mesh_2d
+
+        with pytest.raises(ValueError, match="divide evenly"):
+            make_mesh_2d(n_slices=3)
+
+    def test_make_mesh_2d_rejects_oversubscription(self):
+        from hyperspace_tpu.parallel import make_mesh_2d
+
+        with pytest.raises(ValueError, match="only 8 devices"):
+            make_mesh_2d(n_slices=4, per_slice=4)
+
+    def test_fingerprint_distinguishes_mesh_shapes(self):
+        from hyperspace_tpu.parallel import make_mesh, make_mesh_2d, mesh_fingerprint
+
+        fp8 = mesh_fingerprint(make_mesh(8))
+        assert fp8 == mesh_fingerprint(make_mesh(8))  # stable
+        assert fp8 != mesh_fingerprint(make_mesh(4))
+        assert fp8 != mesh_fingerprint(make_mesh_2d(n_slices=2, per_slice=4))
+        assert fp8.startswith("cpu:8:")
