@@ -35,6 +35,11 @@ size and the flatness ratio (8-way per-chip / 1-way per-chip). The bar on
 real hardware is >= 0.7x; the JSON's ``platform`` field says honestly when
 the "chips" are emulated host devices sharing one CPU, where per-chip
 throughput necessarily divides. Writes BENCH_mesh.json.
+
+``--check-overhead`` prices the hscheck runtime hook: the disabled
+``maybe_verify`` per-call cost as a percentage of a mean program-cache fill
+(bar: <= 1%), with the enabled once-per-executable verify cost reported for
+context. Writes BENCH_check.json.
 """
 
 from __future__ import annotations
@@ -925,6 +930,88 @@ def mesh_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def check_overhead_main() -> None:
+    """--check-overhead: price the hscheck runtime hook.
+
+    ``maybe_verify`` sits on every program-cache fill in exec/device.py and
+    ops/bucketize.py. Its contract is that the DISABLED path (the default:
+    ``hyperspace.check.hlo.enabled`` false) is one conf lookup — this measures
+    that per-call cost against the mean cost of an actual program-cache fill
+    (lower + XLA compile) and holds it under 1%. The enabled path's full
+    verify cost is reported alongside for context (it is paid once per new
+    executable, never per query). Writes BENCH_check.json.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    fills = max(8, int(os.environ.get("BENCH_CHECK_FILLS", 16)))
+    calls = max(10_000, int(os.environ.get("BENCH_CHECK_CALLS", 200_000)))
+
+    import jax
+    import jax.numpy as jnp
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.check import hlo_lint
+    from hyperspace_tpu.exec import device as _device  # noqa: F401  (registers contracts)
+
+    tmp = tempfile.mkdtemp(prefix="hs_bench_check_")
+    try:
+        sess = hst.Session(conf={hst.keys.SYSTEM_PATH: tmp})
+        hst.set_session(sess)
+        assert not sess.conf.check_hlo_enabled
+
+        jitted = jax.jit(lambda x: jnp.cumsum(x * 2 + 1) % 7)
+
+        # mean program-cache fill: lower+compile at distinct shapes so every
+        # rep is a genuine fill, not a hit
+        fill_times = []
+        for i in range(fills):
+            x = jnp.zeros((64 + 8 * i,), jnp.float32)
+            t0 = time.perf_counter()
+            jitted.lower(x).compile()
+            fill_times.append(time.perf_counter() - t0)
+        mean_fill = sum(fill_times) / len(fill_times)
+
+        # disabled maybe_verify: the exact call the hot path makes
+        x = jnp.zeros((64,), jnp.float32)
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            hlo_lint.maybe_verify(sess.conf, "fused-filter", "bench-key", jitted, (x,))
+        disabled_per_call = (time.perf_counter() - t0) / calls
+
+        # enabled path, paid once per new executable: verify one program
+        hlo_lint.set_default_enabled(True)
+        hlo_lint.reset_runtime_state()
+        try:
+            t0 = time.perf_counter()
+            hlo_lint.maybe_verify(None, "fused-filter", "bench-key-on", jitted, (x,))
+            enabled_once = time.perf_counter() - t0
+        finally:
+            hlo_lint.set_default_enabled(False)
+            hlo_lint.reset_runtime_state()
+
+        overhead_pct = 100.0 * disabled_per_call / mean_fill
+        out = {
+            "metric": "hscheck_disabled_hook_pct_of_program_cache_fill",
+            "value": round(overhead_pct, 4),
+            "unit": "%",
+            "bar": "<= 1%",
+            "pass": overhead_pct <= 1.0,
+            "disabled_hook_ns": round(disabled_per_call * 1e9, 1),
+            "mean_program_cache_fill_ms": round(mean_fill * 1e3, 3),
+            "enabled_verify_once_ms": round(enabled_once * 1e3, 3),
+            "fills": fills,
+            "calls": calls,
+        }
+        print(json.dumps(out))
+        with open("BENCH_check.json", "w") as f:
+            json.dump(out, f, indent=2)
+        if not out["pass"]:
+            sys.exit(1)
+    finally:
+        hst.set_session(None)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main() -> None:
     _honor_cpu_request()
     _backend_watchdog()
@@ -1017,5 +1104,7 @@ if __name__ == "__main__":
         mesh_child_main()
     elif "--mesh" in sys.argv[1:]:
         mesh_main()
+    elif "--check-overhead" in sys.argv[1:]:
+        check_overhead_main()
     else:
         main()

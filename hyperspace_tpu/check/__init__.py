@@ -1,0 +1,32 @@
+"""``hyperspace_tpu.check`` — static program-contract and codebase-invariant
+analysis.
+
+Three passes, one stance: the repo's correctness claims are *mechanically
+checkable*, so check them mechanically instead of re-reading the code.
+
+- :mod:`hyperspace_tpu.check.hlo_lint` — compiled-program contracts. Each
+  device-program family (fused filter, bucketed SMJ span, grouped-agg chunk,
+  sharded grouped merge, index-build exchange) *declares* its collective
+  budget and forbidden-op patterns where the program is built; the engine
+  verifies compiled HLO text against the declaration, either offline (tests,
+  ``__graft_entry__.dryrun_multichip``) or at program-cache-fill time behind
+  ``hyperspace.check.hlo.enabled``.
+- :mod:`hyperspace_tpu.check.lint` + :mod:`hyperspace_tpu.check.rules` —
+  AST rules encoding repo contracts and past-bug patterns (conf-key/doc
+  drift, metric-family drift, lock-hold blocking calls, dropped
+  cache-branding kwargs, host ops inside jitted programs). CLI:
+  ``python -m hyperspace_tpu.check`` (nonzero exit on findings).
+- :mod:`hyperspace_tpu.check.locks` — a runtime lock-order watcher
+  (default-off, ``hyperspace.check.locks``) that records the cross-thread
+  lock acquisition graph and reports cycles as potential deadlocks.
+
+This ``__init__`` stays import-light on purpose: ``session.py`` imports the
+runtime hooks at construction time, and pulling the AST lint (ast parsing of
+the whole tree) into that path would tax every session start.
+"""
+
+from __future__ import annotations
+
+__all__ = ["Finding"]
+
+from hyperspace_tpu.check.findings import Finding

@@ -1,0 +1,43 @@
+"""Rule registry for the AST codebase lint.
+
+A rule is a module-level :class:`Rule` with a unique kebab-case name, a
+one-paragraph doc (rendered by ``--list`` and docs/static-analysis.md), and
+a ``check(ctx) -> List[Finding]``. Add a rule by dropping a module here,
+defining ``RULE = Rule(...)``, and listing it in :data:`_RULE_MODULES` —
+the fixture-pair convention in tests/fixtures/check/ (one seeded-violation
+file that must fire, one clean file that must not) keeps it honest.
+"""
+
+from __future__ import annotations
+
+import importlib
+from dataclasses import dataclass
+from typing import Callable, Dict, List
+
+from hyperspace_tpu.check.findings import Finding
+
+_RULE_MODULES = (
+    "conf_keys",
+    "metric_families",
+    "lock_blocking",
+    "cache_branding",
+    "jit_purity",
+)
+
+
+@dataclass(frozen=True)
+class Rule:
+    name: str
+    doc: str
+    check: Callable[["LintContext"], List[Finding]]  # noqa: F821
+
+
+def all_rules() -> Dict[str, Rule]:
+    out: Dict[str, Rule] = {}
+    for mod in _RULE_MODULES:
+        m = importlib.import_module(f"hyperspace_tpu.check.rules.{mod}")
+        rule = m.RULE
+        if rule.name in out:
+            raise ValueError(f"duplicate rule name {rule.name!r}")
+        out[rule.name] = rule
+    return out

@@ -8,6 +8,7 @@ Keys are namespaced ``hyperspace.*`` (the reference uses ``spark.hyperspace.*``)
 
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 
@@ -128,6 +129,11 @@ class keys:
     OBS_SLO_WINDOWS_SECONDS = "hyperspace.obs.slo.windowsSeconds"
     OBS_HTTP_PORT = "hyperspace.obs.http.port"
     OBS_HTTP_HOST = "hyperspace.obs.http.host"
+    # Static-analysis / runtime-contract checks (hyperspace_tpu/check/):
+    # HLO program-contract verification at program-cache-fill time, and the
+    # lock-order watcher. Both default off — they are CI/diagnostic tools.
+    CHECK_HLO_ENABLED = "hyperspace.check.hlo.enabled"
+    CHECK_LOCKS = "hyperspace.check.locks"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -344,6 +350,17 @@ DEFAULTS: Dict[str, Any] = {
     # ephemeral port (read it from server.telemetry.port).
     keys.OBS_HTTP_PORT: None,
     keys.OBS_HTTP_HOST: "127.0.0.1",
+    # Verify every newly compiled device program against its registered
+    # ProgramContract (collective budget + forbidden ops) and bump
+    # hs_check_violations_total on breach. Costs one HLO text dump per
+    # compile — compile-time only, nothing on the cached-execution path.
+    # HS_CHECK_HLO=1 flips the default on for a whole process, so existing
+    # suites can run under verification without touching their sessions.
+    keys.CHECK_HLO_ENABLED: os.environ.get("HS_CHECK_HLO", "") not in ("", "0"),
+    # Wrap named internal mutexes in the lock-order watcher (cross-thread
+    # acquisition-order cycle detection). Construction-time flag: locks
+    # created before a Session enabled it stay plain.
+    keys.CHECK_LOCKS: False,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -751,6 +768,14 @@ class HyperspaceConf:
     @property
     def obs_http_host(self) -> str:
         return str(self.get(keys.OBS_HTTP_HOST))
+
+    @property
+    def check_hlo_enabled(self) -> bool:
+        return bool(self.get(keys.CHECK_HLO_ENABLED))
+
+    @property
+    def check_locks_enabled(self) -> bool:
+        return bool(self.get(keys.CHECK_LOCKS))
 
     def deltas(self) -> Dict[str, Any]:
         """Explicitly-set keys whose value differs from the centralized

@@ -597,6 +597,47 @@ def _program_key(skeleton: str, mesh, sharded: bool = False) -> str:
     return f"{skeleton}@{_mesh_fp(mesh)}/{mode}"
 
 
+# --- declared HLO contracts (hyperspace_tpu/check/hlo_lint.py) -------------
+# Each device-program family states its collective budget next to the code
+# that builds it (and inherits the forbidden-op rules: no host callbacks, no
+# f32->f64 array upcasts, no bounded-dynamic shapes). With
+# hyperspace.check.hlo.enabled on, maybe_verify() checks every newly
+# compiled executable at program-cache-fill time.
+from hyperspace_tpu.check import hlo_lint as _hlo_lint
+
+_ANY = (0, None)
+_hlo_lint.register_contract(
+    "fused-filter",
+    collectives={},
+    description="fused predicate mask: elementwise over resident shards, shuffle-free",
+)
+_hlo_lint.register_contract(
+    "fused-agg",
+    collectives={"all-reduce": _ANY},
+    description="fused filter+aggregate: scalar reductions may all-reduce, never move rows",
+)
+_hlo_lint.register_contract(
+    "grouped-agg-chunk",
+    collectives={"all-gather": _ANY, "all-reduce": _ANY},
+    description="GSPMD grouped-aggregate chunk: the partitioner may gather fixed-size partials, never rows",
+)
+_hlo_lint.register_contract(
+    "sharded-grouped",
+    collectives={"all-gather": (1, None), "all-reduce": _ANY},
+    description="shard_map grouped chunk: all-gathers per-shard partial TABLES (>=1), never rows",
+)
+_hlo_lint.register_contract(
+    "grouped-merge",
+    collectives={},
+    description="pairwise partial-aggregate merge: device-local, collective-free",
+)
+_hlo_lint.register_contract(
+    "bucketed-smj-span",
+    collectives={},
+    description="bucketed sort-merge join span search: the shuffle-freedom claim itself",
+)
+
+
 def _dry_codecs(batch: B.Batch, refs) -> Dict[str, ColumnCodec]:
     """Dtype-kind-only codecs for the pre-transfer support check (string
     bounds resolve to 0; values are discarded)."""
@@ -678,6 +719,7 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None, 
     key = _program_key(skeleton, mesh, sharded=parallel is not None)
     jitted = _cached_predicate_jit(key, fn)
     _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
+    _hlo_lint.maybe_verify(session.conf, "fused-filter", key, jitted, (dev_cols, lit_values))
     mask = jitted(dev_cols, lit_values)
     return np.asarray(mask)[:n]
 
@@ -853,6 +895,7 @@ def device_filtered_aggregate(
     key = _program_key(skeleton, mesh)
     jitted = _cached_predicate_jit(key, program)
     _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
+    _hlo_lint.maybe_verify(session.conf, "fused-agg", key, jitted, (dev_cols, lit_values, np.int64(n)))
     outs, valids = jitted(dev_cols, lit_values, np.int64(n))
     outs = [np.asarray(o) for o in outs]
     valids = [int(v) for v in valids]
@@ -1291,6 +1334,12 @@ class GroupedAggStream:
             key = _program_key(f"gagg[{cap}]:{base_sk}", mesh, sharded=sharded)
             jitted = _cached_predicate_jit(key, program)
             _note_compile(key, shapes)
+            _hlo_lint.maybe_verify(
+                self.session.conf,
+                "sharded-grouped" if sharded else "grouped-agg-chunk",
+                key, jitted,
+                (dev_cols, lit_values, np.int64(n), np.int64(self._row_base)),
+            )
             if sharded:
                 n_g_dev, fs, key_out, slot_out = self._parallel.timed_call(
                     "grouped-agg", jitted,
@@ -1371,6 +1420,11 @@ class GroupedAggStream:
         program = _grouped_merge_program(key_specs, self._slots, cap_in, cap_out)
         jitted = _cached_predicate_jit(key, program)
         _note_compile(key, (cap_in, cap_out))
+        _hlo_lint.maybe_verify(
+            self.session.conf, "grouped-merge", key, jitted,
+            (tuple(a["keys"]), tuple(b["keys"]), tuple(a["slots"]), tuple(b["slots"]),
+             a["fs"], b["fs"], np.int64(a["n"]), np.int64(b["n"])),
+        )
         t0 = _time.perf_counter()
         with obs_spans.span("agg-merge", cat="groupagg", groups_in=a["n"] + b["n"]):
             n_g_dev, fs, key_out, slot_out = jitted(
@@ -2491,6 +2545,10 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
 
     spans = _bucketed_span_program(mesh, axis)
     _note_compile("join-span", (tuple(lmat_dev.shape), tuple(rmat_dev.shape)))
+    _hlo_lint.maybe_verify(
+        session.conf, "bucketed-smj-span", _program_key("join-span", mesh),
+        spans, (lmat_dev, rmat_dev),
+    )
     lo, hi = spans(lmat_dev, rmat_dev)
 
     if plan.how == "inner" and session.conf.join_device_materialize:

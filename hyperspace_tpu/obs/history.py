@@ -35,6 +35,8 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional
 
+from hyperspace_tpu.check.locks import named_lock
+
 __all__ = [
     "P2Quantile",
     "StreamStat",
@@ -201,7 +203,7 @@ class _FingerprintStats:
         self.rows = StreamStat(alpha)
         self.bytes = StreamStat(alpha)
         self.compiles = StreamStat(alpha)
-        self.lock = threading.Lock()
+        self.lock = named_lock("obs.profileHistory.entry")
 
     def to_json(self) -> Dict[str, Any]:
         with self.lock:
@@ -235,13 +237,13 @@ class ProfileHistory:
         registry=None,
         server: str = "",
     ):
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.profileHistory")
         self._entries: "OrderedDict[str, _FingerprintStats]" = OrderedDict()
         self.max_fingerprints = max(1, int(max_fingerprints))
         self.ema_alpha = float(ema_alpha)
         self.evicted = 0
         self._persist_path = persist_path
-        self._persist_lock = threading.Lock()
+        self._persist_lock = named_lock("obs.profileHistory.persist")
         self._persist_f = None
         self._recorded = None
         if persist_path:
@@ -490,7 +492,7 @@ class FlightRecorder:
                  registry=None, server: str = ""):
         self.max_entries = max(1, int(max_entries))
         self.directory = directory
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.flightRecorder")
         self._ring: "deque[FlightEntry]" = deque(maxlen=self.max_entries)
         self._seq = 0
         self._counter = None

@@ -24,6 +24,8 @@ import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from hyperspace_tpu.check.locks import named_lock
+
 __all__ = [
     "Counter",
     "Gauge",
@@ -189,7 +191,9 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # registry-level lock only: per-instrument value locks stay plain —
+        # they are leaf locks on the inc() hot path and never nest
+        self._lock = named_lock("obs.metricsRegistry")
         self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
         self._kinds: Dict[str, str] = {}
         self._help: Dict[str, str] = {}

@@ -26,6 +26,8 @@ import time
 from collections import deque
 from typing import Any, Dict, Optional, Tuple
 
+from hyperspace_tpu.check.locks import named_lock
+
 __all__ = ["SloTracker"]
 
 #: per-tenant cap on retained windowed events; beyond it the oldest fall off
@@ -40,7 +42,7 @@ class _TenantState:
         self.good = None  # registry counters, bound lazily
         self.bad = None
         self.events: "deque[Tuple[float, bool]]" = deque(maxlen=_MAX_EVENTS)
-        self.lock = threading.Lock()
+        self.lock = named_lock("obs.slo.tenant")
 
 
 class SloTracker:
@@ -63,7 +65,7 @@ class SloTracker:
         self.registry = registry
         self.server = server
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.slo")
         self._tenants: Dict[str, _TenantState] = {}
 
     def _tenant(self, tenant: str) -> _TenantState:

@@ -240,6 +240,28 @@ def _next_pow2(x: int) -> int:
 
 from functools import lru_cache  # noqa: E402
 
+from hyperspace_tpu.check import hlo_lint as _hlo_lint  # noqa: E402
+
+# Declared HLO contracts for the build/exchange programs (SURVEY.md §2.9:
+# build = exactly ONE all-to-all; hierarchical re-bucketing = one per phase).
+# The single-phase contracts also apply to the plane-packed `rebucket`
+# program — tests jit-wrap it and assert against "index-rebucket".
+_hlo_lint.register_contract(
+    "index-build-exchange",
+    collectives={"all-to-all": (1, 1)},
+    description="distributed index build: rows cross devices in exactly one plane-packed all-to-all",
+)
+_hlo_lint.register_contract(
+    "index-rebucket",
+    collectives={"all-to-all": (1, 1)},
+    description="incremental re-bucketing: one plane-packed all-to-all",
+)
+_hlo_lint.register_contract(
+    "hierarchical-exchange",
+    collectives={"all-to-all": (2, 2)},
+    description="2-D (dcn, ici) re-bucketing: one all-to-all per phase, rows cross DCN once",
+)
+
 
 @lru_cache(maxsize=64)
 def _build_exchange_program(mesh: Mesh, kinds: Tuple[str, ...], num_buckets: int, capacity: int):
@@ -339,6 +361,13 @@ def distributed_bucket_sort_build(
     import numpy as np
 
     fn = _build_exchange_program(mesh, tuple(kinds), int(num_buckets), int(capacity))
+    # no session conf reaches this layer: maybe_verify(None, ...) consults
+    # the process-global default the most recent Session wired
+    _hlo_lint.maybe_verify(
+        None, "index-build-exchange",
+        f"build-exchange[{num_buckets}/{capacity}]@{len(mesh.devices.flat)}",
+        fn, (tuple(keys), tuple(host_hashes), row_idx, np.int64(n_valid)),
+    )
     return fn(tuple(keys), tuple(host_hashes), row_idx, np.int64(n_valid))
 
 

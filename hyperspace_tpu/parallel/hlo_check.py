@@ -1,86 +1,26 @@
-"""Compiled-HLO collective inspection.
-
-The framework's core architectural claims (SURVEY.md §2.9, mirroring the
-reference's shuffle-freedom guarantees, ref: HS/index/covering/
-JoinIndexRule.scala:604-618) are properties of the *compiled program*:
-
-- the bucketed equi-join runs with NO data exchange (no all-to-all /
-  all-gather / collective-permute — co-sharded buckets join device-locally;
-  only the query's own aggregate may all-reduce),
-- the distributed index build exchanges rows with exactly ONE all-to-all
-  (the packed-plane exchange, ops/bucketize.py ``_exchange_packed``),
-- the hierarchical DCN x ICI re-bucketing uses exactly TWO (one per phase).
-
-These helpers scan ``jit(...).lower(...).compile().as_text()`` so the claims
-are asserted from the HLO itself (``__graft_entry__.dryrun_multichip`` and
-tests/test_hlo_collectives.py), not from reading the Python.
-"""
+"""Compat shim — the compiled-HLO inspection helpers moved to
+:mod:`hyperspace_tpu.check.hlo_lint`, where they grew into a declared
+program-contract rule engine (collective budgets + forbidden-op patterns per
+device-program family). Import sites keep working; new code should import
+from ``hyperspace_tpu.check.hlo_lint`` and prefer ``verify_hlo`` /
+``assert_contract`` over raw count assertions."""
 
 from __future__ import annotations
 
-import re
-from typing import Dict
-
-COLLECTIVE_OPS = (
-    "all-to-all",
-    "all-gather",
-    "collective-permute",
-    "all-reduce",
-    "reduce-scatter",
+from hyperspace_tpu.check.hlo_lint import (  # noqa: F401
+    COLLECTIVE_OPS,
+    SHUFFLE_OPS,
+    assert_collectives,
+    assert_shuffle_free,
+    collective_counts,
+    hlo_text_of,
 )
 
-# an HLO op application site: ` op-name(` or ` op-name-start(` — the result
-# type before it may be a tuple containing spaces, so key on the call itself;
-# operand mentions like `get-tuple-element(%all-to-all)` don't match (no
-# following paren), and metadata op_name strings use underscores, not dashes.
-# Async pairs (op-start/op-done) count once at -start.
-_INSTR = re.compile(
-    r"[\s)](" + "|".join(COLLECTIVE_OPS) + r")(-start|-done)?(?:\.\d+)?\("
-)
-
-
-def collective_counts(hlo_text: str) -> Dict[str, int]:
-    """Occurrences of each collective op in compiled HLO text (async
-    start/done pairs counted once)."""
-    counts = {k: 0 for k in COLLECTIVE_OPS}
-    for m in _INSTR.finditer(hlo_text):
-        if m.group(2) == "-done":
-            continue
-        counts[m.group(1)] += 1
-    return counts
-
-
-def assert_collectives(hlo_text: str, expect: Dict[str, int], context: str = "") -> None:
-    """Assert exact counts for the ops named in ``expect`` and ZERO for every
-    other collective op."""
-    got = collective_counts(hlo_text)
-    for op in COLLECTIVE_OPS:
-        want = expect.get(op, 0)
-        assert got[op] == want, (
-            f"{context or 'program'}: expected {want} x {op} in compiled HLO, "
-            f"found {got[op]} (all counts: {got})"
-        )
-
-
-# ops that move row data between devices: their absence is the reference's
-# shuffle-freedom claim (ref: JoinIndexRule.scala:604-618). all-reduce stays
-# out of this set — a scalar reduction is not a data shuffle.
-SHUFFLE_OPS = ("all-to-all", "all-gather", "collective-permute", "reduce-scatter")
-
-
-def assert_shuffle_free(hlo_text: str, context: str = "") -> None:
-    """Assert the compiled program exchanges NO row data between devices
-    (no all-to-all / all-gather / collective-permute / reduce-scatter)."""
-    got = collective_counts(hlo_text)
-    bad = {op: got[op] for op in SHUFFLE_OPS if got[op]}
-    assert not bad, (
-        f"{context or 'program'}: expected a shuffle-free program but the "
-        f"compiled HLO contains data-movement collectives {bad} "
-        f"(all counts: {got})"
-    )
-
-
-def hlo_text_of(jitted, *args, **kwargs) -> str:
-    """Compiled HLO text of a jitted callable for the given example
-    arguments — the artifact the assertions above inspect."""
-    return jitted.lower(*args, **kwargs).compile().as_text()
+__all__ = [
+    "COLLECTIVE_OPS",
+    "SHUFFLE_OPS",
+    "assert_collectives",
+    "assert_shuffle_free",
+    "collective_counts",
+    "hlo_text_of",
+]

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from hyperspace_tpu.check.locks import named_lock
+
 
 class AdmissionRejected(RuntimeError):
     """Queue full at submit time — back off and retry."""
@@ -43,7 +45,7 @@ class AdmissionController:
         self.depth = int(depth)
         self.default_timeout = default_timeout
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.admission")
         self.submitted = 0
         self.rejected = 0
         self.timeouts = 0

@@ -19,6 +19,8 @@ from typing import List, Optional, Tuple
 
 from hyperspace_tpu.utils.lru import BytesLRU
 
+from hyperspace_tpu.check.locks import named_lock
+
 
 def _key(files: List[str], columns: Optional[List[str]]) -> Tuple:
     return (tuple(files), tuple(columns) if columns is not None else None)
@@ -31,9 +33,9 @@ class BucketCache:
         self._lru = BytesLRU(int(cap_bytes))
         self._prefetch_workers = int(prefetch_workers)
         self._pool: Optional[ThreadPoolExecutor] = None
-        self._pool_lock = threading.Lock()
+        self._pool_lock = named_lock("serving.bucketCache.pool")
         self._inflight = set()
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = named_lock("serving.bucketCache.inflight")
         self.prefetch_issued = 0
         self.prefetch_completed = 0
 

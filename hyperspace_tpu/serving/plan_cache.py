@@ -26,6 +26,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, List, Optional, Tuple
 
+from hyperspace_tpu.check.locks import named_lock
 from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.serving.fingerprint import (
     Fingerprint,
@@ -100,7 +101,7 @@ class PlanCache:
 
     def __init__(self, max_entries: int = 256):
         self.max_entries = int(max_entries)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.planCache")
         self._entries: "OrderedDict[Tuple, Any]" = OrderedDict()
         self.hits = 0
         self.misses = 0

@@ -41,6 +41,8 @@ from hyperspace_tpu.plan import logical as L
 from hyperspace_tpu.plan.expr import as_bool_mask, comparison_atom, split_conjuncts
 from hyperspace_tpu.serving.fingerprint import Fingerprint, _lit_token
 
+from hyperspace_tpu.check.locks import named_lock
+
 __all__ = ["ResultCache", "version_brand", "chain_atoms", "atoms_imply"]
 
 
@@ -161,7 +163,7 @@ class ResultCache:
         self.max_bytes = int(max_bytes)
         self.max_entry_bytes = int(max_entry_bytes)
         self.subsumption = bool(subsumption)
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.resultCache")
         self._entries: "OrderedDict[Tuple, _Entry]" = OrderedDict()
         # (structure) -> {brand -> [exact keys]} so a new brand can purge the
         # structure's stale-version entries wholesale

@@ -38,6 +38,8 @@ from typing import Callable, Dict, Optional
 
 from hyperspace_tpu.serving.admission import AdmissionController, AdmissionRejected
 
+from hyperspace_tpu.check.locks import named_lock
+
 __all__ = ["CostAwareScheduler", "TokenBucket", "classify_cost", "COST_CLASSES"]
 
 #: dispatch order within a tenant: interactive first, heavy last; "unknown"
@@ -72,7 +74,7 @@ class TokenBucket:
         self.tokens = float(burst)
         self._clock = clock
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = named_lock("serving.sched.tokenBucket")
 
     def try_acquire(self, n: float = 1.0) -> bool:
         with self._lock:

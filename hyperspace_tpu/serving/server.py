@@ -43,6 +43,8 @@ from hyperspace_tpu.serving.plan_cache import CompiledPlan, PlanCache, session_t
 from hyperspace_tpu.serving.result_cache import ResultCache, version_brand
 from hyperspace_tpu.serving.scheduler import CostAwareScheduler, classify_cost
 
+from hyperspace_tpu.check.locks import named_lock
+
 __all__ = ["QueryServer", "AdmissionRejected", "RequestTimeout", "ServerClosed"]
 
 # distinguishes concurrent QueryServers' series in the process-wide registry
@@ -230,7 +232,7 @@ class QueryServer:
         if overrides:
             raise TypeError(f"Unknown QueryServer options: {sorted(overrides)}")
 
-        self._sql_memo_lock = threading.Lock()
+        self._sql_memo_lock = named_lock("serving.sqlMemo")
         self._sql_memo: Dict[str, tuple] = {}
         self._threads: List[threading.Thread] = []
         self._stop = threading.Event()

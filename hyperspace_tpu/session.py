@@ -40,6 +40,17 @@ class Session:
         from hyperspace_tpu.exec import io as _io
 
         _io.set_decode_threads(self.conf.io_decode_threads)
+        # check-layer runtime switches are process-global for the same
+        # reason (compile sites without a session in scope consult them).
+        # HLO verification: most recent session's conf wins, like decode
+        # threads. Lock watching is enable-only: locks wrap at construction,
+        # so a later Session with the flag off can't unwrap them anyway.
+        from hyperspace_tpu.check import hlo_lint as _hlo_lint
+        from hyperspace_tpu.check import locks as _locks
+
+        _hlo_lint.set_default_enabled(self.conf.check_hlo_enabled)
+        if self.conf.check_locks_enabled:
+            _locks.watcher.enable()
         self.provider_manager = FileBasedSourceProviderManager(self)
         # context-local override beats the session-wide default, so a scoped
         # toggle (with_hyperspace_disabled, a serving worker pinning the flag

@@ -1,0 +1,5 @@
+"""Seeded conf-keys violation: unregistered key literal at a conf call."""
+
+
+def misuse(conf):
+    return conf.get("hyperspace.serving.quueDepth")  # typo'd, unregistered

@@ -1,0 +1,40 @@
+"""Seeded jit-purity violations: host numpy/time/random in jitted code."""
+
+import random
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    t = time.time()  # freezes at trace time
+    return np.sum(x) + t  # host numpy on a tracer
+
+
+def by_name(x):
+    return x * random.random()  # freezes at trace time
+
+
+jitted = jax.jit(by_name)
+
+
+def wrapped(key, fn):
+    return fn
+
+
+def cached(x):
+    return np.mean(x)  # host numpy; jitted via the *jit*-named wrapper below
+
+
+program = wrapped("k", cached)
+compiled = _cached_predicate_jit = None
+
+
+def _fake_jit(key, fn):
+    return fn
+
+
+_cached_predicate_jit = _fake_jit
+built = _cached_predicate_jit("skeleton", cached)

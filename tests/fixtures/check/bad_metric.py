@@ -1,0 +1,5 @@
+"""Seeded metric-families violation: dynamically built family name."""
+
+
+def register(registry, kind):
+    return registry.counter("hs_" + kind + "_total", "dynamic name escapes drift checks")

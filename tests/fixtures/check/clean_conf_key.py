@@ -1,0 +1,8 @@
+"""Clean twin of bad_conf_key.py: registered key, non-conf receivers."""
+
+
+def fine(conf, options):
+    v = conf.get("hyperspace.exec.agg.enabled")
+    # dict .get with a hyperspace-looking string is NOT a conf call
+    w = options.get("hyperspace.anything.goes")
+    return v, w

@@ -1,0 +1,18 @@
+"""Clean twin of bad_jit.py: jnp inside jit; np dtypes/constants are fine."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def decorated(x):
+    return jnp.sum(x.astype(np.float64)) + np.float32(1.5)  # dtypes whitelisted
+
+
+def helper(x):
+    # NOT jitted anywhere: host numpy and time are fine here
+    time.sleep(0)
+    return np.sum(x)

@@ -1,0 +1,8 @@
+"""Clean twin of bad_metric.py: literal family name, non-registry receiver."""
+
+
+def register(registry, accumulator):
+    c = registry.counter("hs_events_total", "a literal, statically findable family")
+    # .counter on a non-registry-looking receiver is not a registration site
+    accumulator.counter("whatever" + "_dynamic")
+    return c

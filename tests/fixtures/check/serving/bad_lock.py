@@ -1,0 +1,14 @@
+"""Seeded lock-blocking violations: sleep/file IO/device sync under a mutex."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def convoy(arr):
+    with _lock:
+        time.sleep(0.01)
+        with open("/tmp/hscheck-fixture", "w") as f:
+            f.write("x")
+        arr.block_until_ready()

@@ -1,0 +1,20 @@
+"""Clean twin of bad_lock.py: IO outside the lock, nested defs don't count."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def disciplined(arr):
+    with _lock:
+        snapshot = list(arr)
+
+        def later():
+            # runs AFTER the with-block, on some other thread
+            time.sleep(0.01)
+
+    time.sleep(0.0)  # outside the lock: fine
+    with open("/tmp/hscheck-fixture", "w") as f:  # outside the lock: fine
+        f.write("x")
+    return snapshot, later

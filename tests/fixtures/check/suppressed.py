@@ -1,0 +1,8 @@
+"""Pragma fixture: the same violation as bad_conf_key.py, suppressed."""
+
+
+def misuse(conf):
+    bare = conf.get("hyperspace.not.registered.a")  # hscheck: disable
+    named = conf.get("hyperspace.not.registered.b")  # hscheck: disable=conf-keys
+    other = conf.get("hyperspace.not.registered.c")  # hscheck: disable=some-other-rule
+    return bare, named, other

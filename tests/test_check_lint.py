@@ -1,0 +1,151 @@
+"""hscheck AST lint: per-rule seeded-violation/clean fixture pairs, pragma
+suppression, CLI exit codes, and the tree-is-clean acceptance gate."""
+
+import json
+import os
+
+import pytest
+
+from hyperspace_tpu.check.__main__ import main
+from hyperspace_tpu.check.lint import default_paths, default_root, run_lint
+from hyperspace_tpu.check.rules import all_rules
+
+pytestmark = pytest.mark.check
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures", "check")
+
+
+def fixture(*parts):
+    return os.path.join(FIXTURES, *parts)
+
+
+def lint_one(path, rule):
+    return run_lint(paths=[path], rules=[rule])
+
+
+class TestRulePairs:
+    def test_conf_keys_bad(self):
+        found = lint_one(fixture("bad_conf_key.py"), "conf-keys")
+        assert len(found) == 1
+        assert found[0].rule == "conf-keys"
+        assert "hyperspace.serving.quueDepth" in found[0].message
+        assert found[0].line == 5
+
+    def test_conf_keys_clean(self):
+        assert lint_one(fixture("clean_conf_key.py"), "conf-keys") == []
+
+    def test_metric_families_bad(self):
+        found = lint_one(fixture("bad_metric.py"), "metric-families")
+        assert len(found) == 1
+        assert "literal" in found[0].message
+
+    def test_metric_families_clean(self):
+        assert lint_one(fixture("clean_metric.py"), "metric-families") == []
+
+    def test_lock_blocking_bad(self):
+        found = lint_one(fixture("serving", "bad_lock.py"), "lock-blocking")
+        reasons = " | ".join(f.message for f in found)
+        assert len(found) == 3
+        assert "sleep" in reasons
+        assert "file" in reasons
+        assert "device" in reasons
+
+    def test_lock_blocking_clean(self):
+        # IO after the with-block and inside nested defs must not count.
+        assert lint_one(fixture("serving", "clean_lock.py"), "lock-blocking") == []
+
+    def test_lock_blocking_only_fires_under_serving_or_obs(self):
+        # Same seeded pattern, but the path filter keeps the rule scoped to
+        # the latency-sensitive trees — bad_jit.py lives outside them.
+        assert lint_one(fixture("bad_jit.py"), "lock-blocking") == []
+
+    def test_cache_branding_bad(self):
+        found = lint_one(fixture("bad_branding.py"), "cache-branding")
+        assert [f.line for f in found] == [7, 8, 9]
+        assert "pruned_by" in found[0].message
+        assert "scan_key" in found[1].message
+
+    def test_cache_branding_clean(self):
+        # Explicit kwarg, positional past the index, and **kwargs all satisfy.
+        assert lint_one(fixture("clean_branding.py"), "cache-branding") == []
+
+    def test_jit_purity_bad(self):
+        found = lint_one(fixture("bad_jit.py"), "jit-purity")
+        lines = [f.line for f in found]
+        assert 12 in lines  # time.time in @jax.jit
+        assert 13 in lines  # np.sum in @jax.jit
+        assert 17 in lines  # random.random in fn later passed to jax.jit
+        assert 28 in lines  # np.mean in fn passed into a *jit*-named wrapper
+
+    def test_jit_purity_clean(self):
+        # jnp calls and whitelisted np dtypes/constants inside jit are fine,
+        # as is host numpy in a never-jitted helper.
+        assert lint_one(fixture("clean_jit.py"), "jit-purity") == []
+
+
+class TestSuppression:
+    def test_pragma(self):
+        found = run_lint(paths=[fixture("suppressed.py")], rules=["conf-keys"])
+        # Line 5 (bare disable) and line 6 (disable=conf-keys) are suppressed;
+        # line 7 names a different rule, so conf-keys still fires there.
+        assert [f.line for f in found] == [7]
+        assert "hyperspace.not.registered.c" in found[0].message
+
+
+class TestRunLint:
+    def test_unknown_rule_raises(self):
+        with pytest.raises(KeyError, match="no-such-rule"):
+            run_lint(rules=["no-such-rule"])
+
+    def test_rule_registry_complete(self):
+        assert set(all_rules()) == {
+            "cache-branding",
+            "conf-keys",
+            "jit-purity",
+            "lock-blocking",
+            "metric-families",
+        }
+
+    def test_default_scope_excludes_tests(self):
+        paths = default_paths(default_root())
+        assert paths, "default scope is empty"
+        assert not any(os.sep + "tests" + os.sep in p for p in paths)
+        assert any(p.endswith("bench.py") for p in paths)
+
+    def test_repo_tree_is_clean(self):
+        # The acceptance gate: the shipped tree carries zero findings.
+        found = run_lint()
+        assert found == [], "\n".join(f.render() for f in found)
+
+
+class TestCli:
+    def test_exit_nonzero_on_fixture(self, capsys):
+        rc = main([fixture("bad_conf_key.py")])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "[conf-keys]" in out
+        assert "quueDepth" in out
+
+    def test_exit_zero_on_tree(self, capsys):
+        assert main([]) == 0
+        assert capsys.readouterr().out == ""
+
+    def test_exit_two_on_unknown_rule(self, capsys):
+        rc = main(["--rules", "bogus", fixture("bad_conf_key.py")])
+        assert rc == 2
+        assert "unknown lint rules" in capsys.readouterr().err
+
+    def test_json_output(self, capsys):
+        rc = main(["--json", fixture("bad_branding.py")])
+        assert rc == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert len(payload) == 3
+        assert payload[0]["rule"] == "cache-branding"
+        assert payload[0]["line"] == 7
+        assert payload[0]["path"].endswith("bad_branding.py")
+
+    def test_list_rules(self, capsys):
+        assert main(["--list"]) == 0
+        out = capsys.readouterr().out
+        for name in all_rules():
+            assert name in out

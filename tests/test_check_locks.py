@@ -1,0 +1,128 @@
+"""hscheck lock-order watcher: ABBA cycle detection across threads,
+named_lock construction gating, and the violations metric."""
+
+import threading
+
+import pytest
+
+from hyperspace_tpu.check.locks import WatchedLock, named_lock, watcher
+from hyperspace_tpu.obs.metrics import REGISTRY
+
+pytestmark = pytest.mark.check
+
+
+@pytest.fixture()
+def watching():
+    watcher.enable()
+    watcher.reset()
+    yield watcher
+    watcher.disable()
+    watcher.reset()
+
+
+def _run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+class TestNamedLock:
+    def test_plain_lock_when_disabled(self):
+        assert not watcher.enabled
+        lk = named_lock("x")
+        assert type(lk) is type(threading.Lock())
+
+    def test_watched_when_enabled(self, watching):
+        lk = named_lock("x")
+        assert isinstance(lk, WatchedLock)
+        assert lk.name == "x"
+        with lk:
+            assert lk.locked()
+        assert not lk.locked()
+
+
+class TestCycles:
+    def test_opposite_order_two_threads(self, watching):
+        """The canonical ABBA hazard: thread 1 takes A then B, thread 2 takes
+        B then A. Neither deadlocks here (sequential), but the held-before
+        graph has the cycle."""
+        a, b = WatchedLock("A"), WatchedLock("B")
+        _run(lambda: [a.acquire(), b.acquire(), b.release(), a.release()])
+        _run(lambda: [b.acquire(), a.acquire(), a.release(), b.release()])
+        cycles = watching.cycles()
+        assert cycles == [["A", "B"]]
+
+    def test_consistent_order_is_clean(self, watching):
+        a, b = WatchedLock("A"), WatchedLock("B")
+        for _ in range(2):
+            _run(lambda: [a.acquire(), b.acquire(), b.release(), a.release()])
+        assert watching.edges() == {("A", "B"): 2}
+        assert watching.cycles() == []
+
+    def test_same_thread_nesting_is_not_a_cycle(self, watching):
+        # one thread nesting A->B then A->B again: an edge, never a cycle
+        a, b = WatchedLock("A"), WatchedLock("B")
+        with a:
+            with b:
+                pass
+        with a:
+            with b:
+                pass
+        assert watching.cycles() == []
+
+    def test_three_lock_cycle(self, watching):
+        a, b, c = WatchedLock("A"), WatchedLock("B"), WatchedLock("C")
+        _run(lambda: [a.acquire(), b.acquire(), b.release(), a.release()])
+        _run(lambda: [b.acquire(), c.acquire(), c.release(), b.release()])
+        _run(lambda: [c.acquire(), a.acquire(), a.release(), c.release()])
+        assert watching.cycles() == [["A", "B", "C"]]
+
+    def test_report_bumps_metric(self, watching):
+        a, b = WatchedLock("mA"), WatchedLock("mB")
+        _run(lambda: [a.acquire(), b.acquire(), b.release(), a.release()])
+        _run(lambda: [b.acquire(), a.acquire(), a.release(), b.release()])
+        program = "mA -> mB -> mA"
+        before = REGISTRY.counter(
+            "hs_check_violations_total", rule="lock-order-cycle", program=program
+        ).value
+        cycles = watching.report()
+        assert cycles == [["mA", "mB"]]
+        after = REGISTRY.counter(
+            "hs_check_violations_total", rule="lock-order-cycle", program=program
+        ).value
+        assert after == before + 1
+
+    def test_reset_clears_graph(self, watching):
+        a, b = WatchedLock("A"), WatchedLock("B")
+        with a:
+            with b:
+                pass
+        assert watching.edges()
+        watching.reset()
+        assert watching.edges() == {}
+
+
+class TestServingLocksUnderWatch:
+    def test_serving_caches_construct_watched(self, watching):
+        """Serving modules built while the watcher is on get WatchedLocks
+        (construction-time gating), and their normal operations record into
+        an acyclic graph."""
+        from hyperspace_tpu.serving.plan_cache import PlanCache
+        from hyperspace_tpu.serving.result_cache import ResultCache
+
+        pc = PlanCache(max_entries=8)
+        rc = ResultCache()
+        assert isinstance(pc._lock, WatchedLock)
+        assert isinstance(rc._lock, WatchedLock)
+        pc.stats()
+        pc.clear()
+        rc.stats()
+        rc.invalidate_all()
+        assert watching.cycles() == []
+
+    def test_modules_built_before_enable_stay_plain(self):
+        from hyperspace_tpu.serving.plan_cache import PlanCache
+
+        assert not watcher.enabled
+        pc = PlanCache(max_entries=8)
+        assert type(pc._lock) is type(threading.Lock())

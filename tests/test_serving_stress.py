@@ -317,3 +317,48 @@ def test_serving_soak_bounded(env):
     assert errors == []
     assert final["errors"] == 0
     assert final["completed"] > 0 and final["planCache"]["hitRate"] > 0.3
+
+
+@pytest.mark.check
+def test_lock_order_acyclic_under_concurrency(env):
+    """hscheck lock watcher over the real serving stack: build the server
+    with the watcher ON (locks instrument at construction) and hammer it from
+    8 threads — the observed cross-module acquisition graph must be acyclic,
+    i.e. no ABBA deadlock is reachable on the paths this workload drives."""
+    from hyperspace_tpu.check.locks import WatchedLock, watcher
+
+    texts = [
+        "SELECT k, w FROM sales WHERE v > 250",
+        "SELECT v FROM sales WHERE k = 13",
+        "SELECT a, count(*) AS c FROM sales WHERE v > 400 GROUP BY a ORDER BY a",
+    ]
+    watcher.enable()
+    watcher.reset()
+    try:
+        with QueryServer(env, workers=4, queue_depth=256) as srv:
+            # locks instrument at construction: the server was built under an
+            # enabled watcher, so its serving-layer locks must be watched
+            assert isinstance(srv._sql_memo_lock, WatchedLock)
+            errors = []
+
+            def client(tid):
+                try:
+                    for i in range(10):
+                        srv.query(texts[(tid + i) % len(texts)], timeout=60)
+                except Exception as exc:  # pragma: no cover - failure reporting
+                    errors.append((tid, exc))
+
+            threads = [threading.Thread(target=client, args=(t,)) for t in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            srv.stats(emit=True)
+        assert errors == []
+        # an empty edge set is the ideal outcome (no lock ever nests another);
+        # any edges that DID appear must not form a cycle
+        cycles = watcher.report()
+        assert cycles == [], f"lock-order cycles observed: {cycles}"
+    finally:
+        watcher.disable()
+        watcher.reset()
