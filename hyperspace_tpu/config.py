@@ -61,6 +61,12 @@ class keys:
     EXEC_STREAM_AGG_MIN_BYTES = "hyperspace.exec.stream.aggMinBytes"
     EXEC_STREAM_CHUNK_BYTES = "hyperspace.exec.stream.chunkBytes"
     EXEC_JOIN_SPILL_MIN_ROWS = "hyperspace.exec.join.spillMinRows"
+    # Streaming join engine (exec/join_stream.py + the pipelined bucketed
+    # SMJ): broadcast-side size gate, shared-build-side LRU budget, and the
+    # per-bucket prefetch master switch.
+    EXEC_JOIN_BROADCAST_MAX_BYTES = "hyperspace.exec.join.broadcastMaxBytes"
+    EXEC_JOIN_BUILD_CACHE_MAX_BYTES = "hyperspace.exec.join.buildCache.maxBytes"
+    EXEC_JOIN_PIPELINE_ENABLED = "hyperspace.exec.join.pipeline.enabled"
     # Scan IO + pipelined streaming (hyperspace_tpu/exec/pipeline.py):
     # decode-pool width, chunk prefetch depth/budget, and row-group pruning.
     EXEC_IO_DECODE_THREADS = "hyperspace.exec.io.decodeThreads"
@@ -233,6 +239,23 @@ DEFAULTS: Dict[str, Any] = {
     # partitioned (grace-join style): both sides split by key hash and each
     # partition merges independently, bounding the merge intermediate.
     keys.EXEC_JOIN_SPILL_MIN_ROWS: 1 << 26,
+    # When one join side's estimated input (sum of its leaf file sizes) fits
+    # under this, that side builds ONCE as a device-resident sorted hash
+    # table and the other side streams through it chunk-by-chunk — the
+    # build-once/probe-streaming discipline that keeps dimension-table joins
+    # off the materialize-both-sides path. 0 disables broadcast hash joins.
+    keys.EXEC_JOIN_BROADCAST_MAX_BYTES: 64 * 1024 * 1024,
+    # Byte budget of the shared build-side LRU (serving/build_cache.py):
+    # micro-batched requests joining the same dimension table reuse one
+    # built hash table instead of rebuilding per request. Entries key on
+    # (scan signature, keys, data-version brand) and purge on brand
+    # rotation, like the result cache.
+    keys.EXEC_JOIN_BUILD_CACHE_MAX_BYTES: 256 * 1024 * 1024,
+    # Route the streaming bucketed SMJ's per-bucket side decodes through the
+    # prefetch pipeline (exec/pipeline.py): bucket b+1's two sides decode
+    # while bucket b's spans compute, under the pipeline depth/byte budgets.
+    # False restores the serial consumer-thread decode loop.
+    keys.EXEC_JOIN_PIPELINE_ENABLED: True,
     # Width of the shared parquet decode pool (exec/io.py). Applied when a
     # Session is constructed; the HS_DECODE_THREADS env var overrides both.
     keys.EXEC_IO_DECODE_THREADS: 8,
@@ -548,6 +571,18 @@ class HyperspaceConf:
     @property
     def join_spill_min_rows(self) -> int:
         return int(self.get(keys.EXEC_JOIN_SPILL_MIN_ROWS))
+
+    @property
+    def join_broadcast_max_bytes(self) -> int:
+        return int(self.get(keys.EXEC_JOIN_BROADCAST_MAX_BYTES))
+
+    @property
+    def join_build_cache_max_bytes(self) -> int:
+        return int(self.get(keys.EXEC_JOIN_BUILD_CACHE_MAX_BYTES))
+
+    @property
+    def join_pipeline_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_JOIN_PIPELINE_ENABLED))
 
     @property
     def io_decode_threads(self) -> int:

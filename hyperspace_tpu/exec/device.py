@@ -1993,6 +1993,11 @@ def _stream_join_dtype_hints(
         try:
             is_left, col = _join_column_source(name, lcols_needed, rcols_needed)
         except DeviceUnsupported:
+            # a column with no resolvable side keeps no hint: cross-bucket
+            # dtype promotion for it then depends on which buckets hold rows.
+            # Surface the decision instead of silently narrowing it away.
+            trace.fallback("join", "dtype_hint")
+            trace.record("join", f"dtype-hint-dropped({name})")
             continue
         dt = (lmap if is_left else rmap).get(col)
         if dt is not None:
@@ -2000,14 +2005,35 @@ def _stream_join_dtype_hints(
     return hints
 
 
+def _count_join_stream_chunk() -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_join_stream_chunks_total",
+        "Chunks yielded by the streaming join paths (bucketed SMJ buckets + broadcast probe chunks)",
+    ).inc()
+
+
+def _chunk_nbytes(batch: B.Batch) -> int:
+    return sum(int(np.asarray(a).nbytes) for a in batch.values())
+
+
 def stream_bucketed_join(session, plan: L.Join, _compat=None):
     """Yield the bucketed SMJ's output ONE BUCKET AT A TIME: per bucket, both
     sides decode, spans compute (native merge walk / searchsorted), pairs
-    expand, and the chunk is yielded before the next bucket is touched. No
+    expand, and the chunk is yielded before the next bucket's expansion. No
     operator state spans buckets, so memory stays O(bucket pair + one output
     chunk) at any scale — the out-of-core discipline Spark's streaming
     executors give the reference for free (ref:
     HS/index/covering/JoinIndexRule.scala:604-705, valid at any SF).
+
+    With ``hyperspace.exec.join.pipeline.enabled`` (and the pipeline master
+    switch) on, bucket b+1's BOTH side decodes — plus their span-key
+    encodings, the expensive host half of the bucket — run on the prefetch
+    pipeline (exec/pipeline.py) while bucket b's spans compute on the
+    consumer thread, double-buffered under the pipeline depth/byte budgets
+    and cancel-safe on generator close. Off, the serial consumer-thread loop
+    is preserved bit-for-bit.
 
     Used above conf ``hyperspace.exec.stream.joinMinBytes`` (estimated from
     file sizes) by ``dispatch_bucketed_join``, and by
@@ -2036,8 +2062,12 @@ def stream_bucketed_join(session, plan: L.Join, _compat=None):
     keep_right = plan.how in ("right", "outer")
 
     hints = _stream_join_dtype_hints(plan, lside, rside, lcols_needed, rcols_needed)
+    parts = [b for b in range(nb) if b in lread or b in rread]
 
-    for b in range(nb):
+    def decode_pair(b):
+        """Producer half: both side decodes + span-key encoding (the
+        rank/int64 encode is the bucket's dominant host cost after decode,
+        so it prefetches too)."""
         lt, rt = lread.get(b), rread.get(b)
         lb = lt() if lt is not None else None
         rb = rt() if rt is not None else None
@@ -2045,15 +2075,8 @@ def stream_bucketed_join(session, plan: L.Join, _compat=None):
             lb = None
         if rb is not None and B.num_rows(rb) == 0:
             rb = None
-        if lb is None and rb is None:
-            continue
-        if lb is None and not keep_right:
-            continue
-        if rb is None and not keep_left:
-            continue
-        span_of = None
+        lk = rk = None
         if lb is not None and rb is not None:
-            lk = rk = None
             if len(lkeys) == 1:
                 try:
                     lk = _join_key_of(lb, lkeys[0])
@@ -2064,6 +2087,19 @@ def stream_bucketed_join(session, plan: L.Join, _compat=None):
                 lk, rk = _composite_ranks(
                     [lb[k] for k in lkeys], [rb[k] for k in rkeys]
                 )
+        return lb, rb, lk, rk
+
+    def expand(lb, rb, lk, rk):
+        """Consumer half: span walk + pair expansion; None when the bucket
+        contributes no output rows."""
+        if lb is None and rb is None:
+            return None
+        if lb is None and not keep_right:
+            return None
+        if rb is None and not keep_left:
+            return None
+        span_of = None
+        if lb is not None and rb is not None:
 
             def span_of(_b, lk=lk, rk=rk):
                 try:
@@ -2084,7 +2120,39 @@ def stream_bucketed_join(session, plan: L.Join, _compat=None):
             span_of,
             dtype_fallback=hints,
         )
-        if B.num_rows(chunk):
+        return chunk if B.num_rows(chunk) else None
+
+    conf = session.conf
+    if conf.join_pipeline_enabled and conf.pipeline_enabled and len(parts) > 1:
+        from hyperspace_tpu.exec.pipeline import ScanPipeline
+
+        def weigh(res):
+            lb, rb, _lk, _rk = res
+            return sum(_chunk_nbytes(s) for s in (lb, rb) if s is not None)
+
+        pipe = ScanPipeline(
+            [lambda b=b: decode_pair(b) for b in parts],
+            depth=conf.pipeline_depth,
+            max_buffered_bytes=conf.pipeline_max_buffered_bytes,
+            weigh=weigh,
+        )
+        try:
+            for lb, rb, lk, rk in pipe:
+                chunk = expand(lb, rb, lk, rk)
+                if chunk is not None:
+                    _count_join_stream_chunk()
+                    yield chunk
+        finally:
+            # generator close mid-stream lands here: cancel queued bucket
+            # decodes and wait out in-flight ones so neither side's readers
+            # outlive the stream (the pipeline cancel-safety contract)
+            pipe.close()
+        return
+
+    for b in parts:
+        chunk = expand(*decode_pair(b))
+        if chunk is not None:
+            _count_join_stream_chunk()
             yield chunk
 
 
@@ -2208,8 +2276,33 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
         except OSError:
             input_bytes = 0
         if input_bytes >= stream_min:
-            chunks = list(stream_bucketed_join(session, plan, _compat=compat))
-            if not chunks:
+            # fold chunks incrementally instead of list()-ing the whole
+            # stream: peak memory is O(merged result + one pending run), not
+            # O(result x2), and the generator is closed on any exit so both
+            # sides' bucket readers release mid-stream
+            gen = stream_bucketed_join(session, plan, _compat=compat)
+            merged = None
+            merged_bytes = 0
+            pending: List[B.Batch] = []
+            pending_bytes = 0
+            try:
+                for chunk in gen:
+                    pending.append(chunk)
+                    pending_bytes += _chunk_nbytes(chunk)
+                    # geometric fold: concat once the pending run reaches the
+                    # merged size, so total copy work stays O(result) while
+                    # at most one merged copy + one run are ever alive
+                    if merged is None or pending_bytes >= merged_bytes:
+                        batches = ([merged] if merged is not None else []) + pending
+                        merged = batches[0] if len(batches) == 1 else B.concat(batches)
+                        merged_bytes = _chunk_nbytes(merged)
+                        pending, pending_bytes = [], 0
+            finally:
+                gen.close()
+            if pending:
+                batches = ([merged] if merged is not None else []) + pending
+                merged = batches[0] if len(batches) == 1 else B.concat(batches)
+            if merged is None:
                 # an empty streamed result must NOT fall back to the generic
                 # merge — that materializes both multi-GiB sides, the OOM
                 # this path exists to prevent; type the empty batch from the
@@ -2225,9 +2318,7 @@ def dispatch_bucketed_join(session, plan: L.Join) -> B.Batch:
                     return {n: np.empty(0, dtype=hints[n]) for n in plan.output_columns}
                 raise DeviceUnsupported("streamed join produced no rows")
             trace.record("join", "host-span-smj-stream")
-            out = B.concat(chunks)
-            del chunks
-            return out
+            return merged
     setup = _bucketed_join_setup(session, plan, compat)
     # the device span program's round trip is EXACTLY computable here: the
     # buckets are already decoded, and the key matrices are rectangles of

@@ -711,6 +711,13 @@ class Executor:
                 proj = None
                 if isinstance(node, L.Project):
                     proj, node = list(node.columns), node.child
+                # a Filter directly above a Join fuses into the streaming
+                # join paths (per-chunk mask for the SMJ, jitted post-join
+                # program for the broadcast probe) instead of forcing the
+                # materialized shape
+                post_filter = None
+                if isinstance(node, L.Filter) and isinstance(node.child, L.Join):
+                    post_filter, node = node.condition, node.child
                 if isinstance(node, L.Join) and self.session.conf.device_execution_enabled:
                     try:
                         from hyperspace_tpu.exec import device as D
@@ -725,11 +732,37 @@ class Executor:
                         except D.DeviceUnsupported:
                             gen = None
                         if gen is not None:
+                            from hyperspace_tpu.plan.expr import as_bool_mask
+
+                            def shape(chunk):
+                                if post_filter is not None:
+                                    chunk = B.mask_rows(
+                                        chunk, as_bool_mask(post_filter.eval(chunk))
+                                    )
+                                return B.select(chunk, proj) if proj else chunk
+
                             trace.record("join", "host-span-smj-stream")
-                            yield B.select(first, proj) if proj else first
+                            yield shape(first)
                             for chunk in gen:
-                                yield B.select(chunk, proj) if proj else chunk
+                                yield shape(chunk)
                             return
+                    if D is not None:
+                        from hyperspace_tpu.exec import join_stream as JS
+
+                        if JS.broadcast_spec(self.session, node) is not None:
+                            gen = JS.stream_broadcast_join(
+                                self, node, post_filter=post_filter, project=proj
+                            )
+                            try:
+                                first = next(gen)
+                            except StopIteration:
+                                return
+                            except D.DeviceUnsupported:
+                                gen = None
+                            if gen is not None:
+                                yield first
+                                yield from gen
+                                return
                 chain, leaf = _chain_to_scan(plan)
                 if leaf is not None:
                     files = _leaf_files(leaf)
@@ -1604,6 +1637,12 @@ class Executor:
             if D is not None:
                 try:
                     return D.dispatch_bucketed_join(self.session, plan)
+                except D.DeviceUnsupported:
+                    pass  # next tier: broadcast hash join
+                try:
+                    from hyperspace_tpu.exec import join_stream as JS
+
+                    return JS.dispatch_broadcast_join(self, plan)
                 except D.DeviceUnsupported:
                     trace.fallback("join", "unsupported")
         trace.record("join", "generic-merge")

@@ -169,6 +169,13 @@ class QueryServer:
             int(opt("bucket_cache_bytes", conf.serving_bucket_cache_bytes)),
             prefetch_workers=int(opt("prefetch_workers", conf.serving_prefetch_workers)),
         )
+        # shared broadcast-join build sides (exec/join_stream.py consults
+        # session.join_build_cache while this server is attached)
+        from hyperspace_tpu.serving.build_cache import JoinBuildCache
+
+        self.join_build_cache = JoinBuildCache(
+            int(opt("join_build_cache_bytes", conf.join_build_cache_max_bytes))
+        )
         # every server labels its series in the process-wide registry (a
         # private registry when metrics are conf'd off, so accounting still
         # works but nothing is published)
@@ -180,6 +187,7 @@ class QueryServer:
         self.admission.bind_registry(self.registry, server=self.server_name)
         self.plan_cache.bind_registry(self.registry, server=self.server_name)
         self.bucket_cache.bind_registry(self.registry, server=self.server_name)
+        self.join_build_cache.bind_registry(self.registry, server=self.server_name)
         if self.result_cache is not None:
             self.result_cache.bind_registry(self.registry, server=self.server_name)
         self.tracing_enabled = bool(conf.obs_tracing_enabled)
@@ -239,6 +247,7 @@ class QueryServer:
         self._started = False
         self._closed = False
         self._prev_bucket_cache = None
+        self._prev_join_build_cache = None
 
     def _telemetry_path(self, *parts) -> Optional[str]:
         """A path under ``<system.path>/_telemetry`` (the index log
@@ -283,6 +292,8 @@ class QueryServer:
         # executor-side scans consult session.bucket_cache when present
         self._prev_bucket_cache = getattr(self.session, "bucket_cache", None)
         self.session.bucket_cache = self.bucket_cache
+        self._prev_join_build_cache = getattr(self.session, "join_build_cache", None)
+        self.session.join_build_cache = self.join_build_cache
         for i in range(self.workers_n):
             t = threading.Thread(target=self._worker, name=f"hs-serve-{i}", daemon=True)
             t.start()
@@ -306,6 +317,7 @@ class QueryServer:
                 req.future.set_exception(ServerClosed("server shut down"))
         self.bucket_cache.shutdown()
         self.session.bucket_cache = self._prev_bucket_cache
+        self.session.join_build_cache = self._prev_join_build_cache
         if self.telemetry is not None:
             self.telemetry.close()
             self.telemetry = None

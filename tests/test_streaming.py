@@ -668,6 +668,9 @@ class TestPartitionedGenericJoin:
     def test_matches_unpartitioned(self, tmp_path, how):
         ld, rd = _join_fixture(tmp_path, skew_side=(how == "outer"))
         sess = _mk_session(tmp_path)  # no indexes -> generic merge path
+        # the broadcast hash join would claim these small sides first; this
+        # test targets the partitioned generic merge specifically
+        sess.conf.set(hst.keys.EXEC_JOIN_BROADCAST_MAX_BYTES, 0)
         left = sess.read_parquet(ld)
         right = sess.read_parquet(rd)
         q = left.join(right, on=hst.col("lk") == hst.col("rk"), how=how).select(
