@@ -46,6 +46,11 @@ chain (fact joined through two broadcast dimensions, filter + projection on
 top) streamed cold-cache with the prefetch pipeline on vs off, byte-identity
 and probe-executable-count checks, plus shared-build-side hit counting under
 micro-batched serving. Bar: >= 1.5x pipelined/serial. Writes BENCH_join.json.
+
+``--refresh`` runs the lifecycle benchmark: serving latency percentiles while
+the refresh manager commits incremental refreshes concurrently vs a quiesced
+baseline, with every served result checked for staleness/torn visibility
+(the count must be zero). Writes BENCH_refresh.json.
 """
 
 from __future__ import annotations
@@ -1308,6 +1313,151 @@ def main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def refresh_main() -> None:
+    """``python bench.py --refresh``: serving under concurrent refresh.
+
+    One marker-file dataset behind a covering index and a QueryServer. Phase
+    one measures per-query latency quiesced; phase two repeats the identical
+    load while a driver thread appends files and commits incremental
+    refreshes through the lifecycle ``RefreshManager``. Every served result
+    is validated like the soak test: each file's marker rows appear
+    all-or-nothing (torn check) and every marker whose refresh committed
+    before submission is present (staleness check) — ``staleness_rejections``
+    in the JSON must be 0. ``vs_baseline`` is quiesced p99 / under-refresh
+    p99 (1.0 = refresh is latency-free).
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    import threading
+
+    rows_per_file = int(os.environ.get("BENCH_REFRESH_ROWS", 20_000))
+    queries = max(8, int(os.environ.get("BENCH_REFRESH_QUERIES", 60)))
+    initial_files = 4
+    tmp = tempfile.mkdtemp(prefix="hs_bench_refresh_")
+    try:
+        import jax
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.lifecycle import RefreshManager
+        from hyperspace_tpu.serving import QueryServer
+
+        data_dir = os.path.join(tmp, "marked")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+
+        def write_marked(marker: int) -> None:
+            t = pa.table(
+                {
+                    "c1": (np.arange(rows_per_file, dtype=np.int64) * 13) % 1000,
+                    "m": np.full(rows_per_file, marker, dtype=np.int64),
+                }
+            )
+            final = os.path.join(data_dir, f"part-{marker:05d}.parquet")
+            pq.write_table(t, final + ".tmp")
+            os.replace(final + ".tmp", final)
+
+        for i in range(initial_files):
+            write_marked(i)
+
+        sess = hst.Session(conf={hst.keys.SYSTEM_PATH: sys_dir, hst.keys.NUM_BUCKETS: 8})
+        hst.set_session(sess)
+        sess.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+        sess.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.95)
+        sess.conf.set(hst.keys.HYBRID_SCAN_MAX_DELETED_RATIO, 0.95)
+        hs = hst.Hyperspace(sess)
+        hs.create_index(
+            sess.read_parquet(data_dir), hst.CoveringIndexConfig("bixr", ["c1"], ["m"])
+        )
+        sess.enable_hyperspace()
+        rm = RefreshManager(sess)
+        bus = sess.lifecycle_bus
+
+        state_lock = threading.Lock()
+        committed = list(range(initial_files))
+        violations = []
+
+        def check(res, need):
+            vals, cnts = np.unique(res["m"], return_counts=True)
+            seen = dict(zip(vals.tolist(), cnts.tolist()))
+            for mk, c in seen.items():
+                if c != rows_per_file:
+                    violations.append(("torn", int(mk), int(c)))
+            for mk in need:
+                if seen.get(mk) != rows_per_file:
+                    violations.append(("stale", int(mk), seen.get(mk)))
+
+        def run_phase(srv, refreshing: bool):
+            stop = threading.Event()
+            next_marker = [len(committed)]
+
+            def driver():
+                while not stop.is_set():
+                    marker = next_marker[0]
+                    next_marker[0] += 1
+                    write_marked(marker)
+                    if rm.refresh_index("bixr", "incremental") == "committed":
+                        with state_lock:
+                            committed.append(marker)
+
+            t = threading.Thread(target=driver) if refreshing else None
+            if t is not None:
+                t.start()
+            lats = []
+            try:
+                for _ in range(queries):
+                    with state_lock:
+                        need = list(committed)
+                    q = sess.read_parquet(data_dir).filter(hst.col("c1") >= 0).select("m")
+                    t0 = time.perf_counter()
+                    res = srv.submit(q).result(timeout=300)
+                    lats.append(time.perf_counter() - t0)
+                    check(res, need)
+            finally:
+                stop.set()
+                if t is not None:
+                    t.join(60)
+            return lats
+
+        with QueryServer(sess, workers=2, queue_depth=65536) as srv:
+            # warm: compile + first decode
+            srv.submit(sess.read_parquet(data_dir).filter(hst.col("c1") >= 0).select("m")).result(
+                timeout=300
+            )
+            seq0 = bus.commit_seq
+            quiesced = run_phase(srv, refreshing=False)
+            refreshed = run_phase(srv, refreshing=True)
+            commits = bus.commit_seq - seq0
+
+        def pct(xs, p):
+            return float(np.percentile(np.asarray(xs), p))
+
+        p99_q, p99_r = pct(quiesced, 99), pct(refreshed, 99)
+        out = {
+            "metric": "serving_p99_under_refresh_seconds",
+            "value": round(p99_r, 4),
+            "unit": "s",
+            "vs_baseline": round(p99_q / p99_r, 4) if p99_r > 0 else 1.0,
+            "platform": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "quiesced": {"p50": round(pct(quiesced, 50), 4), "p99": round(p99_q, 4)},
+            "under_refresh": {"p50": round(pct(refreshed, 50), 4), "p99": round(p99_r, 4)},
+            "refresh_commits": commits,
+            "queries_per_phase": queries,
+            "staleness_rejections": len(violations),
+        }
+        line = json.dumps(out)
+        with open("BENCH_refresh.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+        if violations:
+            raise SystemExit(f"refresh bench served stale/torn results: {violations[:10]}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         serve_main()
@@ -1327,5 +1477,7 @@ if __name__ == "__main__":
         check_overhead_main()
     elif "--join" in sys.argv[1:]:
         join_main()
+    elif "--refresh" in sys.argv[1:]:
+        refresh_main()
     else:
         main()

@@ -22,6 +22,7 @@ _RULE_MODULES = (
     "lock_blocking",
     "cache_branding",
     "jit_purity",
+    "snapshot_pin",
 )
 
 

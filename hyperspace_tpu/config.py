@@ -140,6 +140,15 @@ class keys:
     # lock-order watcher. Both default off — they are CI/diagnostic tools.
     CHECK_HLO_ENABLED = "hyperspace.check.hlo.enabled"
     CHECK_LOCKS = "hyperspace.check.locks"
+    # Live-data lifecycle (hyperspace_tpu/lifecycle/): per-request snapshot
+    # pinning, the background refresh manager, and the device lineage
+    # anti-semi-join for hybrid-scan delete filtering.
+    LIFECYCLE_SNAPSHOT_ENABLED = "hyperspace.lifecycle.snapshot.enabled"
+    LIFECYCLE_REFRESH_ENABLED = "hyperspace.lifecycle.refresh.enabled"
+    LIFECYCLE_REFRESH_INTERVAL_SECONDS = "hyperspace.lifecycle.refresh.intervalSeconds"
+    LIFECYCLE_REFRESH_MODE = "hyperspace.lifecycle.refresh.mode"
+    LIFECYCLE_DEVICE_LINEAGE_ENABLED = "hyperspace.lifecycle.deviceLineage.enabled"
+    LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS = "hyperspace.lifecycle.deviceLineage.minRows"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -384,6 +393,26 @@ DEFAULTS: Dict[str, Any] = {
     # acquisition-order cycle detection). Construction-time flag: locks
     # created before a Session enabled it stay plain.
     keys.CHECK_LOCKS: False,
+    # Pin a SnapshotHandle (index-log roster frozen at admission) per served
+    # request, so a refresh committing mid-flight never changes a running
+    # query's answer (docs/lifecycle.md).
+    keys.LIFECYCLE_SNAPSHOT_ENABLED: True,
+    # Run the background RefreshManager alongside serving; off by default —
+    # refreshes are an explicit operational decision.
+    keys.LIFECYCLE_REFRESH_ENABLED: False,
+    # Seconds between RefreshManager drift polls.
+    keys.LIFECYCLE_REFRESH_INTERVAL_SECONDS: 5.0,
+    # Refresh mode the manager schedules: "auto" picks incremental when the
+    # appended/deleted ratios exceed the hybrid-scan thresholds (the index
+    # would stop qualifying for hybrid scan) and quick otherwise; or pin
+    # "incremental" / "quick" / "full" explicitly.
+    keys.LIFECYCLE_REFRESH_MODE: "auto",
+    # Evaluate the hybrid-scan deleted-row filter (NOT IN over the lineage
+    # column) as a fused device anti-semi-join instead of host set ops.
+    keys.LIFECYCLE_DEVICE_LINEAGE_ENABLED: True,
+    # Below this row count the host np.isin oracle wins (device dispatch
+    # overhead); counted as hs_device_fallback_total{op="lineage"}.
+    keys.LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS: 4096,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -811,6 +840,30 @@ class HyperspaceConf:
     @property
     def check_locks_enabled(self) -> bool:
         return bool(self.get(keys.CHECK_LOCKS))
+
+    @property
+    def lifecycle_snapshot_enabled(self) -> bool:
+        return bool(self.get(keys.LIFECYCLE_SNAPSHOT_ENABLED))
+
+    @property
+    def lifecycle_refresh_enabled(self) -> bool:
+        return bool(self.get(keys.LIFECYCLE_REFRESH_ENABLED))
+
+    @property
+    def lifecycle_refresh_interval_seconds(self) -> float:
+        return float(self.get(keys.LIFECYCLE_REFRESH_INTERVAL_SECONDS))
+
+    @property
+    def lifecycle_refresh_mode(self) -> str:
+        return str(self.get(keys.LIFECYCLE_REFRESH_MODE)).lower()
+
+    @property
+    def lifecycle_device_lineage_enabled(self) -> bool:
+        return bool(self.get(keys.LIFECYCLE_DEVICE_LINEAGE_ENABLED))
+
+    @property
+    def lifecycle_device_lineage_min_rows(self) -> int:
+        return int(self.get(keys.LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS))
 
     def deltas(self) -> Dict[str, Any]:
         """Explicitly-set keys whose value differs from the centralized

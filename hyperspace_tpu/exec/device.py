@@ -567,6 +567,31 @@ def clear_device_cache() -> None:
     _CAP_HINT_MEMO.clear()
 
 
+def purge_device_cache_files(paths) -> int:
+    """Drop every resident device column whose scan covers any of ``paths``
+    (data-version commit invalidation); returns entries removed.
+
+    Cache keys are ``(scan_key, col, mesh_fp)`` where scan_key is a tuple of
+    ``(path, mtime_ns, size)`` file triples (optionally suffixed with a
+    row-group-pruning marker), so a purge scans those leading triples.
+    """
+    wanted = set(paths)
+    if not wanted:
+        return 0
+    removed = 0
+    for key in _device_cache.keys():
+        scan_key = key[0]
+        if not isinstance(scan_key, tuple):
+            continue
+        hit = any(
+            isinstance(part, tuple) and part and part[0] in wanted
+            for part in scan_key
+        )
+        if hit and _device_cache.discard(key):
+            removed += 1
+    return removed
+
+
 def _cached_predicate_jit(skeleton: str, fn):
     import jax
 

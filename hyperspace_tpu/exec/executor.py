@@ -1168,6 +1168,24 @@ class Executor:
             predicate=predicate,
         )
 
+    @staticmethod
+    def _lineage_not_in(condition) -> Optional[Tuple[str, list]]:
+        """Match the hybrid-scan delete filter ``NOT (col IN int-literals)``
+        (rules/utils._hybrid_scan_plan); returns (column, ids) or None."""
+        from hyperspace_tpu.plan.expr import Col, In, Lit, Not
+
+        if not (isinstance(condition, Not) and isinstance(condition.child, In)):
+            return None
+        inner = condition.child
+        if not isinstance(inner.child, Col):
+            return None
+        ids = []
+        for lit in inner.values:
+            if not (isinstance(lit, Lit) and isinstance(lit.value, (int, np.integer))):
+                return None
+            ids.append(int(lit.value))
+        return inner.child.name, ids
+
     def _filter_mask(self, plan: L.Filter, child: B.Batch, pruned_by=None) -> np.ndarray:
         """Predicate evaluation: device path over index/file scans when the
         session mesh is available, host numpy otherwise. ``pruned_by`` is the
@@ -1175,6 +1193,35 @@ class Executor:
         if self.session.conf.device_execution_enabled and isinstance(
             plan.child, (L.IndexScan, L.FileScan)
         ):
+            # hybrid-scan lineage delete filter: fused device anti-semi-join
+            # instead of the general predicate path (which has no IN support)
+            # or the host NumPy set-op
+            lineage = self._lineage_not_in(plan.condition)
+            if lineage is not None and self.session.conf.lifecycle_device_lineage_enabled:
+                if B.num_rows(child) >= self.session.conf.lifecycle_device_lineage_min_rows:
+                    from hyperspace_tpu.exec import device as D
+                    from hyperspace_tpu.exec.lineage import lineage_delete_mask
+
+                    col, ids = lineage
+                    px = _maybe_parallel(self.session, B.num_rows(child))
+                    try:
+                        mask = lineage_delete_mask(
+                            self.session,
+                            child,
+                            col,
+                            ids,
+                            scan_key=_pruned_scan_key(_scan_identity(plan.child), pruned_by),
+                            parallel=px,
+                        )
+                        trace.record("filter", "device-lineage")
+                        return mask
+                    except D.DeviceUnsupported:
+                        trace.record("filter", "host-fallback")
+                        trace.fallback("lineage", "unsupported")
+                        return as_bool_mask(plan.condition.eval(child))
+                trace.fallback("lineage", "min-rows")
+                trace.record("filter", "host")
+                return as_bool_mask(plan.condition.eval(child))
             if B.num_rows(child) >= self.session.conf.device_exec_min_rows:
                 from hyperspace_tpu.exec import device as D
 

@@ -82,6 +82,31 @@ def clear_io_cache() -> None:
     _io_cache.clear()
 
 
+def _key_mentions_path(key, paths) -> bool:
+    # cache keys are nested tuples whose leaves include the source path
+    # string: file keys are (path, mtime, size, cols), concat keys wrap a
+    # tuple of per-file keys, row-group keys append a suffix tuple — a
+    # recursive scan covers every shape without coupling to each layout
+    if isinstance(key, str):
+        return key in paths
+    if isinstance(key, tuple):
+        return any(_key_mentions_path(part, paths) for part in key)
+    return False
+
+
+def purge_io_cache(paths) -> int:
+    """Drop every cached batch derived from any of ``paths`` (data-version
+    commit invalidation); returns the number of entries removed."""
+    wanted = set(paths)
+    if not wanted:
+        return 0
+    removed = 0
+    for key in _io_cache.keys():
+        if _key_mentions_path(key, wanted) and _io_cache.discard(key):
+            removed += 1
+    return removed
+
+
 _DECODE_POOL = None
 _DECODE_POOL_LOCK = threading.Lock()
 _DECODE_POOL_SIZE = None  # width the live pool was created with

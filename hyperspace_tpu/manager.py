@@ -20,6 +20,7 @@ from hyperspace_tpu.models.data_manager import IndexDataManager, IndexDataManage
 from hyperspace_tpu.models.log_entry import IndexLogEntry
 from hyperspace_tpu.models.log_manager import IndexLogManager, IndexLogManagerFactory
 from hyperspace_tpu.models.path_resolver import PathResolver
+from hyperspace_tpu.lifecycle.snapshot import current_snapshot
 from hyperspace_tpu.utils.cache import TTLCache
 
 
@@ -88,11 +89,21 @@ class IndexCollectionManager:
         return OptimizeAction(self.session, name, log_m, data_m, mode.lower()).run()
 
     # --- reads (ref: IndexCollectionManager.scala indexes) -----------------
+    # Both reads consult the lifecycle snapshot pin first: inside a
+    # snapshot_scope every roster resolution returns the version captured at
+    # admission, so a refresh committing mid-flight cannot change a running
+    # query's answer (lifecycle/snapshot.py has the invariant).
     def get_index(self, name: str) -> Optional[IndexLogEntry]:
+        pin = current_snapshot()
+        if pin is not None:
+            return pin.get_index(name)
         log_m, _, _ = self._managers(name)
         return log_m.get_latest_stable_log()
 
     def get_indexes(self, accepted_states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        pin = current_snapshot()
+        if pin is not None:
+            return pin.get_indexes(accepted_states)
         accepted = set(accepted_states or states.STABLE_STATES)
         out = []
         for path in self.path_resolver.all_index_paths():
@@ -137,6 +148,12 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         self._cache.clear()
 
     def get_indexes(self, accepted_states: Optional[List[str]] = None) -> List[IndexLogEntry]:
+        # pin check BEFORE the TTL cache: a pinned request must not read the
+        # cache (its version may be newer than the pin) and, worse, a cache
+        # miss under a pin would store the *pinned* roster for everyone else
+        pin = current_snapshot()
+        if pin is not None:
+            return pin.get_indexes(accepted_states)
         cached = self._cache.get()
         if cached is None:
             cached = super().get_indexes(list(states.STABLE_STATES))
@@ -150,23 +167,66 @@ class CachingIndexCollectionManager(IndexCollectionManager):
         finally:
             self.clear_cache()
 
+    # --- lifecycle commit publication --------------------------------------
+    def _pre_mutation_entry(self, name):
+        """The entry as it stands before a mutation — read straight from the
+        log (not the TTL cache, not any snapshot pin) so the commit event
+        names exactly the files the mutation superseded."""
+        try:
+            log_m, _, _ = self._managers(name)
+            return log_m.get_latest_stable_log()
+        except Exception:
+            return None
+
+    def _publish_commit(self, kind, name, old, new):
+        """Publish one CommitEvent on the session bus after a successful
+        mutation. Affected files = the previous entry's index data files
+        (superseded/rewritten) + source files the commit dropped from
+        coverage — the set whose cached derivatives are now stale."""
+        affected = []
+        try:
+            if old is not None:
+                affected.extend(old.content.files)
+                old_src = {fi.name for fi in old.source_file_infos()}
+                new_src = (
+                    {fi.name for fi in new.source_file_infos()}
+                    if new is not None
+                    else set()
+                )
+                affected.extend(sorted(old_src - new_src))
+        except Exception:
+            affected = []  # defensive: a malformed entry must not fail the commit
+        from hyperspace_tpu.lifecycle.invalidation import CommitEvent
+
+        event = CommitEvent(name, getattr(new, "id", None), kind, affected)
+        self.session.lifecycle_bus.publish(event)
+
+    def _published(self, kind, name, fn, *args, **kwargs):
+        old = self._pre_mutation_entry(name)
+        entry = self._invalidating(fn, *args, **kwargs)
+        # only successful mutations publish: an exception above (including
+        # NoChangesException from an idempotent refresh retry) propagates
+        # before any event is emitted, so commit_seq counts real commits
+        self._publish_commit(kind, name, old, entry)
+        return entry
+
     def create(self, df, index_config):
-        return self._invalidating(super().create, df, index_config)
+        return self._published("create", index_config.index_name, super().create, df, index_config)
 
     def delete(self, name):
-        return self._invalidating(super().delete, name)
+        return self._published("delete", name, super().delete, name)
 
     def restore(self, name):
-        return self._invalidating(super().restore, name)
+        return self._published("restore", name, super().restore, name)
 
     def vacuum(self, name):
-        return self._invalidating(super().vacuum, name)
+        return self._published("vacuum", name, super().vacuum, name)
 
     def cancel(self, name):
-        return self._invalidating(super().cancel, name)
+        return self._published("cancel", name, super().cancel, name)
 
     def refresh(self, name, mode=C.REFRESH_MODE_FULL):
-        return self._invalidating(super().refresh, name, mode)
+        return self._published(f"refresh-{mode}", name, super().refresh, name, mode)
 
     def optimize(self, name, mode=C.OPTIMIZE_MODE_QUICK):
-        return self._invalidating(super().optimize, name, mode)
+        return self._published(f"optimize-{mode}", name, super().optimize, name, mode)

@@ -19,6 +19,7 @@ from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.rules.utils import (
     destructure_linear,
     hybrid_coverage_fraction,
+    hybrid_thresholds_ok,
     transform_plan_to_use_index,
 )
 
@@ -57,6 +58,8 @@ def _filter_column_filter(
         if not ctx.tag_reason_if_failed(
             covers, entry, scan, lambda: R.missing_required_col(required, indexed + included)
         ):
+            continue
+        if not hybrid_thresholds_ok(ctx, entry, scan):
             continue
         out.append(entry)
     return out

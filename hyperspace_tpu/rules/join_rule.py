@@ -29,6 +29,7 @@ from hyperspace_tpu.rules.context import RuleContext
 from hyperspace_tpu.rules.utils import (
     destructure_linear,
     hybrid_coverage_fraction,
+    hybrid_thresholds_ok,
     transform_plan_to_use_index,
 )
 
@@ -98,6 +99,8 @@ def _side_candidates(
         if not ctx.tag_reason_if_failed(
             covers, entry, scan, lambda: R.missing_required_col(required, indexed + included)
         ):
+            continue
+        if not hybrid_thresholds_ok(ctx, entry, scan):
             continue
         out.append(entry)
     return out

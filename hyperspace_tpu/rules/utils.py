@@ -68,6 +68,46 @@ def destructure_linear(plan: L.LogicalPlan) -> Optional[Tuple[Optional[List[str]
             return None
 
 
+def hybrid_thresholds_ok(ctx: RuleContext, entry: IndexLogEntry, scan: L.Scan) -> bool:
+    """Rule-time re-check of the hybrid-scan drift thresholds
+    (``hyperspace.index.hybridscan.maxDeletedRatio`` /
+    ``maxAppendedRatio``).
+
+    The candidate gate (``candidate._signature_filter``) enforces these at
+    collection time, but entries reach the rules through the TTL roster
+    cache with tags computed under the conf *of that moment* — and both the
+    conf and the source keep moving. Re-derive the byte ratios from the
+    current file diff and gate against the current thresholds, so
+    tightening a threshold (or drift accumulating past one) takes effect on
+    the very next rewrite instead of after the cache expires."""
+    conf = ctx.session.conf
+    if not entry.get_tag(L.plan_key(scan), R.HYBRIDSCAN_REQUIRED):
+        return True  # exact signature match: no drift to gate
+    current = {fi.key: fi for fi in scan.relation.all_file_infos()}
+    indexed = {fi.key: fi for fi in entry.source_file_infos()}
+    appended_bytes = sum(current[k].size for k in current.keys() - indexed.keys())
+    deleted_bytes = sum(indexed[k].size for k in indexed.keys() - current.keys())
+    # same denominators as candidate._signature_filter
+    if deleted_bytes:
+        deleted_ratio = deleted_bytes / max(1, entry.source_files_size())
+        if deleted_ratio > conf.hybrid_scan_deleted_ratio_threshold:
+            ctx.tag_reason_if_failed(
+                False, entry, scan,
+                lambda: R.too_many_deleted(deleted_ratio, conf.hybrid_scan_deleted_ratio_threshold),
+            )
+            return False
+    if appended_bytes:
+        total_bytes = sum(fi.size for fi in current.values())
+        appended_ratio = appended_bytes / max(1, total_bytes)
+        if appended_ratio > conf.hybrid_scan_appended_ratio_threshold:
+            ctx.tag_reason_if_failed(
+                False, entry, scan,
+                lambda: R.too_many_appended(appended_ratio, conf.hybrid_scan_appended_ratio_threshold),
+            )
+            return False
+    return True
+
+
 def pruned_buckets_for_predicate(
     condition: Optional[Expr], bucket_columns: Tuple[str, ...], num_buckets: int
 ) -> Optional[List[int]]:

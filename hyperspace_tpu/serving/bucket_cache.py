@@ -100,6 +100,19 @@ class BucketCache:
     def clear(self) -> None:
         self._lru.clear()
 
+    def purge_files(self, paths) -> int:
+        """Drop every cached bucket group containing any of ``paths``
+        (data-version commit invalidation); returns entries removed."""
+        wanted = set(paths)
+        if not wanted:
+            return 0
+        removed = 0
+        for key in self._lru.keys():
+            files = key[0]
+            if any(f in wanted for f in files) and self._lru.discard(key):
+                removed += 1
+        return removed
+
     def bind_registry(self, registry, **labels) -> None:
         """Publish cache accounting as callback gauges (see
         ``AdmissionController.bind_registry`` for the equality rationale)."""

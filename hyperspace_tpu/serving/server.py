@@ -44,6 +44,7 @@ from hyperspace_tpu.serving.result_cache import ResultCache, version_brand
 from hyperspace_tpu.serving.scheduler import CostAwareScheduler, classify_cost
 
 from hyperspace_tpu.check.locks import named_lock
+from hyperspace_tpu.lifecycle.snapshot import SnapshotHandle, snapshot_scope
 
 __all__ = ["QueryServer", "AdmissionRejected", "RequestTimeout", "ServerClosed"]
 
@@ -55,12 +56,13 @@ class _Request:
     __slots__ = (
         "plan", "fp", "token", "enabled", "future", "deadline", "submitted_at",
         "root", "tenant", "query_text", "cost_class", "brand", "dequeued_at",
-        "sched_charge",
+        "sched_charge", "snapshot",
     )
 
     def __init__(self, plan, fp: Fingerprint, token, enabled: bool, deadline, root=None,
                  tenant: str = "default", query_text: str = "",
-                 cost_class: str = "unknown", brand: Optional[str] = None):
+                 cost_class: str = "unknown", brand: Optional[str] = None,
+                 snapshot=None):
         self.plan = plan
         self.fp = fp
         self.token = token
@@ -81,6 +83,10 @@ class _Request:
         self.brand = brand
         self.dequeued_at: Optional[float] = None
         self.sched_charge = 0.0
+        # admission-time SnapshotHandle (None when pinning is off): workers
+        # enter snapshot_scope(self.snapshot) so every log-version resolution
+        # sees the roster this request was admitted against
+        self.snapshot = snapshot
 
     def expired(self) -> bool:
         return self.deadline is not None and time.monotonic() > self.deadline
@@ -355,22 +361,29 @@ class QueryServer:
             )
         with spans.attach(root):
             plan, fp = self._parse(query)
-        token = session_token(self.session, enabled)
-        cost_class = "unknown"
-        if self.history is not None:
-            cost_class = classify_cost(
-                self.history.estimate_cost(fp.structure),
-                self._interactive_s, self._heavy_s, self._min_confidence,
-            )
-        brand = None
-        if self.result_cache is not None:
-            # submit-time data-version brand: index-log roster + source
-            # snapshots; None (unsignable) bypasses the cache entirely
-            brand = version_brand(self.session, plan, enabled)
+        # pin the data version at admission: the token, the brand, and every
+        # later resolution in the worker read through this snapshot, so a
+        # refresh committing mid-flight never changes this request's answer
+        snapshot = None
+        if self.session.conf.lifecycle_snapshot_enabled:
+            snapshot = SnapshotHandle.capture(self.session)
+        with snapshot_scope(snapshot):
+            token = session_token(self.session, enabled)
+            cost_class = "unknown"
+            if self.history is not None:
+                cost_class = classify_cost(
+                    self.history.estimate_cost(fp.structure),
+                    self._interactive_s, self._heavy_s, self._min_confidence,
+                )
+            brand = None
+            if self.result_cache is not None:
+                # submit-time data-version brand: index-log roster + source
+                # snapshots; None (unsignable) bypasses the cache entirely
+                brand = version_brand(self.session, plan, enabled)
         req = _Request(
             plan, fp, token, enabled, self.admission.deadline_for(timeout),
             root=root, tenant=tenant, query_text=query_text,
-            cost_class=cost_class, brand=brand,
+            cost_class=cost_class, brand=brand, snapshot=snapshot,
         )
         if brand is not None:
             hit = self.result_cache.get(fp, brand, plan=plan)
@@ -522,7 +535,8 @@ class QueryServer:
         for r in reqs:
             try:
                 with spans.attach(r.root), spans.span("resolve-plan", cat="serving"):
-                    resolved.append((r, *self._resolve(r)))
+                    with snapshot_scope(r.snapshot):
+                        resolved.append((r, *self._resolve(r)))
             except Exception as exc:
                 self._fail(r, exc)
 
@@ -539,7 +553,10 @@ class QueryServer:
                 if ops_leaf is not None:
                     ops, leaf = ops_leaf
                     t0 = time.perf_counter()
-                    with self.session.hyperspace_scope(resolved[0][0].enabled):
+                    # same group key => same session token => same pinned
+                    # roster, so the first request's snapshot covers all
+                    with self.session.hyperspace_scope(resolved[0][0].enabled), \
+                            snapshot_scope(resolved[0][0].snapshot):
                         batches = execute_shared_scan(
                             self.session, ops, leaf, [b for _, b, _ in resolved]
                         )
@@ -562,7 +579,7 @@ class QueryServer:
                 continue
             try:
                 with spans.attach(r.root), spans.span("execute", cat="serving"):
-                    with self.session.hyperspace_scope(r.enabled):
+                    with self.session.hyperspace_scope(r.enabled), snapshot_scope(r.snapshot):
                         out_cols = list(entry.output_columns) if entry is not None else list(bound.output_columns)
                         batch = Executor(self.session).execute(
                             bound, required_columns=out_cols, prepruned=entry is not None

@@ -60,6 +60,7 @@ class Session:
         )
         self.hyperspace_enabled = False
         self._index_manager = None
+        self._lifecycle_bus = None
         self._mesh = None
         self._temp_views: Dict[str, Any] = {}
         # most recent QueryProfile from a traced collect() (obs tracing on)
@@ -176,6 +177,18 @@ class Session:
 
             self._index_manager = CachingIndexCollectionManager(self)
         return self._index_manager
+
+    # --- lifecycle commit bus ----------------------------------------------
+    @property
+    def lifecycle_bus(self):
+        """The session's commit/invalidation bus (lazy, one per session).
+        Every index mutation publishes here; snapshot pins read its commit
+        sequence. See hyperspace_tpu/lifecycle/invalidation.py."""
+        if self._lifecycle_bus is None:
+            from hyperspace_tpu.lifecycle.invalidation import InvalidationBus
+
+            self._lifecycle_bus = InvalidationBus(self)
+        return self._lifecycle_bus
 
     # --- query profiles (obs) ----------------------------------------------
     def last_query_profile(self):

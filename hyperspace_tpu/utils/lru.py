@@ -62,6 +62,16 @@ class BytesLRU:
         with self._lock:
             return list(self._entries.keys())
 
+    def discard(self, key: Hashable) -> bool:
+        """Drop one entry if present (targeted invalidation on data-version
+        commits); returns whether anything was removed."""
+        with self._lock:
+            got = self._entries.pop(key, None)
+            if got is None:
+                return False
+            self._bytes -= got[1]
+            return True
+
     def clear(self) -> None:
         with self._lock:
             self._entries.clear()

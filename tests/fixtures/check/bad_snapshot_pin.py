@@ -1,0 +1,8 @@
+"""Seeded snapshot-pin violations: log versions resolved past the pin."""
+
+
+def serves(self, session, name):
+    log_m, _, _ = session.index_manager._managers(name)
+    entry = log_m.get_latest_stable_log()  # bypasses the SnapshotHandle pin
+    latest = log_m.get_latest_log()  # so does the unstable variant
+    return entry, latest

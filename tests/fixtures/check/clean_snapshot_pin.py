@@ -1,0 +1,8 @@
+"""Clean twin of bad_snapshot_pin.py: resolution through the pin-aware API."""
+
+
+def serves(self, session, name, snapshot):
+    entry = session.index_manager.get_index(name)  # consults current_snapshot()
+    pinned = snapshot.get_index(name)  # or the handle directly
+    suppressed = self.log_manager.get_latest_stable_log()  # hscheck: disable=snapshot-pin
+    return entry, pinned, suppressed

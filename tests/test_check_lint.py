@@ -82,6 +82,17 @@ class TestRulePairs:
         # as is host numpy in a never-jitted helper.
         assert lint_one(fixture("clean_jit.py"), "jit-purity") == []
 
+    def test_snapshot_pin_bad(self):
+        found = lint_one(fixture("bad_snapshot_pin.py"), "snapshot-pin")
+        assert [f.line for f in found] == [6, 7]
+        assert "SnapshotHandle" in found[0].message
+        assert "get_latest_log" in found[1].message
+
+    def test_snapshot_pin_clean(self):
+        # Pin-aware manager reads, handle reads, and a pragma-suppressed
+        # direct resolver all pass.
+        assert lint_one(fixture("clean_snapshot_pin.py"), "snapshot-pin") == []
+
 
 class TestSuppression:
     def test_pragma(self):
@@ -104,6 +115,7 @@ class TestRunLint:
             "jit-purity",
             "lock-blocking",
             "metric-families",
+            "snapshot-pin",
         }
 
     def test_default_scope_excludes_tests(self):

@@ -1,0 +1,132 @@
+"""Long refresh-while-serving endurance runs (out of tier-1; the fast
+deterministic variant lives in test_lifecycle.py).
+
+Drives a QueryServer with continuous traffic while the lifecycle refresh
+manager commits appends (and, in the second test, deletes through lineage)
+and asserts the serving invariant from docs/lifecycle.md over many rounds:
+no torn results, no stale results, deletions invisible once committed."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu.lifecycle import RefreshManager
+
+from tests.test_e2e_rules import assert_batches_equal
+from tests.test_lifecycle import run_refresh_serving_soak, write_marked_part
+
+pytestmark = [pytest.mark.lifecycle, pytest.mark.soak, pytest.mark.slow]
+
+
+def test_soak_long_append_refresh_under_traffic(session, tmp_path):
+    out = run_refresh_serving_soak(
+        session, tmp_path, rounds=20, workers=4, initial_files=4, n=200
+    )
+    assert out["violations"] == [], out["violations"][:20]
+    assert out["commits"] == 20
+    assert out["queries"] >= 20  # sustained traffic actually overlapped commits
+
+    q = session.read_parquet(str(tmp_path / "soak")).filter(hst.col("c1") >= 0).select("m")
+    on = q.collect()
+    session.disable_hyperspace()
+    assert_batches_equal(on, q.collect())
+
+
+def test_soak_appends_and_deletes_with_lineage(session, tmp_path):
+    from hyperspace_tpu.serving import QueryServer
+
+    n = 150
+    root = tmp_path / "soakdel"
+    root.mkdir()
+    files = {}
+    for i in range(4):
+        files[i] = write_marked_part(str(root), i, n=n)
+
+    session.conf.set(hst.keys.HYBRID_SCAN_ENABLED, True)
+    session.conf.set(hst.keys.LINEAGE_ENABLED, True)
+    session.conf.set(hst.keys.HYBRID_SCAN_MAX_APPENDED_RATIO, 0.95)
+    session.conf.set(hst.keys.HYBRID_SCAN_MAX_DELETED_RATIO, 0.95)
+    session.conf.set(hst.keys.LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS, 1)
+    hs_api = hst.Hyperspace(session)
+    df = session.read_parquet(str(root))
+    hs_api.create_index(df, hst.CoveringIndexConfig("soakDelIdx", ["c1"], ["m"]))
+    session.enable_hyperspace()
+
+    rm = RefreshManager(session)
+    state_lock = threading.Lock()
+    committed = set(range(4))  # markers visible via a refresh commit
+    deleted = set()            # markers whose deletion has committed
+    violations = []
+    stop = threading.Event()
+    queries_done = [0]
+
+    def query_loop():
+        while not stop.is_set():
+            with state_lock:
+                need, gone = set(committed), set(deleted)
+            try:
+                q = session.read_parquet(str(root)).filter(hst.col("c1") >= 0).select("m")
+                res = server.submit(q).result(timeout=120)
+            except Exception as exc:
+                violations.append(("query-error", repr(exc)))
+                continue
+            vals, cnts = np.unique(res["m"], return_counts=True)
+            seen = dict(zip(vals.tolist(), cnts.tolist()))
+            for mk, c in seen.items():
+                if c != n:
+                    violations.append(("torn", mk, c))
+            for mk in need:
+                if seen.get(mk) != n:
+                    violations.append(("stale", mk, seen.get(mk)))
+            for mk in gone:
+                if mk in seen:
+                    violations.append(("undead", mk, seen[mk]))
+            queries_done[0] += 1
+
+    with QueryServer(session, workers=4) as server:
+        threads = [threading.Thread(target=query_loop) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            next_marker = 4
+            for r in range(16):
+                if r % 4 == 3 and len(committed - deleted) > 2:
+                    # delete the oldest still-visible marker, then commit
+                    victim = min(committed - deleted)
+                    with state_lock:
+                        committed.discard(victim)  # in-limbo until proven gone
+                    os.remove(files[victim])
+                    outcome = rm.refresh_index("soakDelIdx", "incremental")
+                    if outcome != "committed":
+                        violations.append(("refresh-del", victim, outcome))
+                        continue
+                    with state_lock:
+                        deleted.add(victim)
+                else:
+                    marker = next_marker
+                    next_marker += 1
+                    files[marker] = write_marked_part(str(root), marker, n=n)
+                    outcome = rm.refresh_index("soakDelIdx", "incremental")
+                    if outcome != "committed":
+                        violations.append(("refresh-add", marker, outcome))
+                        continue
+                    with state_lock:
+                        committed.add(marker)
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(60)
+
+    assert violations == [], violations[:20]
+    assert queries_done[0] >= 16
+
+    q = session.read_parquet(str(root)).filter(hst.col("c1") >= 0).select("m")
+    on = q.collect()
+    session.disable_hyperspace()
+    assert_batches_equal(on, q.collect())
+    assert sorted(np.unique(on["m"]).tolist()) == sorted(committed - deleted)
