@@ -625,6 +625,128 @@ def scan_pipeline_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def topk_main() -> None:
+    """``python bench.py --topk``: streaming device top-k benchmark.
+
+    ORDER BY k, v LIMIT 100 over key-clustered lake data (k sorted within
+    each file, the usual layout for time- or key-partitioned ingestion),
+    streamed device top-k vs the host materialize-and-sort path. Each
+    measured run uses a FRESH session (cold scan cache; the OS page cache is
+    warmed for both sides by a priming run) because the point of the top-k
+    fold is exactly to avoid materializing the scan: the device path decodes
+    only the row groups the running k-th-value threshold cannot prune, while
+    the host path decodes everything and stable-sorts two keys. Asserts the
+    top-k path actually dispatched (trace), byte-identical results, and zero
+    warm-run compiles. Baseline: >= 1.5x; writes BENCH_topk.json.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    num_files = int(os.environ.get("BENCH_TOPK_FILES", 8))
+    rows_per = int(os.environ.get("BENCH_TOPK_ROWS_PER_FILE", 500_000))
+    reps = max(1, int(os.environ.get("BENCH_TOPK_REPS", 3)))
+    limit_n = int(os.environ.get("BENCH_TOPK_LIMIT", 100))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_topk_")
+    try:
+        import hashlib
+
+        import jax
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.exec import trace
+        from hyperspace_tpu.obs.metrics import REGISTRY
+
+        data_dir = os.path.join(tmp, "events")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        rng = np.random.default_rng(11)
+        for i in range(num_files):
+            k = np.sort(rng.integers(0, 10_000_000, rows_per)).astype(np.int64)
+            pq.write_table(
+                pa.table(
+                    {
+                        "k": k,
+                        "v": rng.uniform(0.0, 1e6, rows_per),
+                        "w": rng.uniform(0.0, 100.0, rows_per),
+                    }
+                ),
+                os.path.join(data_dir, f"part-{i:05d}.parquet"),
+                compression="zstd",
+                row_group_size=50_000,
+            )
+
+        def run(topk: bool):
+            # fresh session per run: the scan cache must stay cold, or both
+            # sides skip the decode the top-k fold exists to avoid
+            sess = hst.Session(
+                conf={
+                    hst.keys.SYSTEM_PATH: sys_dir,
+                    hst.keys.EXEC_TOPK_ENABLED: topk,
+                    hst.keys.EXEC_STREAM_CHUNK_BYTES: 1,  # one file per chunk
+                }
+            )
+            hst.set_session(sess)
+            q = sess.read_parquet(data_dir).order_by("k", "v").limit(limit_n)
+            with trace.recording() as events:
+                t0 = time.perf_counter()
+                out = q.collect()
+                dt = time.perf_counter() - t0
+            return out, dt, events
+
+        compiles = REGISTRY.counter(
+            "hs_xla_compiles_total", "first-time XLA compilations (program x shape bucket)"
+        )
+        skipped = REGISTRY.counter("hs_rowgroups_skipped_total", "")
+        host_res, _, _ = run(False)  # warms the OS page cache for both sides
+        dev_res, cold_dev, ev = run(True)
+        if ("topk", "device-topk-stream") not in ev:
+            raise SystemExit(f"top-k path did not dispatch: {trace.summarize(ev)}")
+        c0, s0 = compiles.value, skipped.value
+        dev_times = [run(True)[1] for _ in range(reps)]
+        warm_compile_delta = compiles.value - c0
+        rg_skipped = (skipped.value - s0) / reps
+        host_times = [run(False)[1] for _ in range(reps)]
+        dt_dev, dt_host = min(dev_times), min(host_times)
+
+        def digest(batch) -> str:
+            h = hashlib.sha256()
+            for c in sorted(batch):
+                h.update(c.encode())
+                h.update(np.asarray(batch[c]).tobytes())
+            return h.hexdigest()
+
+        identical = digest(dev_res) == digest(host_res)
+        src_rows = num_files * rows_per
+        speedup = dt_host / dt_dev
+        out = {
+            "metric": "topk_stream_speedup",
+            "value": round(speedup, 3),
+            "unit": "x vs host sort",
+            "vs_baseline": round(speedup / 1.5, 4),  # baseline: 1.5x
+            "device_rows_per_sec": round(src_rows / dt_dev, 1),
+            "host_rows_per_sec": round(src_rows / dt_host, 1),
+            "cold_device_s": round(cold_dev, 4),
+            "warm_device_s": round(dt_dev, 4),
+            "host_s": round(dt_host, 4),
+            "limit": limit_n,
+            "source_rows": src_rows,
+            "rowgroups_skipped_per_run": round(rg_skipped, 1),
+            "byte_identical": bool(identical),
+            "warm_compile_delta": int(warm_compile_delta),
+            "platform": jax.default_backend(),
+        }
+        line = json.dumps(out)
+        with open("BENCH_topk.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+        if not identical:
+            raise SystemExit("top-k stream and host sort disagree")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def groupby_main() -> None:
     """``python bench.py --groupby``: device grouped-aggregation benchmark.
 
@@ -1469,6 +1591,8 @@ if __name__ == "__main__":
         scan_pipeline_main()
     elif "--groupby" in sys.argv[1:]:
         groupby_main()
+    elif "--topk" in sys.argv[1:]:
+        topk_main()
     elif "--mesh-child" in sys.argv[1:]:
         mesh_child_main()
     elif "--mesh" in sys.argv[1:]:

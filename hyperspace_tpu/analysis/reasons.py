@@ -121,6 +121,12 @@ def index_not_eligible(reason: str) -> FilterReason:
     return FilterReason("INDEX_NOT_ELIGIBLE", (("reason", reason),), reason)
 
 
+def sort_order_not_covered(reason: str) -> FilterReason:
+    """Sort elimination (streamed merge of sorted index runs,
+    plan/ordering.sort_run_eligibility) could not fire for a Sort node."""
+    return FilterReason("SORT_ORDER_NOT_COVERED", (("reason", reason),), reason)
+
+
 # Tag names (ref: HS/index/IndexLogEntryTags.scala:23-70)
 FILTER_REASONS = "FILTER_REASONS"
 COMMON_SOURCE_SIZE_IN_BYTES = "COMMON_SOURCE_SIZE_IN_BYTES"

@@ -143,10 +143,45 @@ def why_not_string(df, session, index_name: Optional[str] = None, extended: bool
                 "(details with extended=True)"
             )
     buf.append("=" * 64)
+    buf.extend(_sort_elimination_lines(new_plan))
     for entry in indexes:
         entry.unset_tag_for_all_plans(R.FILTER_REASONS)
         entry.unset_tag_for_all_plans(R.APPLICABLE_INDEX_RULES)
     return "\n".join(buf)
+
+
+def _sort_elimination_lines(new_plan: L.LogicalPlan) -> List[str]:
+    """Per-Sort verdicts over the OPTIMIZED plan: eliminated in favor of the
+    streamed sorted-run merge, or the reason it cannot fire (the planner half
+    lives in plan/ordering.sort_run_eligibility; the executor records the
+    same outcomes in dispatch traces)."""
+    from hyperspace_tpu.plan import ordering as ORD
+    from hyperspace_tpu.rules.apply import plans_including_subqueries
+
+    lines: List[str] = []
+    try:
+        sorts = []
+        for p in plans_including_subqueries(new_plan):
+            sorts.extend(L.collect(p, lambda x: isinstance(x, L.Sort)))
+        for s in sorts:
+            keys = ", ".join(f"{c}{'' if a else ' DESC'}" for c, a in s.keys)
+            leaf, _chain, reason = ORD.sort_run_eligibility(s)
+            if leaf is not None:
+                lines.append(
+                    f"Sort({keys}): eliminated — streamed merge of sorted index runs"
+                )
+            elif reason is not None:
+                lines.append(f"Sort({keys}): {R.sort_order_not_covered(reason)}")
+            else:
+                lines.append(
+                    f"Sort({keys}): "
+                    f"{R.sort_order_not_covered('child is not an index scan chain')}"
+                )
+    except Exception:
+        return []
+    if not lines:
+        return []
+    return ["Sort elimination:", "-" * len("Sort elimination:"), *lines, "=" * 64]
 
 
 def _subplan_label(scan: L.Scan) -> str:

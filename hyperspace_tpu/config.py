@@ -80,6 +80,13 @@ class keys:
     EXEC_AGG_DEVICE_GROUPED = "hyperspace.exec.agg.enabled"
     EXEC_AGG_MAX_GROUPS = "hyperspace.exec.agg.maxGroups"
     EXEC_AGG_CAPACITY_FLOOR = "hyperspace.exec.agg.capacityFloor"
+    # Streaming device top-k (exec/topk.py): ORDER BY ... LIMIT k over a
+    # chunked scan folds a device-resident candidate buffer instead of
+    # materializing + host-sorting; master switch, the largest k served on
+    # device, and the running-threshold row-group-pruning feedback toggle.
+    EXEC_TOPK_ENABLED = "hyperspace.exec.topk.enabled"
+    EXEC_TOPK_MAX_K = "hyperspace.exec.topk.maxK"
+    EXEC_TOPK_THRESHOLD_PUSHDOWN = "hyperspace.exec.topk.thresholdPushdown"
     # Query-serving runtime (hyperspace_tpu/serving/): concurrent request
     # admission, compiled-plan caching, micro-batching, bucket prefetch.
     SERVING_QUEUE_DEPTH = "hyperspace.serving.queueDepth"
@@ -294,6 +301,18 @@ DEFAULTS: Dict[str, Any] = {
     # (powers of sqrt(2)) above it so arbitrary cardinalities land on a
     # handful of cached executables.
     keys.EXEC_AGG_CAPACITY_FLOOR: 256,
+    # ORDER BY + LIMIT over multi-chunk scans executes as a streaming device
+    # top-k (exec/topk.py): per-chunk select + device-resident candidate
+    # merge, byte-identical to the host sort path. False routes back to
+    # materialize + host lexsort.
+    keys.EXEC_TOPK_ENABLED: True,
+    # Largest LIMIT the device top-k path serves; beyond it the candidate
+    # buffer would dominate chunk sizes and the host sort wins.
+    keys.EXEC_TOPK_MAX_K: 4096,
+    # Feed the running k-th-candidate key value back into parquet row-group
+    # min/max pruning as a dynamic filter (only row groups that provably
+    # cannot beat the current k-th candidate are skipped).
+    keys.EXEC_TOPK_THRESHOLD_PUSHDOWN: True,
     # Serving runtime. Queue depth bounds memory under overload: submits
     # beyond it are REJECTED (AdmissionRejected), never silently queued.
     keys.SERVING_QUEUE_DEPTH: 64,
@@ -644,6 +663,18 @@ class HyperspaceConf:
     @property
     def agg_capacity_floor(self) -> int:
         return int(self.get(keys.EXEC_AGG_CAPACITY_FLOOR))
+
+    @property
+    def topk_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_TOPK_ENABLED))
+
+    @property
+    def topk_max_k(self) -> int:
+        return int(self.get(keys.EXEC_TOPK_MAX_K))
+
+    @property
+    def topk_threshold_pushdown(self) -> bool:
+        return bool(self.get(keys.EXEC_TOPK_THRESHOLD_PUSHDOWN))
 
     # Serving runtime --------------------------------------------------------
     @property

@@ -970,6 +970,13 @@ def group_capacity(n: int, floor: int) -> int:
     return bucket_rows(max(1, int(n)), floor=max(1, int(floor)))
 
 
+def topk_capacity(k: int, floor: int = 64) -> int:
+    """Candidate-buffer capacity for a LIMIT ``k``: the same geometric
+    buckets over a small floor, so nearby limits (10, 12, 100...) land on a
+    handful of compiled top-k executables instead of one per distinct k."""
+    return bucket_rows(max(1, int(k)), floor=max(1, int(floor)))
+
+
 def _grouped_slots(aggs, is_int: Dict[str, bool]):
     """Decompose ``aggs`` into deduplicated mergeable state slots.
 

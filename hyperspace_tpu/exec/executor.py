@@ -29,6 +29,10 @@ from hyperspace_tpu.plan.expr import (
 )
 
 
+#: synthetic global-row-id column carried by the top-k host fallback
+_TOPK_RID = "__hs_topk_rid__"
+
+
 def _scan_identity(scan):
     """Stable identity of a scan's file set for device-side caching: any
     rewrite of a file (new index version, compaction) changes mtime/size and
@@ -708,6 +712,11 @@ class Executor:
                     yield {k: v for k, v in batch.items() if k != INPUT_FILE_NAME}
                     return
                 node = plan
+                if isinstance(node, L.Limit):
+                    gen = self._stream_limit_node(node)
+                    if gen is not None:
+                        yield from gen
+                        return
                 proj = None
                 if isinstance(node, L.Project):
                     proj, node = list(node.columns), node.child
@@ -779,7 +788,10 @@ class Executor:
             self._memo = {}
             self._shared = set()
 
-    def _stream_chunks(self, chain, leaf, groups, needed, leaf_only=False, stage_extra=None):
+    def _stream_chunks(
+        self, chain, leaf, groups, needed, leaf_only=False, stage_extra=None,
+        dynamic_pushdown=None,
+    ):
         """Yield one executed chain batch per file group, overlapping chunk
         k+1's decode + H2D staging with chunk k's execution via ScanPipeline
         (the tentpole's stage-1/2/3 split). Pushed-down Filter conditions are
@@ -793,7 +805,17 @@ class Executor:
         must still be able to run the chain over the same prefetched batch
         when it falls back mid-stream. ``stage_extra`` names additional
         columns (group keys, aggregate inputs) the H2D staging hook uploads
-        alongside the predicate columns."""
+        alongside the predicate columns.
+
+        ``dynamic_pushdown`` is a zero-arg callable returning a *currently
+        valid* extra pruning predicate (or None) — the top-k stream's running
+        k-th-value threshold. It is evaluated inside each chunk's decode
+        thunk, so prefetched chunks pick up whatever threshold the fold has
+        reached by the time their decode starts (stale thresholds are merely
+        conservative; pruning is row-group granularity and never row-exact).
+        H2D staging is disabled with a dynamic predicate: the branded scan
+        key changes per threshold, so staged columns would never be looked
+        up again."""
         conf = self.session.conf
         pushed = _chain_pushdown_condition(chain) if conf.rowgroup_pruning_enabled else None
         leaves, subs = [], []
@@ -804,10 +826,28 @@ class Executor:
             leaves.append(lf)
             subs.append(_rebuild_chain(chain, lf))
         wfns = [_plan_needs_file_names(s) for s in subs]
+
+        def apply_dynamic(i):
+            # refresh the chunk leaf's pruning predicate at decode time (the
+            # top-k threshold tightens as earlier chunks fold)
+            if dynamic_pushdown is None or not isinstance(
+                leaves[i], (L.FileScan, L.IndexScan)
+            ):
+                return
+            dp = dynamic_pushdown()
+            if dp is None:
+                return
+            from hyperspace_tpu.plan.expr import BinaryOp
+
+            leaves[i].pushdown_predicate = (
+                dp if pushed is None else BinaryOp("AND", pushed, dp)
+            )
+
         if not conf.pipeline_enabled or len(groups) < 2 or any(wfns):
             # leaf-batch prefetch can't also carry file-name columns; such
             # chains (rare: InputFileName in a filter) stay serial
             for i, (sub, wfn) in enumerate(zip(subs, wfns)):
+                apply_dynamic(i)
                 if leaf_only:
                     yield leaves[i], sub, self._exec(leaves[i], False)
                 else:
@@ -831,7 +871,11 @@ class Executor:
             and isinstance(leaves[0], (L.FileScan, L.IndexScan))
         ):
             dev_cond = chain[-1].condition
-        staging = D is not None and (dev_cond is not None or stage_extra)
+        staging = (
+            D is not None
+            and (dev_cond is not None or stage_extra)
+            and dynamic_pushdown is None
+        )
 
         def stage(i, batch):
             if B.num_rows(batch) < conf.device_exec_min_rows:
@@ -848,8 +892,12 @@ class Executor:
         def weigh(batch):
             return sum(int(getattr(a, "nbytes", 0)) for a in batch.values())
 
+        def decode(i):
+            apply_dynamic(i)
+            return self._exec(leaves[i], False)
+
         pipe = ScanPipeline(
-            [(lambda i=i: self._exec(leaves[i], False)) for i in range(len(leaves))],
+            [(lambda i=i: decode(i)) for i in range(len(leaves))],
             depth=max(1, conf.pipeline_depth),
             max_buffered_bytes=conf.pipeline_max_buffered_bytes,
             weigh=weigh,
@@ -1056,6 +1104,10 @@ class Executor:
             return self._exec_aggregate(plan, with_file_names)
 
         if isinstance(plan, L.Sort):
+            if not with_file_names:
+                got = self._try_sorted_run_merge(plan)
+                if got is not None:
+                    return got
             child = self._exec(plan.child, with_file_names)
             from hyperspace_tpu.plan.expr import get_column
 
@@ -1073,6 +1125,15 @@ class Executor:
             return {k: v[order] for k, v in child.items()}
 
         if isinstance(plan, L.Limit):
+            if isinstance(plan.child, L.Sort) and not with_file_names:
+                # ORDER BY ... LIMIT k: index-order merge first (no sort at
+                # all), then the streaming device top-k; both are
+                # byte-identical to host-sort-then-slice
+                got = self._try_sorted_run_merge(plan.child, limit=plan.n)
+                if got is None:
+                    got = self._try_streaming_topk(plan.child, plan.n)
+                if got is not None:
+                    return got
             child = self._exec(plan.child, with_file_names)
             return {k: v[: plan.n] for k, v in child.items()}
 
@@ -1300,6 +1361,268 @@ class Executor:
         if child is None:
             child = self._exec(plan.child, with_file_names)
         return host_aggregate(child, list(plan.keys), list(plan.aggs))
+
+    # -- streamed Limit shapes (execute_stream) -------------------------------
+
+    def _stream_limit_node(self, plan: L.Limit):
+        """Streamed execution of a root Limit: ORDER BY...LIMIT dispatches to
+        the sorted-run merge / device top-k (one result batch), a bare Limit
+        early-terminates the scan pipeline. Returns a generator, or None to
+        fall back to the materialized path."""
+        if isinstance(plan.child, L.Sort):
+            got = self._try_sorted_run_merge(plan.child, limit=plan.n)
+            if got is None:
+                got = self._try_streaming_topk(plan.child, plan.n)
+            if got is None:
+                return None
+
+            def one():
+                yield got
+
+            return one()
+        return self._stream_limit(plan)
+
+    def _stream_limit(self, plan: L.Limit):
+        """Early-terminating bare Limit: stop pulling source chunks once n
+        rows are collected. Closing the chunk generator propagates into
+        ScanPipeline.close(), which cancels every queued decode (the
+        mid-stream-close discipline of the streaming joins)."""
+        if plan.n <= 0:
+            return None
+        conf = self.session.conf
+        chain, leaf = _chain_to_scan(plan.child)
+        if leaf is None:
+            return None
+        files = _leaf_files(leaf)
+        groups = _chunk_files_by_bytes(files, max(1, conf.stream_chunk_bytes))
+        if len(groups) < 2:
+            return None
+        needed = _chain_needed_columns(chain) | set(plan.output_columns)
+
+        def gen():
+            remaining = int(plan.n)
+            chunks = self._stream_chunks(chain, leaf, groups, needed)
+            try:
+                for batch in chunks:
+                    batch = {c: v for c, v in batch.items() if c != INPUT_FILE_NAME}
+                    rows = B.num_rows(batch)
+                    if rows >= remaining:
+                        trace.record("limit", "early-stop-stream")
+                        yield {c: np.asarray(v)[:remaining] for c, v in batch.items()}
+                        return
+                    if rows:
+                        remaining -= rows
+                        yield batch
+            finally:
+                # deterministic cancel of queued decodes, even when our own
+                # consumer abandons mid-iteration
+                chunks.close()
+
+        return gen()
+
+    # -- streaming device top-k (ORDER BY ... LIMIT k) ------------------------
+
+    def _try_streaming_topk(self, sort_plan: L.Sort, k: int) -> Optional[B.Batch]:
+        """ORDER BY ... LIMIT k over a multi-chunk scan chain as a streaming
+        device top-k fold (exec/topk.TopKStream): no full materialization,
+        one compile per (key count, capacity, shape bucket), byte-identical
+        to host-sort-then-slice. Returns None (caller materializes) when the
+        shape or configuration doesn't stream."""
+        conf = self.session.conf
+        if not (conf.topk_enabled and conf.device_execution_enabled):
+            return None
+        if not sort_plan.keys or k <= 0 or k > conf.topk_max_k:
+            return None
+        chain, leaf = _chain_to_scan(sort_plan.child)
+        if leaf is None:
+            return None
+        files = _leaf_files(leaf)
+        if len(files) < 2:
+            return None
+        groups = _chunk_files_by_bytes(files, max(1, conf.stream_chunk_bytes))
+        if len(groups) < 2:
+            return None
+        try:
+            return self._streaming_topk(sort_plan, k, chain, leaf, groups)
+        except Exception:
+            # the streamed path must never break a query the materialized
+            # path can answer; visible in dispatch traces
+            trace.record("topk", "stream-fallback")
+            return None
+
+    def _streaming_topk(self, sort_plan, k, chain, leaf, groups) -> Optional[B.Batch]:
+        from hyperspace_tpu.exec import device as D
+        from hyperspace_tpu.exec.topk import TopKStream
+
+        conf = self.session.conf
+        needed = _chain_needed_columns(chain) | set(sort_plan.output_columns)
+        needed |= {c for c, _ in sort_plan.keys}
+        stream = TopKStream(
+            self.session, sort_plan.keys, k, parallel=_maybe_parallel(self.session)
+        )
+        # the running k-th-value threshold prunes row groups of chunks not
+        # yet decoded; only sound when pruning is on and the chain cannot
+        # rebind the primary key column
+        dynamic = None
+        if (
+            conf.topk_threshold_pushdown
+            and conf.rowgroup_pruning_enabled
+            and all(isinstance(nd, (L.Filter, L.Project)) for nd in chain)
+        ):
+            dynamic = stream.threshold_condition
+        host_parts: Optional[List[B.Batch]] = None
+        host_rid = 0
+        for batch in self._stream_chunks(
+            chain, leaf, groups, needed, dynamic_pushdown=dynamic
+        ):
+            batch = {c: v for c, v in batch.items() if c != INPUT_FILE_NAME}
+            if host_parts is None:
+                try:
+                    stream.update(batch)
+                    continue
+                except D.DeviceUnsupported as e:
+                    # mid-stream fallback: the pool is a superset of the
+                    # top-k of every folded row, so (pool + this and later
+                    # chunks) re-sorted on host stays byte-identical
+                    trace.fallback("topk", str(e) or type(e).__name__)
+                    host_parts = (
+                        [stream.pool_rows_with_rid(_TOPK_RID)]
+                        if stream.has_data
+                        else []
+                    )
+                    host_rid = stream.rows_seen
+            n = B.num_rows(batch)
+            part = dict(batch)
+            part[_TOPK_RID] = host_rid + np.arange(n, dtype=np.int64)
+            host_rid += n
+            host_parts.append(part)
+        if host_parts is None:
+            if not stream.has_data:
+                return None  # every chunk came back empty — materialize
+            trace.record(
+                "topk",
+                "device-topk-stream-sharded"
+                if stream.parallel is not None
+                else "device-topk-stream",
+            )
+            return stream.finalize()
+        parts = [p for p in host_parts if B.num_rows(p)]
+        if not parts:
+            return None
+        from hyperspace_tpu.plan.expr import get_column
+
+        merged = B.concat(parts)
+        # stable composite sort with the global row id as the base order —
+        # exactly the host Sort's tie semantics
+        order = np.argsort(np.asarray(merged[_TOPK_RID]), kind="stable")
+        for name, asc in reversed(sort_plan.keys):
+            arr = get_column(merged, name)
+            if arr is None:
+                raise KeyError(f"Sort key {name!r} not found")
+            codes = _key_codes(np.asarray(arr)[order], asc)
+            order = order[np.argsort(codes, kind="stable")]
+        take = order[:k]
+        trace.record("topk", "host-candidate-fallback")
+        return {c: np.asarray(v)[take] for c, v in merged.items() if c != _TOPK_RID}
+
+    # -- sort elimination: streamed merge of sorted index runs ----------------
+
+    def _try_sorted_run_merge(self, sort_plan: L.Sort, limit=None) -> Optional[B.Batch]:
+        """Replace a Sort whose order the covering index already provides
+        (within-bucket sort order, plan/ordering.sort_run_eligibility) with a
+        k-way merge of per-file runs. Why-not reasons land in dispatch traces
+        and the QueryProfile report when the rewrite cannot fire."""
+        from hyperspace_tpu.plan import ordering as ORD
+
+        leaf, chain, reason = ORD.sort_run_eligibility(sort_plan)
+        if leaf is None:
+            # record once per query: the bare-Sort call (the Limit wrapper
+            # retries through the Sort branch anyway)
+            if reason is not None and limit is None:
+                trace.record("sort", f"merge-why-not: {reason}")
+            return None
+        try:
+            return self._merge_sorted_runs(sort_plan, chain, leaf, limit)
+        except Exception:
+            trace.record("sort", "merge-fallback")
+            return None
+
+    def _merge_sorted_runs(self, sort_plan, chain, leaf, limit) -> Optional[B.Batch]:
+        import heapq
+
+        from hyperspace_tpu.plan.expr import get_column
+
+        files = _leaf_files(leaf)
+        if len(files) < 2:
+            return None  # a single run needs no merge; host path is fine
+        needed = _chain_needed_columns(chain) | set(sort_plan.output_columns)
+        needed |= {c for c, _ in sort_plan.keys}
+        runs = []
+        for f in files:
+            sub = _rebuild_chain(chain, _leaf_subset(leaf, [f], needed))
+            runs.append(self._exec(sub, False))
+        lens = [B.num_rows(r) for r in runs]
+        total = B.concat(runs)
+        n = B.num_rows(total)
+        bounds = np.cumsum([0] + lens)
+        # rank codes over the concatenation: one consistent code space for
+        # all runs, same NULLS LAST / DESC semantics as the host Sort
+        codes = []
+        for name, asc in sort_plan.keys:
+            arr = get_column(total, name)
+            if arr is None:
+                raise KeyError(f"Sort key {name!r} not found")
+            codes.append(_key_codes(np.asarray(arr), asc))
+
+        def run_monotone(s: int, e: int) -> bool:
+            if e - s < 2:
+                return True
+            lt = np.zeros(e - s - 1, dtype=bool)
+            eq = np.ones(e - s - 1, dtype=bool)
+            for c in codes:
+                seg = c[s:e]
+                lt |= eq & (seg[1:] < seg[:-1])
+                eq &= seg[1:] == seg[:-1]
+            return not lt.any()
+
+        run_orders = []
+        repaired = 0
+        for i in range(len(runs)):
+            s, e = int(bounds[i]), int(bounds[i + 1])
+            if run_monotone(s, e):
+                run_orders.append(np.arange(s, e, dtype=np.int64))
+            else:
+                # physical order disagrees with the requested order (NULL
+                # placement, float total-order rotation, stale layout):
+                # stable-repair the run; the merge stays byte-identical
+                repaired += 1
+                sl = np.lexsort(tuple(c[s:e] for c in reversed(codes)))
+                run_orders.append(s + sl.astype(np.int64))
+        # k-way heap merge; ties across runs resolve by global position ==
+        # the host stable sort's tie order (within a run the repair is
+        # stable, so sequential emission preserves it too)
+        take_n = n if limit is None else min(int(limit), n)
+        heap = []
+        for ro in run_orders:
+            if ro.size:
+                i0 = int(ro[0])
+                heapq.heappush(heap, (tuple(c[i0] for c in codes), i0, ro, 1))
+        out_idx = np.empty(take_n, dtype=np.int64)
+        taken = 0
+        while heap and taken < take_n:
+            _, idx, ro, nxt = heapq.heappop(heap)
+            out_idx[taken] = idx
+            taken += 1
+            if nxt < ro.size:
+                i0 = int(ro[nxt])
+                heapq.heappush(heap, (tuple(c[i0] for c in codes), i0, ro, nxt + 1))
+        trace.record(
+            "sort",
+            "index-order-merge"
+            + ("-limit" if limit is not None else "")
+            + (f"-repaired:{repaired}" if repaired else ""),
+        )
+        return {c: np.asarray(v)[out_idx] for c, v in total.items()}
 
     def _try_streaming_aggregate(self, plan: L.Aggregate) -> Optional[B.Batch]:
         """Out-of-core aggregate: when the child is a scan chain over more

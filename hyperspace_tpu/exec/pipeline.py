@@ -69,6 +69,16 @@ def _counters():
     )
 
 
+def _cancelled_counter():
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    return REGISTRY.counter(
+        "hs_pipeline_cancelled_total",
+        "Queued prefetch decodes cancelled by an early stream close (LIMIT "
+        "reached, consumer abandoned the stream)",
+    )
+
+
 class ScanPipeline:
     """Ordered bounded prefetch over a list of chunk-producing thunks.
 
@@ -170,9 +180,15 @@ class ScanPipeline:
         """Cancel queued prefetches and drain in-flight ones. Idempotent."""
         self._closed = True
         inflight = []
+        cancelled = 0
         for f in self._futures:
-            if f is not None and not f.done() and not f.cancel():
-                inflight.append(f)
+            if f is not None and not f.done():
+                if f.cancel():
+                    cancelled += 1
+                else:
+                    inflight.append(f)
+        if cancelled:
+            _cancelled_counter().inc(cancelled)
         for f in inflight:
             try:
                 f.result()

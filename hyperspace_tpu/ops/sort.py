@@ -171,3 +171,85 @@ def warm_build(n: int, kinds: Tuple[str, ...], key_dtypes: Sequence, num_buckets
 def padded_size(n: int) -> int:
     """Power-of-two size class for ``n`` rows (min 8)."""
     return max(8, 1 << (max(n - 1, 1)).bit_length())
+
+
+# --------------------------------------------------------------------------
+# streaming device top-k (ORDER BY ... LIMIT k without materialization)
+#
+# Both programs operate on a (num_keys + 1, P) int64 "plane matrix": one
+# signed-order NULLS-LAST plane per ORDER BY key (ops/encode.order_plane)
+# plus a trailing global-row-id plane that makes the sort total — equal keys
+# resolve by ascending row id, which IS the host stable-sort tie order.
+# Padding rows carry ORDER_PLANE_SENTINEL in every plane (including the row
+# id), so they cluster after all real rows and the host trims them by
+# ``rid < sentinel``. No traced scalars: one compile per (key count,
+# capacity, shape-bucket) triple, shared across every chunk of a stream.
+# --------------------------------------------------------------------------
+
+_TOPK_SENTINEL = np.int64(np.iinfo(np.int64).max)
+
+
+def _take_cap(col, cap: int, sentinel):
+    """First ``cap`` entries, sentinel-extended when the input is shorter
+    (static shapes: the pad amount is a trace-time constant)."""
+    p = col.shape[0]
+    if p >= cap:
+        return col[:cap]
+    return jnp.concatenate([col, jnp.full(cap - p, sentinel, dtype=col.dtype)])
+
+
+def topk_chunk_fn(num_keys: int, cap: int):
+    """Builder for the per-chunk select-top-k program: one multi-operand
+    ``lax.sort`` over the plane matrix, then the first ``cap`` rows of every
+    plane. Returns a (num_keys + 1, cap) candidate matrix."""
+
+    def run(planes):
+        ensure_x64()
+        ops = tuple(planes[i] for i in range(num_keys + 1))
+        out = lax.sort(ops, num_keys=num_keys + 1, is_stable=False)
+        return jnp.stack([_take_cap(o, cap, _TOPK_SENTINEL) for o in out])
+
+    return run
+
+
+def topk_merge_fn(num_keys: int, cap: int):
+    """Builder for the pairwise candidate merge: concatenate two capacity-
+    sized candidate matrices, sort, keep the first ``cap`` — the device-
+    resident fold step of TopKStream (GroupedAggStream._merge analog)."""
+
+    def run(a, b):
+        ensure_x64()
+        ops = tuple(
+            jnp.concatenate([a[i], b[i]]) for i in range(num_keys + 1)
+        )
+        out = lax.sort(ops, num_keys=num_keys + 1, is_stable=False)
+        return jnp.stack([o[:cap] for o in out])
+
+    return run
+
+
+# --- declared HLO contracts (hyperspace_tpu/check/hlo_lint.py), stated next
+# to the program builders like exec/device.py's families ---------------------
+from hyperspace_tpu.check import hlo_lint as _hlo_lint
+
+_hlo_lint.register_contract(
+    "topk-chunk",
+    collectives={"all-gather": (0, None)},
+    description=(
+        "chunk select-top-k: one multi-operand sort over key planes; the "
+        "GSPMD partitioner may gather fixed-size planes, never payload rows"
+    ),
+)
+_hlo_lint.register_contract(
+    "topk-merge",
+    collectives={},
+    description="pairwise top-k candidate merge: 2*cap fixed-size inputs, device-local, collective-free",
+)
+_hlo_lint.register_contract(
+    "sharded-topk",
+    collectives={"all-gather": (1, 1)},
+    description=(
+        "shard_map top-k chunk: per-shard select + EXACTLY one fixed-size "
+        "all-gather of candidate planes (never rows), replicated final merge"
+    ),
+)

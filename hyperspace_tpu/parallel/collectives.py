@@ -41,6 +41,55 @@ def sharded_elementwise(mesh, axis, fn):
     return mapped
 
 
+def sharded_topk_chunk_program(mesh, axis, num_keys, cap):
+    """Sharded twin of ``ops.sort.topk_chunk_fn``: same signature (one
+    ``(num_keys + 1, P)`` plane matrix in, one ``(num_keys + 1, cap)``
+    candidate matrix out), swapped in under ``device._program_key``'s
+    ``shmap`` mode tag by ``TopKStream``.
+
+    Per shard: one multi-operand ``lax.sort`` over the LOCAL plane rows and a
+    static take/pad to ``cap`` candidates. Then EXACTLY one fixed-size
+    ``all_gather`` of the per-shard candidate matrices — ``n_dev * cap``
+    *candidates* on the interconnect, never rows — and a replicated final
+    sort down to ``cap``. The trailing row-id plane makes the order total, so
+    the result is bit-identical to the single-device program on the same
+    matrix (registered HLO contract ``sharded-topk``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from hyperspace_tpu.ops.sort import _TOPK_SENTINEL, _take_cap
+
+    shard_map = get_shard_map()
+    n_dev = mesh.devices.size
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(None, axis),),
+        out_specs=P(),
+        check_rep=False,
+    )
+    def program(planes):
+        local = lax.sort(
+            tuple(planes[i] for i in range(num_keys + 1)),
+            num_keys=num_keys + 1,
+            is_stable=False,
+        )
+        mine = jnp.stack([_take_cap(o, cap, _TOPK_SENTINEL) for o in local])
+        gathered = jax.lax.all_gather(mine, axis)  # (n_dev, K+1, cap)
+        cat = jnp.transpose(gathered, (1, 0, 2)).reshape(num_keys + 1, n_dev * cap)
+        merged = lax.sort(
+            tuple(cat[i] for i in range(num_keys + 1)),
+            num_keys=num_keys + 1,
+            is_stable=False,
+        )
+        return jnp.stack([_take_cap(o, cap, _TOPK_SENTINEL) for o in merged])
+
+    return program
+
+
 def sharded_grouped_chunk_program(mesh, axis, pred_fn, key_specs, slot_specs, cap):
     """Sharded twin of ``device._grouped_chunk_program``: same signature
     ``program(cols, lits, n_valid, row_base)``, same outputs

@@ -112,6 +112,13 @@ class ApplyHyperspace:
         indexes = self.session.index_manager.get_indexes([states.ACTIVE])
         if not indexes:
             return original, 0
+        # stash the query's outermost ORDER BY requirement for the rankers:
+        # an order-covering index lets the executor eliminate the Sort into a
+        # streamed merge of sorted runs (plan/ordering.py), so equal-cost
+        # candidates tie-break toward it
+        from hyperspace_tpu.plan.ordering import required_ordering
+
+        self.ctx.scratch["required_ordering"] = required_ordering(plan)
         plan, sub_score = self._rewrite_subqueries(plan)
         # normalize: push required columns down to the scans (Catalyst runs
         # ColumnPruning before the reference's rules; this IR does it here)

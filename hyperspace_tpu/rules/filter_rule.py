@@ -65,18 +65,40 @@ def _filter_column_filter(
     return out
 
 
+def _order_covers(entry: IndexLogEntry, required) -> bool:
+    """Does the entry's within-bucket sort order (= its indexed columns)
+    satisfy the query's ORDER BY requirement? Only all-ascending key lists
+    that prefix the indexed columns qualify (plan/ordering's eligibility)."""
+    if not required:
+        return False
+    if any(not asc for _, asc in required):
+        return False
+    props = entry.derived_dataset.properties
+    indexed = [str(c).lower() for c in props.get("indexedColumns", [])]
+    want = [str(c).lower() for c, _ in required]
+    return indexed[: len(want)] == want
+
+
 def _rank(ctx: RuleContext, scan: L.Scan, candidates: List[IndexLogEntry]) -> Optional[IndexLogEntry]:
     """FilterRankFilter: smallest index; under hybrid scan, largest common
-    bytes (ref: HS/index/covering/FilterIndexRanker.scala:43-63)."""
+    bytes (ref: HS/index/covering/FilterIndexRanker.scala:43-63). Equal-size
+    candidates tie-break toward one whose sort order covers the query's
+    ORDER BY (stashed by ApplyHyperspace), which unlocks the executor's
+    sort-elimination merge — order-awareness never overrides the size rank,
+    so reference ranking (and approved-plan goldens) are unchanged."""
     if not candidates:
         return None
+    required = ctx.scratch.get("required_ordering")
     if ctx.session.conf.hybrid_scan_enabled:
         best = max(
             candidates,
             key=lambda e: (e.get_tag(L.plan_key(scan), R.COMMON_SOURCE_SIZE_IN_BYTES) or 0, -e.content.total_size),
         )
     else:
-        best = min(candidates, key=lambda e: (e.content.total_size, e.name))
+        best = min(
+            candidates,
+            key=lambda e: (e.content.total_size, not _order_covers(e, required), e.name),
+        )
     if ctx.analysis_enabled:
         for e in candidates:
             if e is not best:

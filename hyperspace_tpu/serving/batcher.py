@@ -35,6 +35,11 @@ def shared_scan_ops(template: L.LogicalPlan) -> Optional[Tuple[List[tuple], L.Lo
     p = template
     n_filters = 0
     seen_agg = False
+    # a root ORDER BY ... LIMIT cap batches too: the shared scan decodes
+    # once, each request top-k's its own masked rows afterwards
+    if isinstance(p, L.Limit) and isinstance(p.child, L.Sort) and p.child.keys:
+        ops.append(("topk", (int(p.n), [(str(c), bool(a)) for c, a in p.child.keys])))
+        p = p.child.child
     while True:
         if isinstance(p, (L.Scan, L.FileScan, L.IndexScan)):
             if n_filters == 0:
@@ -86,6 +91,9 @@ def execute_shared_scan(
     engine."""
     from hyperspace_tpu.exec.executor import Executor, aggregate_batch
 
+    topk = None
+    if ops and ops[0][0] == "topk":
+        topk, ops = ops[0][1], ops[1:]
     split = next((i for i, (kind, _) in enumerate(ops) if kind == "aggregate"), None)
     above = ops[:split] if split is not None else []
     agg = ops[split][1] if split is not None else None
@@ -114,4 +122,26 @@ def execute_shared_scan(
                 batch = B.select(batch, payload)
             out.append(batch)
         results = out
+    if topk is not None:
+        n, keys = topk
+        results = [_topk_batch(b, keys, n) for b in results]
     return results
+
+
+def _topk_batch(batch: B.Batch, keys: List[tuple], n: int) -> B.Batch:
+    """Host ORDER BY + LIMIT over one request's (already masked, in-memory)
+    batch — the same stable composite order as the executor's Sort node."""
+    import numpy as np
+
+    from hyperspace_tpu.exec.executor import _key_codes
+    from hyperspace_tpu.plan.expr import get_column
+
+    order = np.arange(B.num_rows(batch))
+    for name, asc in reversed(keys):
+        arr = get_column(batch, name)
+        if arr is None:
+            raise KeyError(f"Sort key {name!r} not found")
+        codes = _key_codes(np.asarray(arr)[order], asc)
+        order = order[np.argsort(codes, kind="stable")]
+    take = order[:n]
+    return {c: np.asarray(v)[take] for c, v in batch.items()}
