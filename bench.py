@@ -51,6 +51,13 @@ micro-batched serving. Bar: >= 1.5x pipelined/serial. Writes BENCH_join.json.
 the refresh manager commits incremental refreshes concurrently vs a quiesced
 baseline, with every served result checked for staleness/torn visibility
 (the count must be zero). Writes BENCH_refresh.json.
+
+``--faults`` runs the reliability benchmark: the serving workload clean vs
+under a 1% injected transient-fault rate at the decode seam with the retry
+policy on, cold decode every query (io cache disabled) so the seam is
+actually exercised. Every served result is compared against a clean oracle
+digest. Bars: zero wrong answers, zero unclassified errors, faulted p99
+<= 3x clean p99. Writes BENCH_faults.json.
 """
 
 from __future__ import annotations
@@ -1580,6 +1587,185 @@ def refresh_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def faults_main() -> None:
+    """``python bench.py --faults``: serving under injected transient faults.
+
+    One indexed dataset and a QueryServer with the retry policy enabled.
+    Phase one serves the query mix clean; phase two serves the identical mix
+    under ``io.decode:transient:p=0.01`` (seeded, deterministic). The io
+    cache is disabled for the whole run so every query really decodes —
+    otherwise a warm cache would hide the seam and the fault rate would
+    measure nothing. Every successful result is checked against a clean
+    oracle digest; every failure must be a typed ``ReliabilityError``.
+
+    Bars (violations raise SystemExit): ``wrong_answers == 0``,
+    ``unclassified_errors == 0``, ``p99_faulted <= 3 * p99_clean``.
+    ``vs_baseline`` is clean p99 / faulted p99 (1.0 = faults are free).
+    """
+    # must precede the hyperspace import: exec/io.py sizes its decode LRU
+    # from this env var at module import
+    os.environ["HS_IO_CACHE_BYTES"] = "0"
+    _honor_cpu_request()
+    _backend_watchdog()
+    num_rows = int(os.environ.get("BENCH_FAULTS_ROWS", 60_000))
+    num_files = max(2, int(os.environ.get("BENCH_FAULTS_FILES", 6)))
+    reps = max(1, int(os.environ.get("BENCH_FAULTS_REPS", 8)))
+    fault_p = float(os.environ.get("BENCH_FAULTS_P", 0.01))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_faults_")
+    try:
+        import jax
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        import hyperspace_tpu as hst
+        from hyperspace_tpu.obs.metrics import REGISTRY
+        from hyperspace_tpu.reliability import errors as rerr
+        from hyperspace_tpu.reliability.faults import FaultRule, fault_scope
+        from hyperspace_tpu.serving import QueryServer
+
+        data_dir = os.path.join(tmp, "sales")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        per = num_rows // num_files
+        for i in range(num_files):
+            base = np.arange(i * per, (i + 1) * per, dtype=np.int64)
+            pq.write_table(
+                pa.table({"b": (base * 7) % 997, "a": base % 211, "v": (base * 31) % 10_000}),
+                os.path.join(data_dir, f"part-{i:05d}.parquet"),
+            )
+
+        sess = hst.Session(
+            conf={
+                hst.keys.SYSTEM_PATH: sys_dir,
+                hst.keys.NUM_BUCKETS: 8,
+                hst.keys.RELIABILITY_RETRY_ENABLED: True,
+                hst.keys.RELIABILITY_RETRY_BASE_MS: 1.0,
+                hst.keys.RELIABILITY_RETRY_CAP_MS: 20.0,
+            }
+        )
+        hst.set_session(sess)
+        hs = hst.Hyperspace(sess)
+        df = sess.read_parquet(data_dir)
+        hs.create_index(df, hst.CoveringIndexConfig("fix0", ["b"], ["a", "v"]))
+        sess.enable_hyperspace()
+
+        plans = [
+            sess.read_parquet(data_dir).filter(hst.col("b") > 300 + i).select("a", "v")
+            for i in range(16)
+        ]
+
+        def digest(res):
+            return (
+                len(res["a"]),
+                int(np.sum(np.asarray(res["a"], dtype=np.int64))),
+                int(np.sum(np.asarray(res["v"], dtype=np.int64))),
+            )
+
+        oracle = [digest(p.collect()) for p in plans]
+
+        def run(srv, tag):
+            lats, ok, wrong, typed, unclassified = [], 0, 0, 0, 0
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                for i, p in enumerate(plans):
+                    ts = time.perf_counter()
+                    try:
+                        res = srv.submit(p).result(timeout=300)
+                    except rerr.ReliabilityError:
+                        typed += 1
+                        continue
+                    except Exception:
+                        unclassified += 1
+                        continue
+                    lats.append(time.perf_counter() - ts)
+                    if digest(res) == oracle[i]:
+                        ok += 1
+                    else:
+                        wrong += 1
+            wall = time.perf_counter() - t0
+            return {
+                "phase": tag,
+                "queries": reps * len(plans),
+                "goodput_qps": round(ok / wall, 1),
+                "p50_s": round(float(np.percentile(lats, 50)), 4) if lats else None,
+                "p99_s": round(float(np.percentile(lats, 99)), 4) if lats else None,
+                "wrong_answers": wrong,
+                "typed_errors": typed,
+                "unclassified_errors": unclassified,
+            }
+
+        retries0 = REGISTRY.counter("hs_io_retries_total", op="io.decode", reason="injected").value
+        fires0 = REGISTRY.counter(
+            "hs_faults_injected_total", site="io.decode", kind="transient"
+        ).value
+        # serving-layer caches off for the same reason as the io cache: a
+        # warm bucket/result cache never re-decodes, and the seam goes dark
+        with QueryServer(
+            sess,
+            workers=2,
+            queue_depth=65536,
+            bucket_cache_bytes=0,
+            prefetch_enabled=False,
+            result_cache_enabled=False,
+        ) as srv:
+            for p in plans:  # warm: compile (decode stays cold by design)
+                srv.submit(p).result(timeout=300)
+            clean = run(srv, "clean")
+            with fault_scope(
+                FaultRule("io.decode", "transient", probability=fault_p), seed=17
+            ):
+                faulted = run(srv, "faulted")
+        retries = (
+            REGISTRY.counter("hs_io_retries_total", op="io.decode", reason="injected").value
+            - retries0
+        )
+        fires = (
+            REGISTRY.counter(
+                "hs_faults_injected_total", site="io.decode", kind="transient"
+            ).value
+            - fires0
+        )
+
+        p99_ratio = (
+            faulted["p99_s"] / clean["p99_s"] if clean["p99_s"] and faulted["p99_s"] else None
+        )
+        out = {
+            "metric": "faulted_serving_p99_seconds",
+            "value": faulted["p99_s"],
+            "unit": "s",
+            "vs_baseline": round(clean["p99_s"] / faulted["p99_s"], 4)
+            if p99_ratio
+            else None,
+            "platform": jax.default_backend(),
+            "devices": len(jax.devices()),
+            "fault_rate": fault_p,
+            "fault_fires": int(fires),
+            "injected_retries": int(retries),
+            "clean": clean,
+            "faulted": faulted,
+            "p99_ratio": round(p99_ratio, 3) if p99_ratio else None,
+        }
+        line = json.dumps(out)
+        with open("BENCH_faults.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+        bars = []
+        for ph in (clean, faulted):
+            if ph["wrong_answers"]:
+                bars.append(f"{ph['phase']}: {ph['wrong_answers']} wrong answers")
+            if ph["unclassified_errors"]:
+                bars.append(f"{ph['phase']}: {ph['unclassified_errors']} unclassified errors")
+        if p99_ratio is not None and p99_ratio > 3.0:
+            bars.append(f"faulted p99 {p99_ratio:.2f}x clean (bar: <= 3x)")
+        if fires == 0:
+            bars.append("fault harness never fired: the bench measured nothing")
+        if bars:
+            raise SystemExit("faults bench bars violated: " + "; ".join(bars))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         serve_main()
@@ -1603,5 +1789,7 @@ if __name__ == "__main__":
         join_main()
     elif "--refresh" in sys.argv[1:]:
         refresh_main()
+    elif "--faults" in sys.argv[1:]:
+        faults_main()
     else:
         main()

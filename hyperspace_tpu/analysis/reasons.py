@@ -121,6 +121,18 @@ def index_not_eligible(reason: str) -> FilterReason:
     return FilterReason("INDEX_NOT_ELIGIBLE", (("reason", reason),), reason)
 
 
+def index_quarantined(name: str) -> FilterReason:
+    """The reliability circuit breaker quarantined this index after repeated
+    corrupt-data errors on its files (hyperspace_tpu/reliability/degrade.py);
+    queries re-plan against source until a half-open probe reads clean."""
+    return FilterReason(
+        "INDEX_QUARANTINED",
+        (("index", name),),
+        f"Index {name!r} is quarantined after repeated corrupt reads; "
+        "queries fall back to source until a clean probe un-quarantines it.",
+    )
+
+
 def sort_order_not_covered(reason: str) -> FilterReason:
     """Sort elimination (streamed merge of sorted index runs,
     plan/ordering.sort_run_eligibility) could not fire for a Sort node."""

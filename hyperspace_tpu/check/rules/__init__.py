@@ -23,6 +23,7 @@ _RULE_MODULES = (
     "cache_branding",
     "jit_purity",
     "snapshot_pin",
+    "io_error_swallow",
 )
 
 

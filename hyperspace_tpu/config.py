@@ -156,6 +156,21 @@ class keys:
     LIFECYCLE_REFRESH_MODE = "hyperspace.lifecycle.refresh.mode"
     LIFECYCLE_DEVICE_LINEAGE_ENABLED = "hyperspace.lifecycle.deviceLineage.enabled"
     LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS = "hyperspace.lifecycle.deviceLineage.minRows"
+    # Reliability subsystem (hyperspace_tpu/reliability/): deterministic
+    # fault injection at the lake IO seams, deadline-aware retry of
+    # transient IO errors, and the per-index quarantine circuit breaker.
+    # ALL default-off: with these at defaults, behavior and plans are
+    # byte-identical to a build without the subsystem.
+    RELIABILITY_FAULTS_ENABLED = "hyperspace.reliability.faults.enabled"
+    RELIABILITY_FAULTS_SPEC = "hyperspace.reliability.faults.spec"
+    RELIABILITY_FAULTS_SEED = "hyperspace.reliability.faults.seed"
+    RELIABILITY_RETRY_ENABLED = "hyperspace.reliability.retry.enabled"
+    RELIABILITY_RETRY_MAX_ATTEMPTS = "hyperspace.reliability.retry.maxAttempts"
+    RELIABILITY_RETRY_BASE_MS = "hyperspace.reliability.retry.baseMs"
+    RELIABILITY_RETRY_CAP_MS = "hyperspace.reliability.retry.capMs"
+    RELIABILITY_QUARANTINE_ENABLED = "hyperspace.reliability.quarantine.enabled"
+    RELIABILITY_QUARANTINE_THRESHOLD = "hyperspace.reliability.quarantine.threshold"
+    RELIABILITY_QUARANTINE_COOLDOWN_SECONDS = "hyperspace.reliability.quarantine.cooldownSeconds"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -432,6 +447,25 @@ DEFAULTS: Dict[str, Any] = {
     # Below this row count the host np.isin oracle wins (device dispatch
     # overhead); counted as hs_device_fallback_total{op="lineage"}.
     keys.LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS: 4096,
+    # Fault injection. Off means the registry stays empty and every seam
+    # costs one attribute read; the spec string installs seeded rules
+    # ("site:kind[:glob=..][:nth=N][:p=F][:delay=S][:max=N]" joined by ";").
+    keys.RELIABILITY_FAULTS_ENABLED: False,
+    keys.RELIABILITY_FAULTS_SPEC: "",
+    keys.RELIABILITY_FAULTS_SEED: 0,
+    # Retry of transient IO errors with decorrelated-jitter backoff; never
+    # sleeps past the serving request's admission deadline. Off by default:
+    # a failing read surfaces immediately, exactly as before this subsystem.
+    keys.RELIABILITY_RETRY_ENABLED: False,
+    keys.RELIABILITY_RETRY_MAX_ATTEMPTS: 4,
+    keys.RELIABILITY_RETRY_BASE_MS: 5.0,
+    keys.RELIABILITY_RETRY_CAP_MS: 100.0,
+    # Index quarantine circuit breaker: this many corrupt-data errors on one
+    # index's files trip it out of planning (fallback to source scans) until
+    # a half-open probe after the cooldown reads clean.
+    keys.RELIABILITY_QUARANTINE_ENABLED: False,
+    keys.RELIABILITY_QUARANTINE_THRESHOLD: 3,
+    keys.RELIABILITY_QUARANTINE_COOLDOWN_SECONDS: 30.0,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -895,6 +929,46 @@ class HyperspaceConf:
     @property
     def lifecycle_device_lineage_min_rows(self) -> int:
         return int(self.get(keys.LIFECYCLE_DEVICE_LINEAGE_MIN_ROWS))
+
+    @property
+    def reliability_faults_enabled(self) -> bool:
+        return bool(self.get(keys.RELIABILITY_FAULTS_ENABLED))
+
+    @property
+    def reliability_faults_spec(self) -> str:
+        return str(self.get(keys.RELIABILITY_FAULTS_SPEC) or "")
+
+    @property
+    def reliability_faults_seed(self) -> int:
+        return int(self.get(keys.RELIABILITY_FAULTS_SEED))
+
+    @property
+    def reliability_retry_enabled(self) -> bool:
+        return bool(self.get(keys.RELIABILITY_RETRY_ENABLED))
+
+    @property
+    def reliability_retry_max_attempts(self) -> int:
+        return int(self.get(keys.RELIABILITY_RETRY_MAX_ATTEMPTS))
+
+    @property
+    def reliability_retry_base_ms(self) -> float:
+        return float(self.get(keys.RELIABILITY_RETRY_BASE_MS))
+
+    @property
+    def reliability_retry_cap_ms(self) -> float:
+        return float(self.get(keys.RELIABILITY_RETRY_CAP_MS))
+
+    @property
+    def reliability_quarantine_enabled(self) -> bool:
+        return bool(self.get(keys.RELIABILITY_QUARANTINE_ENABLED))
+
+    @property
+    def reliability_quarantine_threshold(self) -> int:
+        return int(self.get(keys.RELIABILITY_QUARANTINE_THRESHOLD))
+
+    @property
+    def reliability_quarantine_cooldown_seconds(self) -> float:
+        return float(self.get(keys.RELIABILITY_QUARANTINE_COOLDOWN_SECONDS))
 
     def deltas(self) -> Dict[str, Any]:
         """Explicitly-set keys whose value differs from the centralized

@@ -780,6 +780,10 @@ def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], sca
         n_dev = mesh.devices.size
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
         fp = _mesh_fp(mesh)
+        from hyperspace_tpu.reliability.faults import FAULTS
+
+        if FAULTS.active:
+            FAULTS.check("device.transfer")
         with obs_spans.span("h2d-stage", cat="pipeline", rows=n):
             for r in cols:
                 ckey = (scan_key, r, fp)
@@ -2100,6 +2104,10 @@ def stream_bucketed_join(session, plan: L.Join, _compat=None):
         """Producer half: both side decodes + span-key encoding (the
         rank/int64 encode is the bucket's dominant host cost after decode,
         so it prefetches too)."""
+        from hyperspace_tpu.reliability.faults import FAULTS
+
+        if FAULTS.active:
+            FAULTS.check("join.task")
         lt, rt = lread.get(b), rread.get(b)
         lb = lt() if lt is not None else None
         rb = rt() if rt is not None else None

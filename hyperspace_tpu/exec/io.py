@@ -22,6 +22,10 @@ import pyarrow.parquet as pq
 from hyperspace_tpu.exec import batch as B
 from hyperspace_tpu.exec import trace
 from hyperspace_tpu.obs import spans
+from hyperspace_tpu.reliability import errors as rerr
+from hyperspace_tpu.reliability.degrade import QUARANTINE
+from hyperspace_tpu.reliability.faults import FAULTS
+from hyperspace_tpu.reliability.retry import with_retry
 
 # ---------------------------------------------------------------------------
 # Per-file decoded-batch cache (the framework's buffer pool). Spark gets this
@@ -247,8 +251,14 @@ def prune_row_groups(path: str, predicate) -> Optional[List[int]]:
     if not refs:
         return None
     try:
+        if FAULTS.active:
+            FAULTS.check("io.footer", path)
         md = pq.read_metadata(path)
-    except (OSError, pa.ArrowInvalid):
+    except (OSError, pa.ArrowInvalid) as exc:
+        # pruning is an optimization: the full decode below still answers
+        # (and will surface/classify a genuinely bad file) — but the footer
+        # failure itself is counted, never silently ignored
+        rerr.count_io_error("io.footer", exc, swallowed=True)
         return None
     n_rg = md.num_row_groups
     if n_rg == 0:
@@ -348,7 +358,11 @@ def read_parquet_batch(
             # file order (a bare dataset takes the FIRST fragment's schema)
             unified = pa.unify_schemas([pq.read_schema(f) for f in files])
             ds = pads.dataset(files, format="parquet", schema=unified)
-        except (OSError, pa.ArrowInvalid, pa.ArrowTypeError):
+        except (OSError, pa.ArrowInvalid, pa.ArrowTypeError) as exc:
+            # schema unification is best-effort (first-fragment schema is a
+            # correct fallback for homogeneous files); count the classified
+            # failure — a truly bad file still raises out of to_table below
+            rerr.count_io_error("io.footer", exc, swallowed=True)
             ds = pads.dataset(files, format="parquet")
         cols = columns
         if columns is not None and any("." in c and c not in ds.schema.names for c in columns):
@@ -416,10 +430,24 @@ def read_parquet_batch(
             _io_cache_put(concat_key, out)
         return out
 
-    # pre-scan schemas; any inconsistency -> unified dataset read
+    # pre-scan schemas; any inconsistency -> unified dataset read. A corrupt
+    # footer is NOT an inconsistency: falling back would re-read the same bad
+    # bytes, so it surfaces typed (and strikes the owning index's breaker)
     try:
-        schemas = [pq.read_schema(f) for f in files]
-    except OSError:
+        schemas = []
+        for f in files:
+            if FAULTS.active:
+                FAULTS.check("io.footer", f)
+            try:
+                schemas.append(pq.read_schema(f))
+            except (pa.ArrowInvalid, pa.ArrowTypeError) as exc:
+                err = rerr.classify(exc, path=f)
+                rerr.count_io_error("io.footer", err)
+                if QUARANTINE.enabled and isinstance(err, rerr.CorruptDataError):
+                    QUARANTINE.note_corrupt(f)
+                raise err from exc
+    except OSError as exc:
+        rerr.count_io_error("io.footer", exc, swallowed=True)
         return _dataset_read()
     if columns is None:
         names0 = list(schemas[0].names)
@@ -441,22 +469,47 @@ def read_parquet_batch(
                 keep = prune_row_groups(f, predicate)
                 if keep is not None:
                     return _read_row_groups(f, columns, schema, keep, dsp)
-            try:
-                cols = list(columns) if columns is not None else list(schema.names)
-                hints = _dtype_hints(schema, cols)
-                got = native.read_columns(f, cols, hints) if hints is not None else None
-            except (native.NativeUnsupported, OSError, KeyError) as e:
-                if os.environ.get("HS_DEBUG_DECODE_FALLBACK"):
-                    import sys
+            def _decode() -> B.Batch:
+                if FAULTS.active:
+                    FAULTS.check("io.decode", f)
+                try:
+                    cols = list(columns) if columns is not None else list(schema.names)
+                    hints = _dtype_hints(schema, cols)
+                    out = native.read_columns(f, cols, hints) if hints is not None else None
+                except (native.NativeUnsupported, OSError, KeyError) as e:
+                    # dialect mismatches are the expected fallback path; real
+                    # IO failures falling through to the pyarrow re-read are
+                    # classified and counted, never silently ignored
+                    if not isinstance(e, native.NativeUnsupported):
+                        rerr.count_io_error("io.decode", e, swallowed=True)
+                    if os.environ.get("HS_DEBUG_DECODE_FALLBACK"):
+                        import sys
 
-                    print(f"DECODE-FALLBACK {f}: {type(e).__name__}: {e}", file=sys.stderr)
-                got = None
-            if got is None:
-                trace.record("decode", "pyarrow")
-                t = pads.dataset([f], format="parquet").to_table(columns=columns)
-                got = B.table_to_batch(t)
-            else:
-                trace.record("decode", "native")
+                        print(f"DECODE-FALLBACK {f}: {type(e).__name__}: {e}", file=sys.stderr)
+                    out = None
+                if out is None:
+                    trace.record("decode", "pyarrow")
+                    t = pads.dataset([f], format="parquet").to_table(columns=columns)
+                    out = B.table_to_batch(t)
+                else:
+                    trace.record("decode", "native")
+                return out
+
+            try:
+                got = with_retry(_decode, op="io.decode")
+            except rerr.ReliabilityError as exc:
+                rerr.count_io_error("io.decode", exc)
+                if QUARANTINE.enabled and isinstance(exc, rerr.CorruptDataError):
+                    QUARANTINE.note_corrupt(f)
+                raise
+            except (OSError, pa.ArrowInvalid, pa.ArrowTypeError) as exc:
+                err = rerr.classify(exc, path=f)
+                rerr.count_io_error("io.decode", err)
+                if QUARANTINE.enabled and isinstance(err, rerr.CorruptDataError):
+                    QUARANTINE.note_corrupt(f)
+                raise err from exc
+            if QUARANTINE.enabled:
+                QUARANTINE.note_ok(f)
             dsp.set(rows=B.num_rows(got))
             _io_cache_put(ckey, got)
             return got

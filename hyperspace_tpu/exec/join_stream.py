@@ -119,7 +119,12 @@ def _plan_leaf_bytes(plan: L.LogicalPlan) -> Optional[int]:
                 if not leaf.files:
                     return None
                 total += sum(os.stat(f).st_size for f in leaf.files)
-        except Exception:
+        except Exception as exc:
+            # no estimate -> no broadcast decision; count the swallow so a
+            # flaky mount degrading every join to SMJ is visible in metrics
+            from hyperspace_tpu.reliability.errors import count_io_error
+
+            count_io_error("join.stat", exc, swallowed=True)
             return None
     return total
 

@@ -33,6 +33,7 @@ from time import monotonic
 from typing import Callable, Dict, List, Optional, Sequence
 
 from hyperspace_tpu.obs import spans
+from hyperspace_tpu.reliability.faults import FAULTS
 
 _PIPELINE_POOL = None
 _PIPELINE_POOL_LOCK = threading.Lock()
@@ -119,6 +120,8 @@ class ScanPipeline:
 
     def _run(self, i: int):
         with spans.span("prefetch", cat="pipeline", chunk=i):
+            if FAULTS.active:
+                FAULTS.check("pipeline.task")
             out = self._tasks[i]()
             if self._stage is not None:
                 self._stage(i, out)

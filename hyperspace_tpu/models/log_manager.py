@@ -20,6 +20,21 @@ from hyperspace_tpu.utils.file_utils import write_atomic, write_atomic_exclusive
 
 LATEST_STABLE = "latestStable"
 
+#: _read_classified statuses: distinguishing missing from corrupt is what
+#: lets a torn trailing entry degrade to the prior version instead of
+#: making the whole index silently vanish
+READ_OK, READ_MISSING, READ_CORRUPT = "ok", "missing", "corrupt"
+
+
+def _count_corrupt(index: str) -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_log_corrupt_total",
+        "operation-log entries that failed to parse (torn/corrupt writes)",
+        index=index,
+    ).inc()
+
 
 class IndexLogManager:
     """Manages the operation log of one index (ref: HS/index/IndexLogManager.scala:57-195)."""
@@ -27,23 +42,58 @@ class IndexLogManager:
     def __init__(self, index_path: str):
         self.index_path = str(index_path)
         self.log_dir = os.path.join(self.index_path, C.HYPERSPACE_LOG_DIR)
+        self.index_name = os.path.basename(os.path.normpath(self.index_path))
 
     def _path(self, log_id: int) -> str:
         return os.path.join(self.log_dir, str(log_id))
 
-    def _read(self, path: str) -> Optional[IndexLogEntry]:
+    def _read_classified(self, path: str):
+        """``(entry, status)`` — status distinguishes a file that is absent
+        (READ_MISSING) from one whose bytes don't parse (READ_CORRUPT, which
+        bumps ``hs_log_corrupt_total`` and strikes the quarantine breaker)."""
+        from hyperspace_tpu.reliability.degrade import QUARANTINE
+        from hyperspace_tpu.reliability.faults import FAULTS
+        from hyperspace_tpu.reliability.retry import with_retry
+
+        def _load() -> bytes:
+            with open(path, "rb") as f:
+                raw = f.read()
+            if FAULTS.active:
+                raw = FAULTS.mangle_bytes("log.read", path, raw)
+            return raw
+
         try:
-            with open(path, "r", encoding="utf-8") as f:
-                return IndexLogEntry.from_json(f.read())
-        except (OSError, json.JSONDecodeError, KeyError):
-            return None
+            entry = IndexLogEntry.from_json(
+                with_retry(_load, op="log.read").decode("utf-8")
+            )
+        except FileNotFoundError:
+            return None, READ_MISSING
+        except OSError:
+            # unreadable, not provably torn: treated as missing (the prior
+            # behavior), but a transient here never marks the entry corrupt
+            return None, READ_MISSING
+        except (json.JSONDecodeError, KeyError, UnicodeDecodeError, ValueError):
+            _count_corrupt(self.index_name)
+            if QUARANTINE.enabled:
+                QUARANTINE.note_corrupt(path)
+            return None, READ_CORRUPT
+        if QUARANTINE.enabled:
+            QUARANTINE.note_ok(path)
+        return entry, READ_OK
+
+    def _read(self, path: str) -> Optional[IndexLogEntry]:
+        return self._read_classified(path)[0]
 
     def get_log(self, log_id: int) -> Optional[IndexLogEntry]:
         return self._read(self._path(log_id))
 
     def get_latest_id(self) -> Optional[int]:
         """Highest numeric log id present, or None
-        (ref: HS/index/IndexLogManager.scala:88-100)."""
+        (ref: HS/index/IndexLogManager.scala:88-100). Raw directory-listing
+        semantics: writers derive the *next* id from this, so a torn trailing
+        entry must still count — skipping it here would hand two writers the
+        same id. Readers wanting the newest *readable* entry use
+        :meth:`get_latest_log`, which walks past torn tails."""
         try:
             names = os.listdir(self.log_dir)
         except OSError:
@@ -52,8 +102,18 @@ class IndexLogManager:
         return max(ids) if ids else None
 
     def get_latest_log(self) -> Optional[IndexLogEntry]:
+        """Newest *readable* entry: a corrupt (torn) trailing entry degrades
+        to the prior parseable version instead of reporting the index absent;
+        a genuinely missing id keeps the old absent semantics."""
         latest = self.get_latest_id()
-        return self.get_log(latest) if latest is not None else None
+        if latest is None:
+            return None
+        for log_id in range(latest, -1, -1):
+            entry, status = self._read_classified(self._path(log_id))
+            if status == READ_CORRUPT:
+                continue
+            return entry  # READ_OK entry, or None for READ_MISSING
+        return None
 
     def get_latest_stable_log(self) -> Optional[IndexLogEntry]:
         """Prefer the ``latestStable`` snapshot; if missing or unstable, scan
@@ -89,6 +149,10 @@ class IndexLogManager:
         Returns False when another writer won (ref: HS/index/IndexLogManager.scala:178-194)."""
         entry.id = log_id
         data = entry.to_json().encode("utf-8")
+        from hyperspace_tpu.reliability.faults import FAULTS
+
+        if FAULTS.active:
+            FAULTS.check("log.write", self._path(log_id))
         return write_atomic_exclusive(self._path(log_id), data)
 
     def create_latest_stable_log(self, log_id: int) -> bool:

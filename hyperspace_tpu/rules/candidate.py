@@ -34,6 +34,23 @@ def _referenced_columns(entry: IndexLogEntry) -> List[str]:
         ]
 
 
+def _quarantine_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntry]) -> List[IndexLogEntry]:
+    """Drop quarantined indexes (reliability circuit breaker) so their
+    queries transparently re-plan against source. One attribute read when
+    the breaker registry is disabled (the default)."""
+    from hyperspace_tpu.reliability.degrade import QUARANTINE
+
+    if not QUARANTINE.enabled:
+        return indexes
+    out = []
+    for entry in indexes:
+        name = str(entry.name)
+        ok = not QUARANTINE.is_quarantined(name)
+        if ctx.tag_reason_if_failed(ok, entry, scan, lambda: R.index_quarantined(name)):
+            out.append(entry)
+    return out
+
+
 def _schema_filter(ctx: RuleContext, scan: L.Scan, indexes: List[IndexLogEntry]) -> List[IndexLogEntry]:
     """Index's referenced columns ⊆ relation output (ref: ColumnSchemaFilter.scala:29-44)."""
     out = []
@@ -148,7 +165,9 @@ def collect_candidates(
     (ref: CandidateIndexCollector.scala:49-59)."""
     out: Dict[int, Tuple[L.Scan, List[IndexLogEntry]]] = {}
     for scan in L.collect(plan, lambda p: isinstance(p, L.Scan)):
-        eligible = _signature_filter(ctx, scan, _schema_filter(ctx, scan, indexes))
+        eligible = _signature_filter(
+            ctx, scan, _schema_filter(ctx, scan, _quarantine_filter(ctx, scan, indexes))
+        )
         if eligible:
             out[L.plan_key(scan)] = (scan, eligible)
     return out

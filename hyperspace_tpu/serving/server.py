@@ -530,12 +530,13 @@ class QueryServer:
 
     def _execute_requests(self, reqs: List[_Request]) -> None:
         from hyperspace_tpu.exec.executor import Executor
+        from hyperspace_tpu.reliability.retry import deadline_scope
 
         resolved = []  # (req, bound_plan, entry or None)
         for r in reqs:
             try:
                 with spans.attach(r.root), spans.span("resolve-plan", cat="serving"):
-                    with snapshot_scope(r.snapshot):
+                    with snapshot_scope(r.snapshot), deadline_scope(r.deadline):
                         resolved.append((r, *self._resolve(r)))
             except Exception as exc:
                 self._fail(r, exc)
@@ -555,8 +556,13 @@ class QueryServer:
                     t0 = time.perf_counter()
                     # same group key => same session token => same pinned
                     # roster, so the first request's snapshot covers all
+                    # retry budget for the whole shared scan: the earliest
+                    # deadline in the batch (conservative — a retry that
+                    # would expire ANY member gives up instead)
+                    group_deadlines = [r.deadline for r, _, _ in resolved if r.deadline is not None]
                     with self.session.hyperspace_scope(resolved[0][0].enabled), \
-                            snapshot_scope(resolved[0][0].snapshot):
+                            snapshot_scope(resolved[0][0].snapshot), \
+                            deadline_scope(min(group_deadlines) if group_deadlines else None):
                         batches = execute_shared_scan(
                             self.session, ops, leaf, [b for _, b, _ in resolved]
                         )
@@ -579,7 +585,8 @@ class QueryServer:
                 continue
             try:
                 with spans.attach(r.root), spans.span("execute", cat="serving"):
-                    with self.session.hyperspace_scope(r.enabled), snapshot_scope(r.snapshot):
+                    with self.session.hyperspace_scope(r.enabled), snapshot_scope(r.snapshot), \
+                            deadline_scope(r.deadline):
                         out_cols = list(entry.output_columns) if entry is not None else list(bound.output_columns)
                         batch = Executor(self.session).execute(
                             bound, required_columns=out_cols, prepruned=entry is not None

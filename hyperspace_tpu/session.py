@@ -51,6 +51,11 @@ class Session:
         _hlo_lint.set_default_enabled(self.conf.check_hlo_enabled)
         if self.conf.check_locks_enabled:
             _locks.watcher.enable()
+        # reliability registries (fault injection, retry policy, quarantine
+        # breakers) are process-global like the decode pool; all default-off
+        from hyperspace_tpu import reliability as _reliability
+
+        _reliability.configure(self)
         self.provider_manager = FileBasedSourceProviderManager(self)
         # context-local override beats the session-wide default, so a scoped
         # toggle (with_hyperspace_disabled, a serving worker pinning the flag

@@ -1,0 +1,17 @@
+"""Seeded io-error-swallow violations: broad excepts around lake IO that
+neither re-raise nor route through the reliability taxonomy."""
+
+
+def read_footer(path, pq):
+    try:
+        return pq.read_metadata(path)
+    except Exception:
+        return None
+
+
+def load_entry(path):
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except:  # noqa: E722
+        return b""

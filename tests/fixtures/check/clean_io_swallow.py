@@ -1,0 +1,45 @@
+"""Clean io-error-swallow fixture: narrow handlers, classified swallows,
+re-raises, pragma'd deliberate swallows, and broad excepts away from lake
+IO all pass."""
+
+from hyperspace_tpu.reliability.errors import classify, count_io_error
+
+
+def narrow(path, pq):
+    # a specific failure mode with a specific fallback is the designed shape
+    try:
+        return pq.read_metadata(path)
+    except OSError:
+        return None
+
+
+def reraises(path, pq):
+    try:
+        return pq.read_metadata(path)
+    except Exception as exc:
+        raise classify(exc, path=path) from exc
+
+
+def counted_fallback(path):
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except Exception as exc:
+        count_io_error("fixture.read", exc, swallowed=True)
+        return b""
+
+
+def deliberate(path):
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except Exception:  # hscheck: disable=io-error-swallow
+        return b""
+
+
+def not_lake_io(values):
+    # broad except is fine when the try body never touches the lake
+    try:
+        return sum(values) / len(values)
+    except Exception:
+        return 0.0

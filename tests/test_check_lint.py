@@ -93,6 +93,16 @@ class TestRulePairs:
         # direct resolver all pass.
         assert lint_one(fixture("clean_snapshot_pin.py"), "snapshot-pin") == []
 
+    def test_io_error_swallow_bad(self):
+        found = lint_one(fixture("bad_io_swallow.py"), "io-error-swallow")
+        assert [f.line for f in found] == [8, 16]
+        assert "classify" in found[0].message
+
+    def test_io_error_swallow_clean(self):
+        # Narrow handlers, re-raises, count_io_error fallbacks, pragmas,
+        # and broad excepts away from lake IO all pass.
+        assert lint_one(fixture("clean_io_swallow.py"), "io-error-swallow") == []
+
 
 class TestSuppression:
     def test_pragma(self):
@@ -116,6 +126,7 @@ class TestRunLint:
             "lock-blocking",
             "metric-families",
             "snapshot-pin",
+            "io-error-swallow",
         }
 
     def test_default_scope_excludes_tests(self):
