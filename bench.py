@@ -1766,6 +1766,248 @@ def faults_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def fabric_child_main() -> None:
+    """Child of ``--fabric``: one fabric serving worker. Fabric-on session
+    with a live CommitWatcher, served views re-registered on every
+    (replayed) commit, a named QueryServer behind a WorkerEndpoint; prints
+    the endpoint URL and serves until the parent closes stdin."""
+    _honor_cpu_request()
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.fabric import WorkerEndpoint
+    from hyperspace_tpu.serving import QueryServer
+
+    data_dir = os.environ["HS_BENCH_FABRIC_DATA"]
+    sys_dir = os.environ["HS_BENCH_FABRIC_SYS"]
+    name = os.environ["HS_BENCH_FABRIC_NAME"]
+    poll_s = float(os.environ.get("HS_BENCH_FABRIC_POLL", "0.2"))
+    sess = hst.Session(
+        conf={
+            hst.keys.SYSTEM_PATH: sys_dir,
+            hst.keys.FABRIC_ENABLED: True,
+            hst.keys.FABRIC_NODE_ID: name,
+            hst.keys.FABRIC_POLL_INTERVAL_SECONDS: poll_s,
+        }
+    )
+    sess.enable_hyperspace()
+
+    def refresh_views(event):
+        # a DataFrame freezes its source listing at read time; re-resolving
+        # served views on every commit is the fabric worker pattern
+        sess.register_view("t", sess.read_parquet(data_dir))
+
+    sess.register_view("t", sess.read_parquet(data_dir))
+    sess.lifecycle_bus.subscribe(refresh_views)
+    with QueryServer(sess, workers=2, name=name) as srv:
+        with WorkerEndpoint(srv) as ep:
+            print(ep.url, flush=True)
+            sys.stdin.readline()  # serve until the parent closes stdin
+
+
+def fabric_main() -> None:
+    """``python bench.py --fabric``: scale-out serving fabric throughput.
+
+    One marker-file dataset behind a covering index, one refresh writer (a
+    fabric-on session with the watcher off), and fleets of {1,2,4} fabric
+    server subprocesses behind a FrontDoor. While the writer continuously
+    appends files and commits incremental refreshes, concurrent clients
+    route tenant-affine queries through the FrontDoor; every answer is
+    validated like the soak test — each file's marker rows all-or-nothing
+    (torn check) and every marker whose commit settled for at least one
+    watcher poll interval present (staleness check). ``staleness_reads``
+    and ``torn_reads`` in the JSON must be 0 or the bench exits nonzero.
+    ``vs_baseline`` is max-fleet QPS / single-process QPS.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    import subprocess
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.fabric import FrontDoor
+    from hyperspace_tpu.lifecycle import RefreshManager
+
+    sizes = [int(s) for s in os.environ.get("BENCH_FABRIC_SIZES", "1,2,4").split(",")]
+    rows_per_file = int(os.environ.get("BENCH_FABRIC_ROWS", 20_000))
+    queries_per_fleet = max(8, int(os.environ.get("BENCH_FABRIC_QUERIES", 48)))
+    clients = max(2, int(os.environ.get("BENCH_FABRIC_CLIENTS", 8)))
+    poll_s = 0.2
+    settle_s = poll_s * 3 + 0.3  # staleness bound + scheduling margin
+    tmp = tempfile.mkdtemp(prefix="hs_bench_fabric_")
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        data_dir = os.path.join(tmp, "marked")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+
+        def write_marked(marker: int) -> None:
+            t = pa.table(
+                {
+                    "c1": (np.arange(rows_per_file, dtype=np.int64) * 13) % 1000,
+                    "m": np.full(rows_per_file, marker, dtype=np.int64),
+                }
+            )
+            final = os.path.join(data_dir, f"part-{marker:05d}.parquet")
+            pq.write_table(t, final + ".tmp")
+            os.replace(final + ".tmp", final)
+
+        initial = 3
+        for i in range(initial):
+            write_marked(i)
+
+        writer = hst.Session(
+            conf={
+                hst.keys.SYSTEM_PATH: sys_dir,
+                hst.keys.FABRIC_ENABLED: True,
+                hst.keys.FABRIC_NODE_ID: "writer",
+                hst.keys.FABRIC_WATCHER_ENABLED: False,  # pure publisher
+            }
+        )
+        hst.Hyperspace(writer).create_index(
+            writer.read_parquet(data_dir),
+            hst.CoveringIndexConfig("fabBix", ["c1"], ["m"]),
+        )
+        rm = RefreshManager(writer)
+
+        state_lock = threading.Lock()
+        committed = [(i, 0.0) for i in range(initial)]  # (marker, commit time)
+        next_marker = [initial]
+        violations = []
+
+        def run_query(fd, tenant: str) -> float:
+            with state_lock:
+                need = [mk for mk, ts in committed if ts <= time.time() - settle_s]
+            t0 = time.perf_counter()
+            res = fd.query("SELECT m FROM t WHERE c1 >= 0", tenant=tenant)
+            lat = time.perf_counter() - t0
+            vals, cnts = np.unique(res["m"], return_counts=True)
+            seen = dict(zip(vals.tolist(), cnts.tolist()))
+            with state_lock:
+                for mk, c in seen.items():
+                    if c != rows_per_file:
+                        violations.append(("torn", int(mk), int(c)))
+                for mk in need:
+                    if seen.get(mk) != rows_per_file:
+                        violations.append(("stale", int(mk), seen.get(mk)))
+            return lat
+
+        def run_fleet(n: int) -> dict:
+            env = os.environ.copy()
+            env["JAX_PLATFORMS"] = "cpu"
+            env["HS_BENCH_FABRIC_DATA"] = data_dir
+            env["HS_BENCH_FABRIC_SYS"] = sys_dir
+            env["HS_BENCH_FABRIC_POLL"] = str(poll_s)
+            procs = []
+            try:
+                for i in range(n):
+                    env_i = dict(env, HS_BENCH_FABRIC_NAME=f"qs{i}")
+                    procs.append(
+                        subprocess.Popen(
+                            [sys.executable, os.path.abspath(__file__), "--fabric-child"],
+                            env=env_i,
+                            stdin=subprocess.PIPE,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE,
+                            text=True,
+                        )
+                    )
+                urls = [p.stdout.readline().strip() for p in procs]
+                for p, u in zip(procs, urls):
+                    if not u.startswith("http://"):
+                        raise RuntimeError(
+                            f"fabric child failed to start: {p.stderr.read()[-2000:]}"
+                        )
+                fd = FrontDoor(urls)
+                for t in range(clients):  # warm every worker: compile + decode
+                    run_query(fd, f"tenant-{t}")
+
+                stop = threading.Event()
+                commits = [0]
+
+                def refresher():
+                    while not stop.is_set():
+                        marker = next_marker[0]
+                        next_marker[0] += 1
+                        write_marked(marker)
+                        if rm.refresh_index("fabBix", "incremental") == "committed":
+                            with state_lock:
+                                committed.append((marker, time.time()))
+                            commits[0] += 1
+                        stop.wait(0.4)
+
+                rt = threading.Thread(target=refresher)
+                rt.start()
+                lats = []
+                t0 = time.perf_counter()
+                try:
+                    with ThreadPoolExecutor(max_workers=clients) as pool:
+                        futs = [
+                            pool.submit(run_query, fd, f"tenant-{i % clients}")
+                            for i in range(queries_per_fleet)
+                        ]
+                        lats = [f.result(timeout=300) for f in futs]
+                finally:
+                    stop.set()
+                    rt.join(60)
+                wall = time.perf_counter() - t0
+                arr = np.asarray(lats)
+                return {
+                    "qps": round(queries_per_fleet / wall, 2),
+                    "p50_s": round(float(np.percentile(arr, 50)), 4),
+                    "p99_s": round(float(np.percentile(arr, 99)), 4),
+                    "queries": queries_per_fleet,
+                    "refresh_commits": commits[0],
+                }
+            finally:
+                for p in procs:
+                    try:
+                        p.stdin.close()
+                    except Exception:
+                        pass
+                for p in procs:
+                    try:
+                        p.wait(timeout=30)
+                    except Exception:
+                        p.kill()
+
+        fleets = {}
+        try:
+            for n in sizes:
+                fleets[n] = run_fleet(n)
+        finally:
+            writer.fabric.stop()
+
+        lo, hi = min(sizes), max(sizes)
+        out = {
+            "metric": "fabric_scale_out_qps",
+            "value": fleets[hi]["qps"],
+            "unit": f"queries/s through {hi} server processes under refresh",
+            "vs_baseline": round(fleets[hi]["qps"] / fleets[lo]["qps"], 4)
+            if fleets[lo]["qps"] > 0
+            else 1.0,
+            "fleets": {str(n): fleets[n] for n in sizes},
+            "staleness_reads": sum(1 for v in violations if v[0] == "stale"),
+            "torn_reads": sum(1 for v in violations if v[0] == "torn"),
+            "settle_seconds": round(settle_s, 3),
+            "rows_per_file": rows_per_file,
+            "platform": jax.default_backend(),
+            "cpus": os.cpu_count(),
+        }
+        line = json.dumps(out)
+        with open("BENCH_fabric.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+        if violations:
+            raise SystemExit(f"fabric bench served stale/torn results: {violations[:10]}")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         serve_main()
@@ -1791,5 +2033,9 @@ if __name__ == "__main__":
         refresh_main()
     elif "--faults" in sys.argv[1:]:
         faults_main()
+    elif "--fabric-child" in sys.argv[1:]:
+        fabric_child_main()
+    elif "--fabric" in sys.argv[1:]:
+        fabric_main()
     else:
         main()

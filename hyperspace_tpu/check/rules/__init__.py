@@ -24,6 +24,7 @@ _RULE_MODULES = (
     "jit_purity",
     "snapshot_pin",
     "io_error_swallow",
+    "process_local_state",
 )
 
 

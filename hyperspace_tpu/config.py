@@ -171,6 +171,20 @@ class keys:
     RELIABILITY_QUARANTINE_ENABLED = "hyperspace.reliability.quarantine.enabled"
     RELIABILITY_QUARANTINE_THRESHOLD = "hyperspace.reliability.quarantine.threshold"
     RELIABILITY_QUARANTINE_COOLDOWN_SECONDS = "hyperspace.reliability.quarantine.cooldownSeconds"
+    # Scale-out serving fabric (hyperspace_tpu/fabric/): multi-process
+    # serving over one lake, with the operation log as the coherence
+    # transport — lake-persisted commit records, a CommitWatcher replaying
+    # remote commits onto the local invalidation bus, and a coherence
+    # sidecar sharing quarantine strikes and SLO/rate accounting.
+    # ALL default-off: with these at defaults, plans, results, and metrics
+    # are byte-identical to a single-process build (docs/scale-out.md).
+    FABRIC_ENABLED = "hyperspace.fabric.enabled"
+    FABRIC_NODE_ID = "hyperspace.fabric.nodeId"
+    FABRIC_WATCHER_ENABLED = "hyperspace.fabric.watcher.enabled"
+    FABRIC_POLL_INTERVAL_SECONDS = "hyperspace.fabric.watcher.pollIntervalSeconds"
+    FABRIC_QUARANTINE_SHARED = "hyperspace.fabric.quarantine.shared"
+    FABRIC_SLO_SHARED = "hyperspace.fabric.slo.shared"
+    FABRIC_SLO_PUBLISH_INTERVAL_SECONDS = "hyperspace.fabric.slo.publishIntervalSeconds"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -466,6 +480,28 @@ DEFAULTS: Dict[str, Any] = {
     keys.RELIABILITY_QUARANTINE_ENABLED: False,
     keys.RELIABILITY_QUARANTINE_THRESHOLD: 3,
     keys.RELIABILITY_QUARANTINE_COOLDOWN_SECONDS: 30.0,
+    # Master fabric switch. Off: no commit records are written, no watcher
+    # or sidecar thread starts, every hook is one conf read — single-process
+    # behavior is byte-identical to a build without the subsystem.
+    keys.FABRIC_ENABLED: False,
+    # Stable identity stamped as the origin of this process's commit
+    # records (self-commit dedupe) and its sidecar node file. Empty means
+    # "<hostname>:<pid>", which is unique per process on one host.
+    keys.FABRIC_NODE_ID: "",
+    # Run the CommitWatcher thread when the fabric is on. A pure writer
+    # process (refresh driver) can turn this off and only publish.
+    keys.FABRIC_WATCHER_ENABLED: True,
+    # Watcher poll interval — the cross-process staleness bound: a commit
+    # in process A is replayed in process B within one interval.
+    keys.FABRIC_POLL_INTERVAL_SECONDS: 0.25,
+    # Merge remote quarantine strikes/trips from peers' commit records and
+    # sidecar files, so one process's corrupt reads protect the others.
+    keys.FABRIC_QUARANTINE_SHARED: True,
+    # Publish/merge per-tenant SLO good/bad counts and token-bucket drains
+    # through the sidecar, so burn rates and rate limits hold globally.
+    keys.FABRIC_SLO_SHARED: True,
+    # Seconds between sidecar publish/merge rounds.
+    keys.FABRIC_SLO_PUBLISH_INTERVAL_SECONDS: 1.0,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -969,6 +1005,34 @@ class HyperspaceConf:
     @property
     def reliability_quarantine_cooldown_seconds(self) -> float:
         return float(self.get(keys.RELIABILITY_QUARANTINE_COOLDOWN_SECONDS))
+
+    @property
+    def fabric_enabled(self) -> bool:
+        return bool(self.get(keys.FABRIC_ENABLED))
+
+    @property
+    def fabric_node_id(self) -> str:
+        return str(self.get(keys.FABRIC_NODE_ID) or "")
+
+    @property
+    def fabric_watcher_enabled(self) -> bool:
+        return bool(self.get(keys.FABRIC_WATCHER_ENABLED))
+
+    @property
+    def fabric_poll_interval_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_POLL_INTERVAL_SECONDS))
+
+    @property
+    def fabric_quarantine_shared(self) -> bool:
+        return bool(self.get(keys.FABRIC_QUARANTINE_SHARED))
+
+    @property
+    def fabric_slo_shared(self) -> bool:
+        return bool(self.get(keys.FABRIC_SLO_SHARED))
+
+    @property
+    def fabric_slo_publish_interval_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_SLO_PUBLISH_INTERVAL_SECONDS))
 
     def deltas(self) -> Dict[str, Any]:
         """Explicitly-set keys whose value differs from the centralized

@@ -1,0 +1,114 @@
+"""Scale-out serving fabric: multi-process serving over one lake.
+
+The operation log is the coherence transport (docs/scale-out.md):
+
+- every committed mutation persists a **commit record** beside the log
+  entry it describes (``lifecycle/invalidation.py`` writes it inside
+  ``publish``), stamped with the publisher's node id and Lamport commit
+  sequence;
+- a :class:`CommitWatcher` in every process tails those records and
+  replays remote commits onto the local invalidation bus — brand rotation,
+  roster-TTL clears, and targeted byte-cache purges fire everywhere within
+  one poll interval;
+- a :class:`CoherenceSidecar` shares the state invalidation can't carry:
+  quarantine strikes and per-tenant SLO / token-bucket accounting;
+- a :class:`FrontDoor` spreads tenants across worker processes with
+  rendezvous hashing and aggregates their ``/metrics``.
+
+Everything is behind ``hyperspace.fabric.*``, all default-off: at defaults
+``configure`` returns None without touching the lake, and single-process
+behavior is byte-identical to a build without this package.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Optional
+
+from hyperspace_tpu.fabric.coherence import CoherenceSidecar
+from hyperspace_tpu.fabric.frontdoor import (
+    FrontDoor,
+    WorkerEndpoint,
+    merge_prometheus_texts,
+    rendezvous_pick,
+)
+from hyperspace_tpu.fabric.records import local_node_id
+from hyperspace_tpu.fabric.watcher import CommitWatcher
+
+__all__ = [
+    "CommitWatcher",
+    "CoherenceSidecar",
+    "FabricRuntime",
+    "FrontDoor",
+    "WorkerEndpoint",
+    "configure",
+    "local_node_id",
+    "merge_prometheus_texts",
+    "rendezvous_pick",
+]
+
+
+class FabricRuntime:
+    """One session's fabric wiring: node identity + watcher + sidecar.
+
+    Constructed (and its threads started) by :func:`configure` when
+    ``hyperspace.fabric.enabled`` is on. ``attach_server``/``detach_server``
+    are called from ``QueryServer.start``/``shutdown`` so the sidecar always
+    accounts against the live serving stack, and a bus subscription merges
+    remote quarantine *trips* the instant their commit records replay —
+    strike-level sharing rides the slower sidecar loop.
+    """
+
+    def __init__(self, session, autostart: bool = True):
+        conf = session.conf
+        self._session_ref = weakref.ref(session)
+        self.node_id = local_node_id(conf)
+        self.watcher = CommitWatcher(session, node_id=self.node_id)
+        self.sidecar = CoherenceSidecar(session, node_id=self.node_id)
+        self.share_quarantine = bool(conf.fabric_quarantine_shared)
+        session.lifecycle_bus.subscribe(self._on_commit)
+        if autostart:
+            if conf.fabric_watcher_enabled:
+                self.watcher.start()
+            if self.share_quarantine or conf.fabric_slo_shared:
+                self.sidecar.start()
+
+    # -- serving attachment --------------------------------------------------
+    def attach_server(self, server) -> None:
+        self.sidecar.attach_server(server)
+
+    def detach_server(self, server) -> None:
+        self.sidecar.detach_server(server)
+
+    # -- remote trip propagation ---------------------------------------------
+    def _on_commit(self, event) -> None:
+        if not self.share_quarantine or event.kind != "quarantine":
+            return
+        origin = getattr(event, "origin", None)
+        if origin is None or origin == self.node_id:
+            return  # local trip: the registry already opened the breaker
+        from hyperspace_tpu.reliability.degrade import QUARANTINE
+
+        if QUARANTINE.merge_remote_trip(event.index_name):
+            from hyperspace_tpu.obs.metrics import REGISTRY
+
+            REGISTRY.counter(
+                "hs_fabric_quarantine_merged_total",
+                "quarantine trips caused or propagated by remote strikes",
+                index=event.index_name,
+            ).inc()
+
+    def stop(self) -> None:
+        self.watcher.stop()
+        self.sidecar.stop()
+        session = self._session_ref()
+        if session is not None:
+            session.lifecycle_bus.unsubscribe(self._on_commit)
+
+
+def configure(session) -> Optional[FabricRuntime]:
+    """Session wiring hook (mirrors ``reliability.configure``): a no-op
+    returning None while ``hyperspace.fabric.enabled`` is off."""
+    if not session.conf.fabric_enabled:
+        return None
+    return FabricRuntime(session)

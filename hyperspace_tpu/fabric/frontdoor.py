@@ -1,0 +1,285 @@
+"""FrontDoor: spread tenants across fabric worker processes.
+
+A thin routing front with no query smarts of its own: it picks a worker
+per tenant with **rendezvous (highest-random-weight) hashing** — stable
+under worker join/leave (only the departed worker's tenants move), no
+shared state, no coordinator — forwards the query text plus tenant id and
+deadline, and aggregates the workers' ``/metrics`` into one exposition
+(worker series stay distinguishable by their per-process ``server="qsN"``
+labels, which is why ``QueryServer`` accepts an explicit ``name``).
+
+Workers come in two flavors, freely mixed:
+
+- an in-process :class:`~hyperspace_tpu.serving.server.QueryServer`
+  (tests, single-process topologies);
+- a base URL of a :class:`WorkerEndpoint` — the stdlib-HTTP shim that
+  exposes one QueryServer to other processes (``GET/POST /query``,
+  ``/metrics``, ``/statusz``, ``/healthz``). Results travel as JSON
+  columns and come back as numpy arrays, same shape ``collect()`` returns.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = ["FrontDoor", "WorkerEndpoint", "rendezvous_pick", "merge_prometheus_texts"]
+
+
+def rendezvous_pick(key: str, nodes: Sequence[str]) -> str:
+    """The highest-random-weight node for ``key``: every participant
+    computes the same winner from the membership list alone."""
+    if not nodes:
+        raise ValueError("rendezvous_pick needs at least one node")
+    return max(
+        nodes,
+        key=lambda n: hashlib.sha256(f"{key}|{n}".encode("utf-8")).digest(),
+    )
+
+
+def merge_prometheus_texts(texts: Sequence[str]) -> str:
+    """Merge several Prometheus 0.0.4 expositions into one: each family's
+    ``# HELP``/``# TYPE`` header appears once, with every worker's samples
+    (already disjoint by their ``server`` labels) concatenated under it."""
+    headers: Dict[str, List[str]] = {}
+    samples: Dict[str, List[str]] = {}
+    order: List[str] = []
+    for text in texts:
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                parts = line.split(None, 3)
+                family = parts[2] if len(parts) >= 3 else ""
+            else:
+                family = line.split("{", 1)[0].split(None, 1)[0]
+            if family not in headers:
+                headers[family] = []
+                samples[family] = []
+                order.append(family)
+            if line.startswith("#"):
+                if line not in headers[family]:
+                    headers[family].append(line)
+            elif line not in samples[family]:
+                samples[family].append(line)
+    out: List[str] = []
+    for family in order:
+        out.extend(headers[family])
+        out.extend(samples[family])
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def _count_route(worker: str) -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_fabric_frontdoor_requests_total",
+        "requests routed through the FrontDoor, by worker",
+        worker=worker,
+    ).inc()
+
+
+class FrontDoor:
+    """Tenant-affine router over a fixed worker set (see module docstring)."""
+
+    def __init__(self, workers: Sequence[Any]):
+        if not workers:
+            raise ValueError("FrontDoor needs at least one worker")
+        self._workers: Dict[str, Any] = {}
+        for i, w in enumerate(workers):
+            if isinstance(w, str):
+                self._workers[f"w{i}:{w}"] = w.rstrip("/")
+            else:
+                self._workers[getattr(w, "server_name", f"w{i}")] = w
+        self._ids = sorted(self._workers)
+
+    @property
+    def worker_ids(self) -> List[str]:
+        return list(self._ids)
+
+    def pick(self, tenant: str) -> str:
+        return rendezvous_pick(str(tenant), self._ids)
+
+    # -- queries -------------------------------------------------------------
+    def query(
+        self,
+        sql: str,
+        tenant: str = "default",
+        timeout: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Route one SQL query to the tenant's worker and return the
+        collected batch (dict of numpy arrays, like ``collect()``)."""
+        wid = self.pick(tenant)
+        _count_route(wid)
+        worker = self._workers[wid]
+        if isinstance(worker, str):
+            return self._http_query(worker, sql, tenant, timeout)
+        return worker.query(sql, timeout=timeout, tenant=tenant)
+
+    @staticmethod
+    def _http_query(
+        base: str, sql: str, tenant: str, timeout: Optional[float]
+    ) -> Dict[str, Any]:
+        import numpy as np
+
+        params = {"sql": sql, "tenant": tenant}
+        if timeout is not None:
+            params["timeoutMs"] = str(int(timeout * 1000))
+        url = f"{base}/query?{urllib.parse.urlencode(params)}"
+        http_timeout = 300.0 if timeout is None else timeout + 5.0
+        try:
+            with urllib.request.urlopen(url, timeout=http_timeout) as resp:
+                body = json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as exc:
+            # the endpoint replies with a typed JSON error body on 4xx/5xx;
+            # surface it instead of the bare transport error
+            try:
+                body = json.loads(exc.read().decode("utf-8"))
+            except Exception:
+                raise RuntimeError(f"worker {base} failed: HTTP {exc.code}") from exc
+        if "error" in body:
+            raise RuntimeError(f"worker {base} failed: {body['error']}")
+        return {k: np.asarray(v) for k, v in body["columns"].items()}
+
+    # -- aggregation ---------------------------------------------------------
+    def metrics_text(self) -> str:
+        """One merged Prometheus exposition over every worker."""
+        texts = []
+        for worker in self._workers.values():
+            if isinstance(worker, str):
+                with urllib.request.urlopen(f"{worker}/metrics", timeout=30) as resp:
+                    texts.append(resp.read().decode("utf-8"))
+            else:
+                texts.append(worker.prometheus_text())
+        return merge_prometheus_texts(texts)
+
+    def statusz(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for wid, worker in self._workers.items():
+            if isinstance(worker, str):
+                with urllib.request.urlopen(f"{worker}/statusz", timeout=30) as resp:
+                    out[wid] = json.loads(resp.read().decode("utf-8"))
+            else:
+                out[wid] = worker.statusz()
+        return out
+
+
+class WorkerEndpoint:
+    """Expose one QueryServer to FrontDoors in other processes over stdlib
+    HTTP. Read-mostly by design: ``/query`` executes through the server's
+    normal admission path (deadline and tenant forwarded), everything else
+    is a snapshot. ``port=0`` binds an ephemeral port (read ``.port``)."""
+
+    def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        endpoint = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            daemon_threads = True
+
+            def log_message(self, fmt, *args):  # no stderr chatter per request
+                pass
+
+            def do_GET(self):
+                try:
+                    endpoint._handle(self)
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # defensive: never kill the accept loop
+                    try:
+                        self.send_error(500, explain=str(exc))
+                    except Exception:
+                        pass
+
+            do_POST = do_GET
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = self._httpd.server_address[0]
+        self.port = int(self._httpd.server_address[1])
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "WorkerEndpoint":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                name=f"hs-fabric-worker-{self.port}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "WorkerEndpoint":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- request handling ----------------------------------------------------
+    def _handle(self, req: BaseHTTPRequestHandler) -> None:
+        parsed = urllib.parse.urlparse(req.path)
+        path = parsed.path.rstrip("/") or "/"
+        if path == "/query":
+            self._query(req, urllib.parse.parse_qs(parsed.query))
+        elif path == "/metrics":
+            body = self.server.prometheus_text().encode("utf-8")
+            self._reply(req, 200, "text/plain; version=0.0.4; charset=utf-8", body)
+        elif path == "/statusz":
+            self._reply_json(req, 200, self.server.statusz())
+        elif path == "/healthz":
+            self._reply_json(req, 200, {"ok": True, "server": self.server.server_name})
+        else:
+            self._reply_json(
+                req, 404,
+                {"error": "not found",
+                 "endpoints": ["/query", "/metrics", "/statusz", "/healthz"]},
+            )
+
+    def _query(self, req: BaseHTTPRequestHandler, query: Dict[str, list]) -> None:
+        sql = (query.get("sql") or [None])[0]
+        if not sql:
+            self._reply_json(req, 400, {"error": "missing sql parameter"})
+            return
+        tenant = (query.get("tenant") or ["default"])[0]
+        timeout_ms = (query.get("timeoutMs") or [None])[0]
+        timeout = None if timeout_ms is None else float(timeout_ms) / 1000.0
+        try:
+            batch = self.server.query(sql, timeout=timeout, tenant=tenant)
+        except Exception as exc:
+            self._reply_json(
+                req, 503, {"error": f"{type(exc).__name__}: {exc}"}
+            )
+            return
+        self._reply_json(
+            req, 200, {"columns": {k: v.tolist() for k, v in batch.items()}}
+        )
+
+    @staticmethod
+    def _reply(req: BaseHTTPRequestHandler, code: int, ctype: str, body: bytes) -> None:
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+    @classmethod
+    def _reply_json(cls, req: BaseHTTPRequestHandler, code: int, obj: Any) -> None:
+        cls._reply(req, code, "application/json; charset=utf-8",
+                   json.dumps(obj, default=str).encode("utf-8"))

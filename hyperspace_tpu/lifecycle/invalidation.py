@@ -39,9 +39,14 @@ class CommitEvent:
     stale after this commit: the *previous* entry's index data files (their
     content was rewritten or superseded) plus any source files the commit
     deleted from coverage.
+
+    ``origin`` is the fabric node id of the publishing process (None for a
+    plain single-process publish): the commit record persists it so a
+    CommitWatcher in the publishing process can recognize — and skip — its
+    own commits instead of re-purging caches it already purged.
     """
 
-    __slots__ = ("index_name", "log_id", "kind", "affected_files")
+    __slots__ = ("index_name", "log_id", "kind", "affected_files", "origin")
 
     def __init__(
         self,
@@ -49,11 +54,13 @@ class CommitEvent:
         log_id: Optional[int],
         kind: str,
         affected_files: Sequence[str] = (),
+        origin: Optional[str] = None,
     ):
         self.index_name = str(index_name)
         self.log_id = log_id
         self.kind = str(kind)  # refresh-incremental | refresh-quick | create | ...
         self.affected_files: Tuple[str, ...] = tuple(affected_files)
+        self.origin = origin
 
     def __repr__(self) -> str:
         return (
@@ -68,6 +75,16 @@ def _count_commit() -> None:
     REGISTRY.counter(
         "hs_lifecycle_commits_total",
         "index mutations published on the lifecycle commit bus",
+    ).inc()
+
+
+def _count_replay(kind: str) -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_fabric_records_replayed_total",
+        "remote commit records replayed onto the local invalidation bus",
+        kind=kind,
     ).inc()
 
 
@@ -119,12 +136,48 @@ class InvalidationBus:
     def publish(self, event: CommitEvent) -> dict:
         """Publish one commit; returns per-cache purge counts (observability
         and test assertions — the same numbers land in
-        ``hs_lifecycle_invalidations_total{cache}``)."""
+        ``hs_lifecycle_invalidations_total{cache}``).
+
+        With the fabric on, the commit is also persisted as a numbered
+        record under the index's log directory, stamped with this process's
+        node id and the post-bump commit sequence, so peer processes replay
+        it (see :meth:`replay` and ``hyperspace_tpu/fabric/watcher.py``).
+        """
         with self._lock:
             self._seq += 1
+            seq = self._seq
             subscribers = list(self._subscribers)
         _count_commit()
+        self._persist_record(event, seq)
+        return self._apply(event, subscribers)
 
+    def replay(self, event: CommitEvent, seq: Optional[int] = None) -> dict:
+        """Apply a commit observed in the lake (published by *another*
+        process) to this process's caches. Advances the local commit
+        sequence to at least the record's persisted sequence — a Lamport
+        merge, so all processes agree that event ordering never runs
+        backwards — and never re-persists a record (no echo)."""
+        with self._lock:
+            if seq is not None and int(seq) > self._seq:
+                self._seq = int(seq)
+            else:
+                # a record without a sequence still invalidates pins/tokens
+                self._seq += 1
+            subscribers = list(self._subscribers)
+        _count_replay(event.kind)
+        return self._apply(event, subscribers)
+
+    def _persist_record(self, event: CommitEvent, seq: int) -> None:
+        conf = getattr(self._session, "conf", None)
+        if conf is None or not conf.fabric_enabled:
+            return
+        from hyperspace_tpu.fabric import records
+
+        if event.origin is None:
+            event.origin = records.local_node_id(conf)
+        records.append_commit_record(conf.system_path, event, seq)
+
+    def _apply(self, event: CommitEvent, subscribers) -> dict:
         counts = {"roster": 0, "bucket": 0, "io": 0, "device": 0}
 
         # 1) roster freshness: without this, a post-commit request would pin

@@ -36,13 +36,18 @@ _MAX_EVENTS = 8192
 
 
 class _TenantState:
-    __slots__ = ("good", "bad", "events", "lock")
+    __slots__ = ("good", "bad", "events", "lock", "local_good", "local_bad")
 
     def __init__(self):
         self.good = None  # registry counters, bound lazily
         self.bad = None
         self.events: "deque[Tuple[float, bool]]" = deque(maxlen=_MAX_EVENTS)
         self.lock = named_lock("obs.slo.tenant")
+        # locally-recorded cumulative counts, excluding remote merges — the
+        # ledger the fabric sidecar publishes (peers must never re-export
+        # each other's events, or counts would snowball around the ring)
+        self.local_good = 0
+        self.local_bad = 0
 
 
 class SloTracker:
@@ -104,9 +109,37 @@ class SloTracker:
         st = self._tenant(tenant)
         with st.lock:
             st.events.append((self._clock(), good))
+            if good:
+                st.local_good += 1
+            else:
+                st.local_bad += 1
         if st.good is not None:
             (st.good if good else st.bad).inc()
         return good
+
+    # -- fabric coherence (hyperspace_tpu/fabric/coherence.py) ---------------
+    def counts(self) -> Dict[str, Tuple[int, int]]:
+        """Locally-recorded cumulative (good, bad) per tenant — the sidecar's
+        publish ledger. Excludes events merged from peers."""
+        with self._lock:
+            tenants = dict(self._tenants)
+        out: Dict[str, Tuple[int, int]] = {}
+        for name, st in tenants.items():
+            with st.lock:
+                out[name] = (st.local_good, st.local_bad)
+        return out
+
+    def note_remote(self, tenant: str, good: int = 0, bad: int = 0) -> None:
+        """Fold a peer process's good/bad event deltas into this tenant's
+        burn-rate windows. Deliberately touches neither the registry
+        counters (each process's ``hs_slo_*_total`` series stay its own
+        cumulative truth — aggregation is the scrape layer's job) nor the
+        local publish ledger (no echo)."""
+        st = self._tenant(tenant)
+        now = self._clock()
+        with st.lock:
+            st.events.extend([(now, True)] * max(0, int(good)))
+            st.events.extend([(now, False)] * max(0, int(bad)))
 
     # -- windowed views ------------------------------------------------------
     def _window_counts(self, st: _TenantState, window_s: float) -> Tuple[int, int]:

@@ -63,6 +63,9 @@ class QuarantineRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._breakers: Dict[str, _Breaker] = {}
+        # strikes merged from fabric peers (coherence sidecar); they count
+        # toward the local threshold but are never re-published
+        self._remote_strikes: Dict[str, int] = {}
         self._indexes_root: Optional[str] = None
         self._session_ref = lambda: None
         self._threshold = 3
@@ -89,6 +92,7 @@ class QuarantineRegistry:
             sys_path = session.conf.system_path
             self._indexes_root = os.path.abspath(str(sys_path)) if sys_path else None
             self._breakers = {}
+            self._remote_strikes = {}
 
     # -- path → index attribution -------------------------------------------
     def index_of_path(self, path: Optional[str]) -> Optional[str]:
@@ -131,7 +135,8 @@ class QuarantineRegistry:
                 tripped = True
             else:
                 b.strikes += 1
-                if b.state == _CLOSED and b.strikes >= self._threshold:
+                effective = b.strikes + self._remote_strikes.get(name, 0)
+                if b.state == _CLOSED and effective >= self._threshold:
                     b.state = _OPEN
                     b.tripped_at = self._clock()
                     tripped = True
@@ -180,6 +185,50 @@ class QuarantineRegistry:
             b = self._breakers.get(str(name))
             return b.state if b is not None else _CLOSED
 
+    # -- fabric coherence (hyperspace_tpu/fabric/coherence.py) ---------------
+    def local_strikes(self) -> Dict[str, int]:
+        """This process's own accumulated strikes per index — what the
+        coherence sidecar publishes (remote strikes are excluded so peers
+        never echo each other's counts back and forth)."""
+        with self._lock:
+            return {n: b.strikes for n, b in self._breakers.items() if b.strikes}
+
+    def merge_remote_strikes(self, strikes: Dict[str, int]) -> List[str]:
+        """Replace the remote-strike view with the peers' current totals and
+        trip any closed breaker whose local+remote count now crosses the
+        threshold. Returns the names tripped by this merge. Merged trips are
+        deliberately NOT re-published on the bus — the originating process
+        already persisted the strikes, and an echo would ping-pong."""
+        if not self.enabled:
+            return []
+        tripped: List[str] = []
+        with self._lock:
+            self._remote_strikes = {str(k): int(v) for k, v in strikes.items() if int(v) > 0}
+            for name, remote in self._remote_strikes.items():
+                b = self._breakers.setdefault(name, _Breaker())
+                if b.state == _CLOSED and b.strikes + remote >= self._threshold:
+                    b.state = _OPEN
+                    b.tripped_at = self._clock()
+                    tripped.append(name)
+        for name in tripped:
+            _count_quarantine(name)
+        return tripped
+
+    def merge_remote_trip(self, name: str) -> bool:
+        """A peer's breaker tripped (its quarantine commit record replayed
+        here): open ours too so this process stops planning the index
+        immediately. Returns False when it was already open."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            b = self._breakers.setdefault(str(name), _Breaker())
+            if b.state == _OPEN:
+                return False
+            b.state = _OPEN
+            b.tripped_at = self._clock()
+        _count_quarantine(str(name))
+        return True
+
     # -- bus publication -----------------------------------------------------
     def _publish_quarantine(self, name: str) -> None:
         session = self._session_ref()
@@ -195,8 +244,13 @@ class QuarantineRegistry:
             pass
 
 
-#: the process-global registry (one-attr fast path while disabled)
+#: the process-global registry (one-attr fast path while disabled); its
+#: strikes/trips are shared across fabric processes by the coherence sidecar
 QUARANTINE = QuarantineRegistry()
+
+#: module-level registries whose state the fabric publishes to peers — the
+#: process-local-state lint rule exempts these by name
+__fabric_published__ = ("QUARANTINE",)
 
 
 def configure(session) -> None:

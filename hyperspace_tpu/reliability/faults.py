@@ -214,8 +214,9 @@ class FaultRegistry:
         )
 
 
-#: the process-global registry every seam consults (fast path: one attr read)
-FAULTS = FaultRegistry()
+#: the process-global registry every seam consults (fast path: one attr read);
+#: intentionally process-local — fault injection is a per-process chaos harness
+FAULTS = FaultRegistry()  # hscheck: disable=process-local-state
 
 
 class fault_scope:

@@ -46,7 +46,8 @@ __all__ = ["CostAwareScheduler", "TokenBucket", "classify_cost", "COST_CLASSES"]
 #: (no confident estimate) sits between standard and heavy — an unseen shape
 #: must neither jump the line nor starve
 COST_CLASSES = ("interactive", "standard", "unknown", "heavy")
-_CLASS_RANK = {c: i for i, c in enumerate(COST_CLASSES)}
+# derived lookup table, written once at import — process-local by design
+_CLASS_RANK = {c: i for i, c in enumerate(COST_CLASSES)}  # hscheck: disable=process-local-state
 
 
 def classify_cost(
@@ -72,6 +73,9 @@ class TokenBucket:
         self.rate = float(rate)
         self.burst = float(burst)
         self.tokens = float(burst)
+        # cumulative tokens ever acquired — the ledger the fabric sidecar
+        # publishes so peer processes can debit their own buckets
+        self.drained_total = 0.0
         self._clock = clock
         self._last = clock()
         self._lock = named_lock("serving.sched.tokenBucket")
@@ -83,8 +87,17 @@ class TokenBucket:
             self._last = now
             if self.tokens >= n:
                 self.tokens -= n
+                self.drained_total += n
                 return True
             return False
+
+    def drain(self, n: float) -> None:
+        """Debit tokens acquired *elsewhere* (a fabric peer's admissions)
+        without counting them as our own: a per-tenant rate limit then holds
+        globally instead of per process. Floors at empty — remote traffic
+        can exhaust the bucket but never drive it into debt."""
+        with self._lock:
+            self.tokens = max(0.0, self.tokens - max(0.0, float(n)))
 
 
 class _TenantState:
@@ -260,6 +273,25 @@ class CostAwareScheduler(AdmissionController):
             st = self._tenants.get(tenant)
             if st is not None:
                 st.consumed = max(0.0, st.consumed + max(0.0, actual_s) - charged_s)
+
+    # -- fabric coherence (hyperspace_tpu/fabric/coherence.py) ---------------
+    def drained_tokens(self) -> Dict[str, float]:
+        """Cumulative tokens each tenant's bucket has granted locally — the
+        sidecar publishes this ledger so peers can :meth:`external_drain`."""
+        with self._cv:
+            return {
+                name: st.bucket.drained_total
+                for name, st in self._tenants.items()
+                if st.bucket is not None
+            }
+
+    def external_drain(self, tenant: str, tokens: float) -> None:
+        """Debit a peer process's admissions from the tenant's local bucket
+        (no-op for tenants without rate limiting)."""
+        with self._cv:
+            st = self._tenant(tenant)
+        if st.bucket is not None:
+            st.bucket.drain(tokens)
 
     # -- fairness internals --------------------------------------------------
     def _tenant(self, name: str) -> _TenantState:

@@ -48,8 +48,10 @@ from hyperspace_tpu.lifecycle.snapshot import SnapshotHandle, snapshot_scope
 
 __all__ = ["QueryServer", "AdmissionRejected", "RequestTimeout", "ServerClosed"]
 
-# distinguishes concurrent QueryServers' series in the process-wide registry
-_server_seq = itertools.count()
+# distinguishes concurrent QueryServers' series in the process-wide registry;
+# intentionally process-local — cross-process label uniqueness comes from the
+# explicit ``name`` option (fabric workers pass one)
+_server_seq = itertools.count()  # hscheck: disable=process-local-state
 
 
 class _Request:
@@ -109,7 +111,9 @@ class QueryServer:
     ``sched_max_queued_seconds``, ``sched_tenant_weights``,
     ``sched_tenant_rate``, ``sched_tenant_burst``, ``sched_burn_threshold``,
     ``sched_burn_factor``, ``result_cache_enabled``, ``result_cache_bytes``,
-    ``result_cache_max_entry_bytes``, ``result_cache_subsumption``.
+    ``result_cache_max_entry_bytes``, ``result_cache_subsumption``; plus
+    ``name`` (explicit metrics ``server=`` label, defaulting to the
+    process-sequential ``qsN``).
     """
 
     def __init__(self, session, **overrides):
@@ -184,8 +188,10 @@ class QueryServer:
         )
         # every server labels its series in the process-wide registry (a
         # private registry when metrics are conf'd off, so accounting still
-        # works but nothing is published)
-        self.server_name = f"qs{next(_server_seq)}"
+        # works but nothing is published); an explicit name keeps labels
+        # distinct ACROSS processes too (every process counts from qs0), so
+        # a fabric FrontDoor can aggregate /metrics without collisions
+        self.server_name = str(opt("name", "") or "") or f"qs{next(_server_seq)}"
         self.registry = (
             obs_metrics.REGISTRY if conf.obs_metrics_enabled else obs_metrics.MetricsRegistry()
         )
@@ -300,6 +306,16 @@ class QueryServer:
         self.session.bucket_cache = self.bucket_cache
         self._prev_join_build_cache = getattr(self.session, "join_build_cache", None)
         self.session.join_build_cache = self.join_build_cache
+        # fabric coherence: the sidecar publishes/merges this server's SLO
+        # and token-bucket accounting while it serves
+        fabric = getattr(self.session, "_fabric", None)
+        if fabric is not None:
+            fabric.attach_server(self)
+        # any commit (local, or a remote one replayed by the fabric watcher)
+        # drops the SQL-text memo: its entries embed each scan's source
+        # listing, so a memoized plan would keep serving the pre-commit file
+        # set. Commits are rare; re-parsing after one is cheap.
+        self.session.lifecycle_bus.subscribe(self._on_commit_event)
         for i in range(self.workers_n):
             t = threading.Thread(target=self._worker, name=f"hs-serve-{i}", daemon=True)
             t.start()
@@ -324,6 +340,10 @@ class QueryServer:
         self.bucket_cache.shutdown()
         self.session.bucket_cache = self._prev_bucket_cache
         self.session.join_build_cache = self._prev_join_build_cache
+        fabric = getattr(self.session, "_fabric", None)
+        if fabric is not None:
+            fabric.detach_server(self)
+        self.session.lifecycle_bus.unsubscribe(self._on_commit_event)
         if self.telemetry is not None:
             self.telemetry.close()
             self.telemetry = None
@@ -367,6 +387,24 @@ class QueryServer:
         snapshot = None
         if self.session.conf.lifecycle_snapshot_enabled:
             snapshot = SnapshotHandle.capture(self.session)
+            # seqlock validation of the capture: the handle records the bus
+            # sequence BEFORE reading the roster, so a commit landing during
+            # the read (a local refresh, or a fabric watcher replaying a
+            # remote one) leaves commit_seq ahead of the handle — the pin
+            # may hold a torn half-old/half-new roster. Re-capture until the
+            # sequence is stable across the whole read (bounded: under a
+            # commit storm the freshest capture wins and is still a valid
+            # roster at SOME commit point).
+            bus = self.session.lifecycle_bus
+            for _ in range(3):
+                if bus.commit_seq == snapshot.commit_seq:
+                    break
+                self.registry.counter(
+                    "hs_fabric_snapshot_retries_total",
+                    "snapshot re-captures after a commit raced the roster read",
+                    server=self.server_name,
+                ).inc()
+                snapshot = SnapshotHandle.capture(self.session)
         with snapshot_scope(snapshot):
             token = session_token(self.session, enabled)
             cost_class = "unknown"
@@ -434,6 +472,13 @@ class QueryServer:
         # Future.result timeout is a backstop; the worker resolves the future
         # with RequestTimeout at the deadline itself
         return fut.result(timeout=None if t is None else t + 5.0)
+
+    def _on_commit_event(self, event) -> None:
+        """Bus subscriber (see start): invalidate the SQL-text memo on any
+        commit so repeated query text re-resolves against the post-commit
+        source listing."""
+        with self._sql_memo_lock:
+            self._sql_memo.clear()
 
     def _parse(self, query: Any):
         if isinstance(query, str):

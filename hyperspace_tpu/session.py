@@ -73,6 +73,12 @@ class Session:
         # lazily-built fingerprint-keyed ProfileHistory for ad-hoc queries
         # (QueryServer instances own their own, registry-labeled per server)
         self._profile_history = None
+        # scale-out fabric runtime (commit watcher + coherence sidecar) —
+        # None at defaults; wired last so its bus subscription and watcher
+        # see a fully-constructed session
+        from hyperspace_tpu import fabric as _fabric
+
+        self._fabric = _fabric.configure(self)
 
     # --- reading data ------------------------------------------------------
     def read(self, paths, file_format: str, **options) -> "DataFrame":  # noqa: F821
@@ -194,6 +200,14 @@ class Session:
 
             self._lifecycle_bus = InvalidationBus(self)
         return self._lifecycle_bus
+
+    # --- scale-out fabric ---------------------------------------------------
+    @property
+    def fabric(self):
+        """This session's :class:`~hyperspace_tpu.fabric.FabricRuntime`
+        (commit watcher + coherence sidecar), or None while
+        ``hyperspace.fabric.enabled`` is off. See docs/scale-out.md."""
+        return self._fabric
 
     # --- query profiles (obs) ----------------------------------------------
     def last_query_profile(self):

@@ -1,0 +1,10 @@
+"""Seeded ``process-local-state`` violations — every binding must fire."""
+
+import itertools
+from collections import defaultdict
+
+BREAKERS = {}
+HISTORY = defaultdict(list)
+_request_seq = itertools.count()
+SEEN: set = set()
+ROUTES = FrontDoorRegistry()  # noqa: F821 — lint parses, never imports

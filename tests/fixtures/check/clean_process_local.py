@@ -1,0 +1,33 @@
+"""Clean ``process-local-state`` fixture: every escape hatch in one file."""
+
+import itertools
+
+
+class StrikeRegistry:
+    pass
+
+
+# fabric-published: listed in __fabric_published__ below
+STRIKES = StrikeRegistry()
+
+# explicitly process-local
+_seq = itertools.count()  # hscheck: disable=process-local-state
+
+# immutable module constants are never flagged
+KINDS = ("transient", "corrupt")
+LIMIT = 8
+ENABLED = False
+
+# dunders are exempt (mutable list or not)
+__all__ = ["STRIKES"]
+
+__fabric_published__ = ("STRIKES",)
+
+
+def handler():
+    cache = {}  # function-local mutables are instance/local state, fine
+    return cache
+
+
+class Holder:
+    slots = {}  # class-body state is per-instance policy, out of scope

@@ -103,6 +103,31 @@ class TestRulePairs:
         # and broad excepts away from lake IO all pass.
         assert lint_one(fixture("clean_io_swallow.py"), "io-error-swallow") == []
 
+    def test_process_local_state_bad(self):
+        found = lint_one(fixture("bad_process_local.py"), "process-local-state")
+        assert [f.line for f in found] == [6, 7, 8, 9, 10]
+        reasons = " | ".join(f.message for f in found)
+        assert "'BREAKERS'" in reasons
+        assert "defaultdict()" in reasons
+        assert "count()" in reasons
+        assert "FrontDoorRegistry()" in reasons
+        assert "__fabric_published__" in found[0].message
+
+    def test_process_local_state_clean(self):
+        # __fabric_published__ listing, a pragma, immutable constants,
+        # dunders, and function/class-body mutables all pass.
+        assert lint_one(fixture("clean_process_local.py"), "process-local-state") == []
+
+    def test_process_local_state_only_fires_under_serving_or_reliability(self):
+        # Full-scope runs keep the rule off layers whose module state the
+        # fabric does not reason about — bad_jit.py lives outside them.
+        from hyperspace_tpu.check.rules.process_local_state import _in_scope
+
+        assert _in_scope(os.path.join("hyperspace_tpu", "serving", "x.py"))
+        assert _in_scope(os.path.join("hyperspace_tpu", "reliability", "x.py"))
+        assert not _in_scope(os.path.join("hyperspace_tpu", "obs", "x.py"))
+        assert not _in_scope("bench.py")
+
 
 class TestSuppression:
     def test_pragma(self):
@@ -127,6 +152,7 @@ class TestRunLint:
             "metric-families",
             "snapshot-pin",
             "io-error-swallow",
+            "process-local-state",
         }
 
     def test_default_scope_excludes_tests(self):
