@@ -58,6 +58,12 @@ policy on, cold decode every query (io cache disabled) so the seam is
 actually exercised. Every served result is compared against a clean oracle
 digest. Bars: zero wrong answers, zero unclassified errors, faulted p99
 <= 3x clean p99. Writes BENCH_faults.json.
+
+``--failover`` runs the fabric crash-tolerance benchmark: 3 fabric worker
+processes behind a health-aware FrontDoor, one SIGKILLed under client load.
+Every request is checked against the expected answer. Bars: zero requests
+lost, zero wrong answers, dead-worker ejection within 2 heartbeat
+intervals. Writes BENCH_failover.json.
 """
 
 from __future__ import annotations
@@ -2008,6 +2014,231 @@ def fabric_main() -> None:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def failover_main() -> None:
+    """``python bench.py --failover``: fabric crash tolerance under load.
+
+    3 fabric worker subprocesses behind a FrontDoor with health tracking
+    and failover on (failure threshold 1, heartbeat-paced probing). Client
+    threads route tenant-affine queries; a third of the way through, one
+    worker is SIGKILLed. Every request's answer is validated against the
+    expected marker counts. A monitor thread probes ``/healthz`` every
+    heartbeat interval and records how long the dead worker stayed in the
+    rendezvous set. Bars (nonzero exit on violation): zero requests lost,
+    zero wrong answers, detection within 2 heartbeat intervals.
+    """
+    _honor_cpu_request()
+    _backend_watchdog()
+    import signal
+    import subprocess
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+
+    import hyperspace_tpu as hst
+    from hyperspace_tpu.fabric import FrontDoor
+    from hyperspace_tpu.fabric.health import HealthTracker
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    workers_n = 3
+    rows_per_file = int(os.environ.get("BENCH_FAILOVER_ROWS", 20_000))
+    total_queries = max(24, int(os.environ.get("BENCH_FAILOVER_QUERIES", 90)))
+    clients = max(2, int(os.environ.get("BENCH_FAILOVER_CLIENTS", 6)))
+    hb_s = float(os.environ.get("BENCH_FAILOVER_HEARTBEAT", "0.5"))
+    tmp = tempfile.mkdtemp(prefix="hs_bench_failover_")
+    try:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        data_dir = os.path.join(tmp, "marked")
+        sys_dir = os.path.join(tmp, "indexes")
+        os.makedirs(data_dir)
+        os.makedirs(sys_dir)
+        initial = 3
+        for marker in range(initial):
+            t = pa.table(
+                {
+                    "c1": (np.arange(rows_per_file, dtype=np.int64) * 13) % 1000,
+                    "m": np.full(rows_per_file, marker, dtype=np.int64),
+                }
+            )
+            final = os.path.join(data_dir, f"part-{marker:05d}.parquet")
+            pq.write_table(t, final + ".tmp")
+            os.replace(final + ".tmp", final)
+        expect = {m: rows_per_file for m in range(initial)}
+
+        writer = hst.Session(
+            conf={
+                hst.keys.SYSTEM_PATH: sys_dir,
+                hst.keys.FABRIC_ENABLED: True,
+                hst.keys.FABRIC_NODE_ID: "writer",
+                hst.keys.FABRIC_WATCHER_ENABLED: False,
+            }
+        )
+        hst.Hyperspace(writer).create_index(
+            writer.read_parquet(data_dir),
+            hst.CoveringIndexConfig("foIdx", ["c1"], ["m"]),
+        )
+
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["HS_BENCH_FABRIC_DATA"] = data_dir
+        env["HS_BENCH_FABRIC_SYS"] = sys_dir
+        env["HS_BENCH_FABRIC_POLL"] = "0.5"
+        procs = []
+        try:
+            for i in range(workers_n):
+                env_i = dict(env, HS_BENCH_FABRIC_NAME=f"qs{i}")
+                procs.append(
+                    subprocess.Popen(
+                        [sys.executable, os.path.abspath(__file__), "--fabric-child"],
+                        env=env_i,
+                        stdin=subprocess.PIPE,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE,
+                        text=True,
+                    )
+                )
+            urls = [p.stdout.readline().strip() for p in procs]
+            for p, u in zip(procs, urls):
+                if not u.startswith("http://"):
+                    raise RuntimeError(
+                        f"fabric child failed to start: {p.stderr.read()[-2000:]}"
+                    )
+            health = HealthTracker(
+                failure_threshold=1,
+                probe_interval_s=3600.0,  # no readmission during the bench
+                heartbeat_interval_s=hb_s,
+                missed_beats=2,
+            )
+            fd = FrontDoor(urls, health=health, failover=True)
+            dead_wid = next(
+                w for w in fd.worker_ids if fd._workers[w] == urls[0].rstrip("/")
+            )
+            tenants = [f"tenant-{i}" for i in range(clients)]
+            for t in tenants:  # warm every worker: compile + decode
+                fd.query("SELECT m FROM t WHERE c1 >= 0", tenant=t)
+
+            def retries_sum() -> int:
+                return sum(
+                    int(
+                        REGISTRY.counter(
+                            "hs_frontdoor_failover_retries_total", worker=w
+                        ).value
+                    )
+                    for w in fd.worker_ids
+                )
+
+            retries0 = retries_sum()
+            state_lock = threading.Lock()
+            done = [0]
+            failed, wrong = [], []
+            lat_before, lat_after = [], []
+            killed = threading.Event()
+
+            def run_query(i: int) -> None:
+                tenant = tenants[i % clients]
+                t0 = time.perf_counter()
+                try:
+                    res = fd.query("SELECT m FROM t WHERE c1 >= 0", tenant=tenant)
+                except Exception as exc:
+                    with state_lock:
+                        failed.append((tenant, type(exc).__name__, str(exc)[:200]))
+                        done[0] += 1
+                    return
+                lat = time.perf_counter() - t0
+                vals, cnts = np.unique(res["m"], return_counts=True)
+                seen = dict(zip(vals.tolist(), cnts.tolist()))
+                with state_lock:
+                    (lat_after if killed.is_set() else lat_before).append(lat)
+                    if seen != expect:
+                        wrong.append((tenant, seen))
+                    done[0] += 1
+
+            detect = [None]
+            with ThreadPoolExecutor(max_workers=clients) as pool:
+                futs = [pool.submit(run_query, i) for i in range(total_queries)]
+                while done[0] < total_queries // 3:
+                    time.sleep(0.01)
+                t_kill = time.perf_counter()
+                os.kill(procs[0].pid, signal.SIGKILL)
+                killed.set()
+                procs[0].wait(timeout=30)
+                # the monitor loop: heartbeat-paced /healthz probing is what
+                # notices a dead worker even with no client traffic on it.
+                # Worst-case phase: the schedule just missed the kill, so the
+                # first probe lands a full heartbeat later.
+                next_probe = t_kill + hb_s
+                deadline = t_kill + 30.0
+                while time.perf_counter() < deadline:
+                    if health.state_of(dead_wid) == "ejected":
+                        detect[0] = time.perf_counter() - t_kill
+                        break
+                    if time.perf_counter() >= next_probe:
+                        fd.probe(timeout=hb_s)
+                        next_probe = time.perf_counter() + hb_s
+                    time.sleep(0.02)
+                for f in futs:
+                    f.result(timeout=300)
+            rerouted = retries_sum() - retries0
+        finally:
+            writer.fabric.stop()
+            for p in procs:
+                try:
+                    p.stdin.close()
+                except Exception:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except Exception:
+                    p.kill()
+
+        def p99(lats):
+            return round(float(np.percentile(np.asarray(lats), 99)), 4) if lats else None
+
+        out = {
+            "metric": "fabric_failover_detection",
+            "value": round(detect[0], 4) if detect[0] is not None else None,
+            "unit": "seconds from SIGKILL to rendezvous-set ejection",
+            "vs_baseline": round(detect[0] / (2 * hb_s), 4)
+            if detect[0] is not None
+            else None,
+            "heartbeat_interval_s": hb_s,
+            "workers": workers_n,
+            "requests_total": total_queries,
+            "requests_failed": len(failed),
+            "requests_wrong": len(wrong),
+            "requests_rerouted": int(rerouted),
+            "steady_p99_s": p99(lat_before),
+            "failover_p99_s": p99(lat_after),
+            "rows_per_file": rows_per_file,
+            "platform": jax.default_backend(),
+            "cpus": os.cpu_count(),
+        }
+        line = json.dumps(out)
+        with open("BENCH_failover.json", "w") as f:
+            f.write(line + "\n")
+        print(line)
+        bars = []
+        if failed:
+            bars.append(f"{len(failed)} requests lost (bar: 0): {failed[:3]}")
+        if wrong:
+            bars.append(f"{len(wrong)} wrong answers (bar: 0): {wrong[:3]}")
+        if detect[0] is None:
+            bars.append("dead worker never ejected within 30s")
+        elif detect[0] > 2 * hb_s:
+            bars.append(
+                f"detection {detect[0]:.2f}s > 2 heartbeat intervals ({2 * hb_s:.2f}s)"
+            )
+        if rerouted == 0:
+            bars.append("no request was ever rerouted: the kill measured nothing")
+        if bars:
+            raise SystemExit("failover bench bars violated: " + "; ".join(bars))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if "--serve" in sys.argv[1:]:
         serve_main()
@@ -2037,5 +2268,7 @@ if __name__ == "__main__":
         fabric_child_main()
     elif "--fabric" in sys.argv[1:]:
         fabric_main()
+    elif "--failover" in sys.argv[1:]:
+        failover_main()
     else:
         main()

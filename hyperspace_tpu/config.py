@@ -185,6 +185,23 @@ class keys:
     FABRIC_QUARANTINE_SHARED = "hyperspace.fabric.quarantine.shared"
     FABRIC_SLO_SHARED = "hyperspace.fabric.slo.shared"
     FABRIC_SLO_PUBLISH_INTERVAL_SECONDS = "hyperspace.fabric.slo.publishIntervalSeconds"
+    # Fabric crash tolerance: lake-persisted refresh leases with fencing
+    # tokens, health-aware FrontDoor failover, and fsck lake garbage
+    # collection. ALL default-off on top of the fabric's own default-off.
+    FABRIC_LEASE_ENABLED = "hyperspace.fabric.lease.enabled"
+    FABRIC_LEASE_TTL_SECONDS = "hyperspace.fabric.lease.ttlSeconds"
+    FABRIC_LEASE_RENEW_INTERVAL_SECONDS = "hyperspace.fabric.lease.renewIntervalSeconds"
+    FABRIC_HEALTH_ENABLED = "hyperspace.fabric.health.enabled"
+    FABRIC_HEALTH_FAILURE_THRESHOLD = "hyperspace.fabric.health.failureThreshold"
+    FABRIC_HEALTH_PROBE_INTERVAL_SECONDS = "hyperspace.fabric.health.probeIntervalSeconds"
+    FABRIC_HEALTH_HEARTBEAT_INTERVAL_SECONDS = "hyperspace.fabric.health.heartbeatIntervalSeconds"
+    FABRIC_HEALTH_MISSED_BEATS = "hyperspace.fabric.health.missedBeats"
+    FABRIC_HEALTH_MAX_COMMIT_LAG = "hyperspace.fabric.health.maxCommitLag"
+    FABRIC_HEALTH_HEDGE_MS = "hyperspace.fabric.health.hedgeMs"
+    FABRIC_FSCK_ENABLED = "hyperspace.fabric.fsck.enabled"
+    FABRIC_FSCK_RETENTION_SECONDS = "hyperspace.fabric.fsck.retentionSeconds"
+    FABRIC_FSCK_DEAD_NODE_SECONDS = "hyperspace.fabric.fsck.deadNodeSeconds"
+    FABRIC_FSCK_INTERVAL_SECONDS = "hyperspace.fabric.fsck.intervalSeconds"
 
 
 # Defaults (ref: HS/index/IndexConstants.scala — e.g. numBuckets default is
@@ -502,6 +519,52 @@ DEFAULTS: Dict[str, Any] = {
     keys.FABRIC_SLO_SHARED: True,
     # Seconds between sidecar publish/merge rounds.
     keys.FABRIC_SLO_PUBLISH_INTERVAL_SECONDS: 1.0,
+    # Lake-persisted refresh lease: when on (and the fabric is on), the
+    # RefreshManager acquires a per-index lease before building, so exactly
+    # one *process* refreshes an index, and the lease's fencing token is
+    # verified at every operation-log write — a holder that paused past
+    # expiry and was taken over fails its late commit instead of landing it.
+    keys.FABRIC_LEASE_ENABLED: False,
+    # How long an unrenewed lease stays exclusive; also the takeover bound
+    # for a holder killed mid-refresh.
+    keys.FABRIC_LEASE_TTL_SECONDS: 30.0,
+    # Heartbeat renewal cadence while a refresh holds its lease. Keep well
+    # under the TTL (a renewal extends the expiry by one full TTL).
+    keys.FABRIC_LEASE_RENEW_INTERVAL_SECONDS: 10.0,
+    # Health-aware FrontDoor membership: consecutive failures / missed
+    # sidecar heartbeats / commit-seq staleness eject a worker from the
+    # rendezvous set (tenants re-hash to survivors); a half-open probe
+    # re-admits it. Also enables retry-on-next-candidate failover.
+    keys.FABRIC_HEALTH_ENABLED: False,
+    # Consecutive transport/transient failures before ejection.
+    keys.FABRIC_HEALTH_FAILURE_THRESHOLD: 3,
+    # Cooldown before an ejected worker gets one half-open probe request.
+    keys.FABRIC_HEALTH_PROBE_INTERVAL_SECONDS: 5.0,
+    # Expected sidecar heartbeat cadence (the ledger publish interval of
+    # the workers being watched); beat age is judged against this.
+    keys.FABRIC_HEALTH_HEARTBEAT_INTERVAL_SECONDS: 1.0,
+    # A worker whose ledger heartbeat is older than this many intervals is
+    # ejected as dead — the failover detection bound is 2 intervals.
+    keys.FABRIC_HEALTH_MISSED_BEATS: 2,
+    # Eject a worker whose /healthz last-applied commit_seq lags the fleet
+    # max by more than this (a wedged watcher serves stale answers while
+    # looking alive). 0 disables staleness ejection.
+    keys.FABRIC_HEALTH_MAX_COMMIT_LAG: 0,
+    # Hedged reads: if the primary worker hasn't answered within this many
+    # milliseconds, mirror the (idempotent) query to the next rendezvous
+    # candidate and take whichever answers first. 0 disables hedging.
+    keys.FABRIC_HEALTH_HEDGE_MS: 0.0,
+    # Run the fsck garbage collector (fabric/fsck.py) at session start and
+    # then periodically: compacts old/torn commit records, superseded lease
+    # tokens, expired leases, and dead-node ledgers.
+    keys.FABRIC_FSCK_ENABLED: False,
+    # Commit records older than this are compacted (the newest record per
+    # index is always kept so watcher cursors stay monotonic).
+    keys.FABRIC_FSCK_RETENTION_SECONDS: 3600.0,
+    # Node ledgers silent for longer than this are removed.
+    keys.FABRIC_FSCK_DEAD_NODE_SECONDS: 600.0,
+    # Seconds between periodic fsck passes when enabled.
+    keys.FABRIC_FSCK_INTERVAL_SECONDS: 300.0,
 }
 
 REFRESH_MODE_INCREMENTAL = "incremental"
@@ -1033,6 +1096,62 @@ class HyperspaceConf:
     @property
     def fabric_slo_publish_interval_seconds(self) -> float:
         return float(self.get(keys.FABRIC_SLO_PUBLISH_INTERVAL_SECONDS))
+
+    @property
+    def fabric_lease_enabled(self) -> bool:
+        return bool(self.get(keys.FABRIC_LEASE_ENABLED))
+
+    @property
+    def fabric_lease_ttl_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_LEASE_TTL_SECONDS))
+
+    @property
+    def fabric_lease_renew_interval_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_LEASE_RENEW_INTERVAL_SECONDS))
+
+    @property
+    def fabric_health_enabled(self) -> bool:
+        return bool(self.get(keys.FABRIC_HEALTH_ENABLED))
+
+    @property
+    def fabric_health_failure_threshold(self) -> int:
+        return int(self.get(keys.FABRIC_HEALTH_FAILURE_THRESHOLD))
+
+    @property
+    def fabric_health_probe_interval_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_HEALTH_PROBE_INTERVAL_SECONDS))
+
+    @property
+    def fabric_health_heartbeat_interval_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_HEALTH_HEARTBEAT_INTERVAL_SECONDS))
+
+    @property
+    def fabric_health_missed_beats(self) -> int:
+        return int(self.get(keys.FABRIC_HEALTH_MISSED_BEATS))
+
+    @property
+    def fabric_health_max_commit_lag(self) -> int:
+        return int(self.get(keys.FABRIC_HEALTH_MAX_COMMIT_LAG))
+
+    @property
+    def fabric_health_hedge_ms(self) -> float:
+        return float(self.get(keys.FABRIC_HEALTH_HEDGE_MS))
+
+    @property
+    def fabric_fsck_enabled(self) -> bool:
+        return bool(self.get(keys.FABRIC_FSCK_ENABLED))
+
+    @property
+    def fabric_fsck_retention_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_FSCK_RETENTION_SECONDS))
+
+    @property
+    def fabric_fsck_dead_node_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_FSCK_DEAD_NODE_SECONDS))
+
+    @property
+    def fabric_fsck_interval_seconds(self) -> float:
+        return float(self.get(keys.FABRIC_FSCK_INTERVAL_SECONDS))
 
     def deltas(self) -> Dict[str, Any]:
         """Explicitly-set keys whose value differs from the centralized

@@ -22,6 +22,7 @@ behavior is byte-identical to a build without this package.
 
 from __future__ import annotations
 
+import threading
 import weakref
 from typing import Optional
 
@@ -29,9 +30,15 @@ from hyperspace_tpu.fabric.coherence import CoherenceSidecar
 from hyperspace_tpu.fabric.frontdoor import (
     FrontDoor,
     WorkerEndpoint,
+    WorkerError,
+    WorkerUnavailable,
     merge_prometheus_texts,
+    rendezvous_order,
     rendezvous_pick,
 )
+from hyperspace_tpu.fabric.health import HealthTracker
+from hyperspace_tpu.fabric.lease import Lease, LeaseLostError, fence_scope
+from hyperspace_tpu.fabric.lease import acquire as acquire_lease
 from hyperspace_tpu.fabric.records import local_node_id
 from hyperspace_tpu.fabric.watcher import CommitWatcher
 
@@ -40,10 +47,18 @@ __all__ = [
     "CoherenceSidecar",
     "FabricRuntime",
     "FrontDoor",
+    "HealthTracker",
+    "Lease",
+    "LeaseLostError",
     "WorkerEndpoint",
+    "WorkerError",
+    "WorkerUnavailable",
+    "acquire_lease",
     "configure",
+    "fence_scope",
     "local_node_id",
     "merge_prometheus_texts",
+    "rendezvous_order",
     "rendezvous_pick",
 ]
 
@@ -66,12 +81,58 @@ class FabricRuntime:
         self.watcher = CommitWatcher(session, node_id=self.node_id)
         self.sidecar = CoherenceSidecar(session, node_id=self.node_id)
         self.share_quarantine = bool(conf.fabric_quarantine_shared)
+        self._fsck_thread: Optional[threading.Thread] = None
+        self._fsck_stop = threading.Event()
         session.lifecycle_bus.subscribe(self._on_commit)
         if autostart:
             if conf.fabric_watcher_enabled:
                 self.watcher.start()
-            if self.share_quarantine or conf.fabric_slo_shared:
+            # health-aware FrontDoors read the node files' updatedAt as the
+            # fleet heartbeat, so the sidecar also runs for health alone
+            if (
+                self.share_quarantine
+                or conf.fabric_slo_shared
+                or conf.fabric_health_enabled
+            ):
                 self.sidecar.start()
+            if conf.fabric_fsck_enabled and conf.system_path:
+                self.fsck_once()
+                self._start_fsck_loop(conf.fabric_fsck_interval_seconds)
+
+    # -- lake garbage collection ---------------------------------------------
+    def fsck_once(self) -> Optional[dict]:
+        """One fsck pass over this session's lake (fabric/fsck.py); a
+        failing pass is swallowed — GC must never take down serving."""
+        session = self._session_ref()
+        if session is None:
+            return None
+        conf = session.conf
+        from hyperspace_tpu.fabric.fsck import fsck
+
+        try:
+            return fsck(
+                conf.system_path,
+                retention_s=conf.fabric_fsck_retention_seconds,
+                dead_node_s=conf.fabric_fsck_dead_node_seconds,
+            )
+        except Exception:
+            return None
+
+    def _start_fsck_loop(self, interval_s: float) -> None:
+        if self._fsck_thread is not None:
+            return
+        self._fsck_stop.clear()
+
+        def _run() -> None:
+            while not self._fsck_stop.wait(interval_s):
+                if self._session_ref() is None:
+                    return
+                self.fsck_once()
+
+        self._fsck_thread = threading.Thread(
+            target=_run, name="hs-fabric-fsck", daemon=True
+        )
+        self._fsck_thread.start()
 
     # -- serving attachment --------------------------------------------------
     def attach_server(self, server) -> None:
@@ -101,6 +162,10 @@ class FabricRuntime:
     def stop(self) -> None:
         self.watcher.stop()
         self.sidecar.stop()
+        self._fsck_stop.set()
+        if self._fsck_thread is not None:
+            self._fsck_thread.join(timeout=5)
+            self._fsck_thread = None
         session = self._session_ref()
         if session is not None:
             session.lifecycle_bus.unsubscribe(self._on_commit)

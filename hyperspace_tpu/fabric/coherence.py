@@ -56,8 +56,11 @@ class CoherenceSidecar:
         node_id: Optional[str] = None,
         interval: Optional[float] = None,
     ):
+        import time as _time
+
         conf = session.conf
         self._session_ref = weakref.ref(session)
+        self._started_at = _time.time()
         self.node_id = node_id or records.local_node_id(conf)
         self.interval = float(
             conf.fabric_slo_publish_interval_seconds if interval is None else interval
@@ -91,7 +94,17 @@ class CoherenceSidecar:
         session = self._session_ref()
         if session is None:
             return False
-        state: dict = {}
+        import time as _time
+
+        # the node file's updatedAt is the fleet heartbeat; this payload
+        # adds what liveness checks want alongside it (FrontDoor.check_beats
+        # reads updatedAt age, /healthz consumers read commitSeq lag)
+        state: dict = {
+            "heartbeat": {
+                "commitSeq": int(getattr(session.lifecycle_bus, "commit_seq", 0)),
+                "uptimeSeconds": max(0.0, _time.time() - self._started_at),
+            }
+        }
         if self.share_quarantine:
             from hyperspace_tpu.reliability.degrade import QUARANTINE
 

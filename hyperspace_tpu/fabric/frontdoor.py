@@ -16,20 +16,56 @@ Workers come in two flavors, freely mixed:
   exposes one QueryServer to other processes (``GET/POST /query``,
   ``/metrics``, ``/statusz``, ``/healthz``). Results travel as JSON
   columns and come back as numpy arrays, same shape ``collect()`` returns.
+
+Crash tolerance (``hyperspace.fabric.health.*``, default off — at
+defaults routing is the original single-candidate raise-on-failure):
+
+- **typed errors over the wire**: a worker failure is classified through
+  ``reliability.errors.classify`` *on the worker*, serialized in the JSON
+  body (``errorType``/``kind``/``retryable``), and rehydrated here as
+  :class:`WorkerUnavailable` (retry elsewhere may help) or
+  :class:`WorkerError` (the query itself is bad — retrying rereads the
+  same wrong bytes), so retry/no-retry decisions survive the process hop.
+- **health-aware membership**: a :class:`~hyperspace_tpu.fabric.health.HealthTracker`
+  ejects workers on consecutive failures, missed sidecar heartbeats
+  (:meth:`FrontDoor.check_beats`), or ``/healthz`` commit-seq staleness
+  (:meth:`FrontDoor.probe`); tenants re-hash to the survivors and the
+  ejected worker returns via a half-open probe.
+- **deadline-aware failover**: ``query`` walks the tenant's rendezvous
+  preference order, retrying a :class:`WorkerUnavailable` on the next
+  candidate while the caller's deadline allows
+  (``hs_frontdoor_failover_retries_total``).
+- **hedged reads**: with ``hedgeMs`` set, a primary silent past the hedge
+  delay gets its (idempotent) query mirrored to the next candidate;
+  first answer wins (``hs_frontdoor_failover_hedges_total``).
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import queue
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence
 
-__all__ = ["FrontDoor", "WorkerEndpoint", "rendezvous_pick", "merge_prometheus_texts"]
+__all__ = [
+    "FrontDoor",
+    "WorkerEndpoint",
+    "WorkerError",
+    "WorkerUnavailable",
+    "rendezvous_pick",
+    "rendezvous_order",
+    "merge_prometheus_texts",
+]
+
+
+def _rendezvous_weight(key: str, node: str) -> bytes:
+    return hashlib.sha256(f"{key}|{node}".encode("utf-8")).digest()
 
 
 def rendezvous_pick(key: str, nodes: Sequence[str]) -> str:
@@ -37,10 +73,17 @@ def rendezvous_pick(key: str, nodes: Sequence[str]) -> str:
     computes the same winner from the membership list alone."""
     if not nodes:
         raise ValueError("rendezvous_pick needs at least one node")
-    return max(
-        nodes,
-        key=lambda n: hashlib.sha256(f"{key}|{n}".encode("utf-8")).digest(),
-    )
+    return max(nodes, key=lambda n: _rendezvous_weight(key, n))
+
+
+def rendezvous_order(key: str, nodes: Sequence[str]) -> List[str]:
+    """All nodes in descending rendezvous weight — the key's full failover
+    preference order. ``rendezvous_order(k, ns)[0] == rendezvous_pick(k, ns)``,
+    and removing the winner promotes exactly the next entry, so failover
+    lands where the tenant would re-hash anyway."""
+    if not nodes:
+        raise ValueError("rendezvous_order needs at least one node")
+    return sorted(nodes, key=lambda n: _rendezvous_weight(key, n), reverse=True)
 
 
 def merge_prometheus_texts(texts: Sequence[str]) -> str:
@@ -75,6 +118,28 @@ def merge_prometheus_texts(texts: Sequence[str]) -> str:
     return "\n".join(out) + ("\n" if out else "")
 
 
+class WorkerUnavailable(RuntimeError):
+    """The worker could not answer (transport failure, injected/classified
+    transient, admission shed): the *same* query on another worker may
+    succeed, so this is the failover-retryable wire error."""
+
+    def __init__(self, message: str, error_type: str = "", kind: str = "transient"):
+        super().__init__(message)
+        self.error_type = error_type
+        self.kind = kind
+
+
+class WorkerError(RuntimeError):
+    """The worker answered with a non-retryable typed error (bad SQL,
+    corrupt data): every worker would fail identically, so the error goes
+    straight to the caller instead of burning failover attempts."""
+
+    def __init__(self, message: str, error_type: str = "", kind: str = "error"):
+        super().__init__(message)
+        self.error_type = error_type
+        self.kind = kind
+
+
 def _count_route(worker: str) -> None:
     from hyperspace_tpu.obs.metrics import REGISTRY
 
@@ -85,10 +150,72 @@ def _count_route(worker: str) -> None:
     ).inc()
 
 
-class FrontDoor:
-    """Tenant-affine router over a fixed worker set (see module docstring)."""
+def _count_failover_retry(worker: str) -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
 
-    def __init__(self, workers: Sequence[Any]):
+    REGISTRY.counter(
+        "hs_frontdoor_failover_retries_total",
+        "failed attempts rerouted to the next rendezvous candidate, by "
+        "the worker that failed",
+        worker=worker,
+    ).inc()
+
+
+def _count_failover_exhausted() -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_frontdoor_failover_exhausted_total",
+        "requests that failed every eligible candidate (or ran out of "
+        "deadline) and surfaced a typed error",
+    ).inc()
+
+
+def _count_hedge() -> None:
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.counter(
+        "hs_frontdoor_failover_hedges_total",
+        "hedged requests fired to a backup worker after the primary "
+        "stayed silent past the hedge delay",
+    ).inc()
+
+
+def _retryable(exc: BaseException, worker: Any) -> bool:
+    """May the same query succeed on another worker? Wire errors carry the
+    answer; in-process exceptions are classified locally with the same
+    ``reliability.errors`` taxonomy the worker side uses."""
+    if isinstance(exc, WorkerError):
+        return False
+    if isinstance(exc, WorkerUnavailable):
+        return True
+    if isinstance(worker, str):
+        return False  # HTTP path always raises the two typed errors above
+    from hyperspace_tpu.reliability import errors as rel_errors
+
+    return not rel_errors.is_corrupt(exc)
+
+
+class FrontDoor:
+    """Tenant-affine router over a fixed worker set (see module docstring).
+
+    ``health``/``failover``/``hedge_ms`` default to the PR-13 behavior
+    (single candidate, raise on failure). Pass ``conf`` (a session conf
+    with ``hyperspace.fabric.health.enabled``) or explicit kwargs to turn
+    the crash-tolerance machinery on.
+    """
+
+    def __init__(
+        self,
+        workers: Sequence[Any],
+        *,
+        health: Optional[Any] = None,
+        failover: bool = False,
+        hedge_ms: float = 0.0,
+        system_path: Optional[str] = None,
+        clock=time.monotonic,
+        conf: Optional[Any] = None,
+    ):
         if not workers:
             raise ValueError("FrontDoor needs at least one worker")
         self._workers: Dict[str, Any] = {}
@@ -98,13 +225,47 @@ class FrontDoor:
             else:
                 self._workers[getattr(w, "server_name", f"w{i}")] = w
         self._ids = sorted(self._workers)
+        self._clock = clock
+        if conf is not None and conf.fabric_health_enabled and health is None:
+            from hyperspace_tpu.fabric.health import HealthTracker
+
+            health = HealthTracker(
+                failure_threshold=conf.fabric_health_failure_threshold,
+                probe_interval_s=conf.fabric_health_probe_interval_seconds,
+                heartbeat_interval_s=conf.fabric_health_heartbeat_interval_seconds,
+                missed_beats=conf.fabric_health_missed_beats,
+                max_commit_lag=conf.fabric_health_max_commit_lag,
+            )
+            failover = True
+            hedge_ms = conf.fabric_health_hedge_ms
+            system_path = system_path or conf.system_path
+        self._health = health
+        self._failover = bool(failover) or health is not None
+        self._hedge_s = float(hedge_ms) / 1000.0
+        self._system_path = system_path
+        #: worker id -> fabric node id, learned from /healthz bodies; maps
+        #: sidecar heartbeat ledgers back onto rendezvous members
+        self._nodes: Dict[str, str] = {}
 
     @property
     def worker_ids(self) -> List[str]:
         return list(self._ids)
 
+    @property
+    def health(self) -> Optional[Any]:
+        return self._health
+
     def pick(self, tenant: str) -> str:
         return rendezvous_pick(str(tenant), self._ids)
+
+    def _candidates(self, tenant: str) -> List[str]:
+        """The tenant's failover preference order over the currently-live
+        membership. Without failover this is the single PR-13 pick."""
+        ids = self._ids
+        if self._health is not None:
+            ids = self._health.live(ids)
+        order = rendezvous_order(str(tenant), ids)
+        return order if self._failover else order[:1]
 
     # -- queries -------------------------------------------------------------
     def query(
@@ -114,13 +275,105 @@ class FrontDoor:
         timeout: Optional[float] = None,
     ) -> Dict[str, Any]:
         """Route one SQL query to the tenant's worker and return the
-        collected batch (dict of numpy arrays, like ``collect()``)."""
-        wid = self.pick(tenant)
-        _count_route(wid)
-        worker = self._workers[wid]
+        collected batch (dict of numpy arrays, like ``collect()``). With
+        failover on, a retryable failure moves to the next rendezvous
+        candidate while the deadline allows; a non-retryable one raises
+        immediately."""
+        candidates = self._candidates(tenant)
+        if self._hedge_s > 0 and len(candidates) > 1:
+            return self._hedged_query(candidates, sql, tenant, timeout)
+        deadline = None if timeout is None else self._clock() + timeout
+        last_exc: Optional[BaseException] = None
+        for i, wid in enumerate(candidates):
+            remaining = timeout
+            if deadline is not None:
+                remaining = deadline - self._clock()
+                if i > 0 and remaining <= 0:
+                    break  # deadline spent: don't start an attempt that can't finish
+            _count_route(wid)
+            worker = self._workers[wid]
+            try:
+                out = self._dispatch(worker, sql, tenant, remaining)
+            except Exception as exc:
+                if not self._failover or not _retryable(exc, worker):
+                    if self._health is not None and _retryable(exc, worker):
+                        self._health.note_failure(wid)
+                    raise
+                if self._health is not None:
+                    self._health.note_failure(wid)
+                _count_failover_retry(wid)
+                last_exc = exc
+                continue
+            if self._health is not None:
+                self._health.note_ok(wid)
+            return out
+        _count_failover_exhausted()
+        if last_exc is not None:
+            raise last_exc
+        raise WorkerUnavailable(
+            f"no candidate answered for tenant {tenant!r} within the deadline"
+        )
+
+    def _dispatch(
+        self, worker: Any, sql: str, tenant: str, timeout: Optional[float]
+    ) -> Dict[str, Any]:
         if isinstance(worker, str):
             return self._http_query(worker, sql, tenant, timeout)
         return worker.query(sql, timeout=timeout, tenant=tenant)
+
+    def _hedged_query(
+        self,
+        candidates: List[str],
+        sql: str,
+        tenant: str,
+        timeout: Optional[float],
+    ) -> Dict[str, Any]:
+        """Primary + (on silence or failure) one backup, first answer wins.
+        Safe because FrontDoor queries are idempotent reads — both answers
+        are correct, we just keep whichever lands first."""
+        results: "queue.Queue" = queue.Queue()
+
+        def run(wid: str) -> None:
+            _count_route(wid)
+            try:
+                results.put((wid, None, self._dispatch(self._workers[wid], sql, tenant, timeout)))
+            except Exception as exc:  # delivered to the caller via the queue
+                results.put((wid, exc, None))
+
+        def spawn(wid: str) -> None:
+            threading.Thread(target=run, args=(wid,), daemon=True).start()
+
+        spawn(candidates[0])
+        outstanding, hedged = 1, False
+        first_exc: Optional[BaseException] = None
+        while outstanding:
+            try:
+                wid, exc, out = results.get(timeout=None if hedged else self._hedge_s)
+            except queue.Empty:
+                hedged = True
+                outstanding += 1
+                _count_hedge()
+                spawn(candidates[1])
+                continue
+            outstanding -= 1
+            if exc is None:
+                if self._health is not None:
+                    self._health.note_ok(wid)
+                return out
+            if self._health is not None and _retryable(exc, self._workers[wid]):
+                self._health.note_failure(wid)
+            if first_exc is None or not isinstance(exc, WorkerUnavailable):
+                first_exc = exc
+            if not hedged:
+                # the primary failed outright before the hedge delay: the
+                # backup is now a failover attempt, not a hedge
+                hedged = True
+                outstanding += 1
+                _count_failover_retry(wid)
+                spawn(candidates[1])
+        _count_failover_exhausted()
+        assert first_exc is not None
+        raise first_exc
 
     @staticmethod
     def _http_query(
@@ -128,12 +381,19 @@ class FrontDoor:
     ) -> Dict[str, Any]:
         import numpy as np
 
+        from hyperspace_tpu.reliability.faults import FAULTS
+
         params = {"sql": sql, "tenant": tenant}
         if timeout is not None:
             params["timeoutMs"] = str(int(timeout * 1000))
         url = f"{base}/query?{urllib.parse.urlencode(params)}"
         http_timeout = 300.0 if timeout is None else timeout + 5.0
         try:
+            # the seam lives inside the handler so an injected transient
+            # (an OSError subclass) surfaces as WorkerUnavailable, exactly
+            # like the real connection failure it stands in for
+            if FAULTS.active:
+                FAULTS.check("fabric.http", f"{base}/query")
             with urllib.request.urlopen(url, timeout=http_timeout) as resp:
                 body = json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as exc:
@@ -142,32 +402,144 @@ class FrontDoor:
             try:
                 body = json.loads(exc.read().decode("utf-8"))
             except Exception:
-                raise RuntimeError(f"worker {base} failed: HTTP {exc.code}") from exc
+                raise WorkerUnavailable(
+                    f"worker {base} failed: HTTP {exc.code}",
+                    error_type="HTTPError",
+                ) from exc
+        except (urllib.error.URLError, OSError, TimeoutError) as exc:
+            # connection refused / reset / timed out: the process is gone or
+            # unreachable — exactly what failover exists for
+            raise WorkerUnavailable(
+                f"worker {base} unreachable: {exc}", error_type=type(exc).__name__
+            ) from exc
         if "error" in body:
-            raise RuntimeError(f"worker {base} failed: {body['error']}")
+            message = f"worker {base} failed: {body['error']}"
+            error_type = str(body.get("errorType", ""))
+            kind = str(body.get("kind", ""))
+            # re-classification point (reliability.errors taxonomy, serialized
+            # by WorkerEndpoint._query): transient → retry elsewhere may help;
+            # corrupt/error → every worker fails identically, don't retry
+            if body.get("retryable", kind == "transient"):
+                raise WorkerUnavailable(message, error_type=error_type,
+                                        kind=kind or "transient")
+            raise WorkerError(message, error_type=error_type, kind=kind or "error")
         return {k: np.asarray(v) for k, v in body["columns"].items()}
+
+    # -- health observation --------------------------------------------------
+    def probe(self, timeout: float = 5.0) -> Dict[str, Optional[dict]]:
+        """One ``/healthz`` sweep over every worker: successes feed
+        ``note_ok`` (which is also how an ejected worker's half-open probe
+        passes), failures feed ``note_failure``, and the reported
+        last-applied ``commitSeq`` values are compared across the fleet to
+        eject wedged-but-alive workers (``note_stale``). Returns the
+        healthz bodies by worker id (None for unreachable)."""
+        out: Dict[str, Optional[dict]] = {}
+        seqs: Dict[str, int] = {}
+        for wid, worker in self._workers.items():
+            if isinstance(worker, str):
+                try:
+                    with urllib.request.urlopen(
+                        f"{worker}/healthz", timeout=timeout
+                    ) as resp:
+                        body = json.loads(resp.read().decode("utf-8"))
+                except Exception:
+                    out[wid] = None
+                    if self._health is not None:
+                        self._health.note_failure(wid)
+                    continue
+            else:
+                body = _local_healthz(worker)
+            out[wid] = body
+            node = body.get("node")
+            if node:
+                self._nodes[wid] = str(node)
+            if self._health is not None and body.get("ok"):
+                self._health.note_ok(wid)
+            if "commitSeq" in body:
+                seqs[wid] = int(body["commitSeq"])
+        if self._health is not None and len(seqs) > 1:
+            fleet_max = max(seqs.values())
+            for wid, seq in seqs.items():
+                self._health.note_stale(wid, fleet_max - seq)
+        return out
+
+    def check_beats(self) -> Dict[str, float]:
+        """Judge sidecar-heartbeat ages: each worker whose fabric node id
+        is known (learned via :meth:`probe`) is checked against its
+        ``_fabric/nodes/<node>.json`` ledger's ``updatedAt``. Needs
+        ``system_path``; returns observed ages by worker id."""
+        ages: Dict[str, float] = {}
+        if self._health is None or not self._system_path:
+            return ages
+        from hyperspace_tpu.fabric import records
+
+        ledgers = records.read_peer_node_files(self._system_path, "")
+        now = time.time()
+        for wid, node in self._nodes.items():
+            state = ledgers.get(node)
+            if state is None:
+                continue
+            age = max(0.0, now - float(state.get("updatedAt", 0.0)))
+            ages[wid] = age
+            self._health.note_beat(wid, age)
+        return ages
 
     # -- aggregation ---------------------------------------------------------
     def metrics_text(self) -> str:
-        """One merged Prometheus exposition over every worker."""
+        """One merged Prometheus exposition over every worker. With health
+        tracking on, an unreachable worker is skipped (and noted) instead
+        of failing the whole merge."""
         texts = []
-        for worker in self._workers.values():
-            if isinstance(worker, str):
-                with urllib.request.urlopen(f"{worker}/metrics", timeout=30) as resp:
-                    texts.append(resp.read().decode("utf-8"))
-            else:
-                texts.append(worker.prometheus_text())
+        for wid, worker in self._workers.items():
+            try:
+                if isinstance(worker, str):
+                    with urllib.request.urlopen(f"{worker}/metrics", timeout=30) as resp:
+                        texts.append(resp.read().decode("utf-8"))
+                else:
+                    texts.append(worker.prometheus_text())
+            except Exception:
+                if self._health is None:
+                    raise
+                self._health.note_failure(wid)
         return merge_prometheus_texts(texts)
 
     def statusz(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for wid, worker in self._workers.items():
-            if isinstance(worker, str):
-                with urllib.request.urlopen(f"{worker}/statusz", timeout=30) as resp:
-                    out[wid] = json.loads(resp.read().decode("utf-8"))
-            else:
-                out[wid] = worker.statusz()
+            try:
+                if isinstance(worker, str):
+                    with urllib.request.urlopen(f"{worker}/statusz", timeout=30) as resp:
+                        out[wid] = json.loads(resp.read().decode("utf-8"))
+                else:
+                    out[wid] = worker.statusz()
+            except Exception:
+                if self._health is None:
+                    raise
+                self._health.note_failure(wid)
+                out[wid] = None
         return out
+
+
+def _local_healthz(server, started_at: Optional[float] = None) -> Dict[str, Any]:
+    """The /healthz body for one QueryServer — shared by WorkerEndpoint and
+    the FrontDoor's in-process probe so both paths report identically:
+    admission queue depth (shed pressure), last-applied commit_seq (watcher
+    wedge detection), uptime, and the fabric node id (heartbeat mapping)."""
+    session = getattr(server, "session", None)
+    fabric = getattr(session, "_fabric", None) if session is not None else None
+    bus = getattr(session, "lifecycle_bus", None) if session is not None else None
+    admission = getattr(server, "admission", None)
+    body: Dict[str, Any] = {
+        "ok": True,
+        "server": getattr(server, "server_name", "?"),
+        "queueDepth": int(getattr(admission, "queued", 0) or 0),
+        "commitSeq": int(getattr(bus, "commit_seq", 0) or 0),
+    }
+    if fabric is not None:
+        body["node"] = fabric.node_id
+    if started_at is not None:
+        body["uptimeSeconds"] = max(0.0, time.time() - started_at)
+    return body
 
 
 class WorkerEndpoint:
@@ -178,6 +550,7 @@ class WorkerEndpoint:
 
     def __init__(self, server, host: str = "127.0.0.1", port: int = 0):
         self.server = server
+        self._started_at = time.time()
         endpoint = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -211,6 +584,7 @@ class WorkerEndpoint:
 
     def start(self) -> "WorkerEndpoint":
         if self._thread is None:
+            self._started_at = time.time()
             self._thread = threading.Thread(
                 target=self._httpd.serve_forever,
                 name=f"hs-fabric-worker-{self.port}",
@@ -244,7 +618,9 @@ class WorkerEndpoint:
         elif path == "/statusz":
             self._reply_json(req, 200, self.server.statusz())
         elif path == "/healthz":
-            self._reply_json(req, 200, {"ok": True, "server": self.server.server_name})
+            self._reply_json(
+                req, 200, _local_healthz(self.server, started_at=self._started_at)
+            )
         else:
             self._reply_json(
                 req, 404,
@@ -255,7 +631,11 @@ class WorkerEndpoint:
     def _query(self, req: BaseHTTPRequestHandler, query: Dict[str, list]) -> None:
         sql = (query.get("sql") or [None])[0]
         if not sql:
-            self._reply_json(req, 400, {"error": "missing sql parameter"})
+            self._reply_json(
+                req, 400,
+                {"error": "missing sql parameter", "errorType": "ValueError",
+                 "kind": "error", "retryable": False},
+            )
             return
         tenant = (query.get("tenant") or ["default"])[0]
         timeout_ms = (query.get("timeoutMs") or [None])[0]
@@ -263,8 +643,20 @@ class WorkerEndpoint:
         try:
             batch = self.server.query(sql, timeout=timeout, tenant=tenant)
         except Exception as exc:
+            # serialize the reliability classification so the FrontDoor can
+            # rebuild the retry/no-retry decision on its side of the wire
+            from hyperspace_tpu.reliability import errors as rel_errors
+
+            retryable = not rel_errors.is_corrupt(exc)
             self._reply_json(
-                req, 503, {"error": f"{type(exc).__name__}: {exc}"}
+                req,
+                503 if retryable else 400,
+                {
+                    "error": f"{type(exc).__name__}: {exc}",
+                    "errorType": type(exc).__name__,
+                    "kind": "transient" if retryable else "corrupt",
+                    "retryable": retryable,
+                },
             )
             return
         self._reply_json(

@@ -1,0 +1,9 @@
+"""``python -m hyperspace_tpu.fsck`` — fabric lake garbage collection.
+
+Thin CLI shim over :func:`hyperspace_tpu.fabric.fsck.main` (which holds
+the actual pass logic and its documentation)."""
+
+from hyperspace_tpu.fabric.fsck import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -18,7 +18,11 @@ Concurrency stance:
   serving keeps resolving the prior stable version throughout;
 - **single-writer per index**: a non-blocking per-index mutex makes a second
   scheduler (or an operator-issued manual refresh racing the manager) skip
-  rather than double-build;
+  rather than double-build; with ``hyperspace.fabric.lease.enabled`` the
+  same guarantee extends across *processes* via a lake-persisted lease with
+  heartbeat renewal and a fencing token verified at the commit point
+  (``fabric/lease.py``) — a holder killed mid-refresh is taken over by a
+  peer after lease expiry, and its late commit is fenced off;
 - **crash-safe / retry-idempotent** by construction: refresh goes through
   the Action FSM (CREATING->ACTIVE via the log manager), so a failure at any
   point leaves the prior ACTIVE entry untouched and a retry re-runs the same
@@ -189,27 +193,70 @@ class RefreshManager:
                 lock = self._index_locks[name] = threading.Lock()
             return lock
 
+    def _acquire_lake_lease(self, name: str):
+        """The cross-process half of single-writer: a lake-persisted lease
+        per index (``fabric/lease.py``) when the fabric + lease conf is on.
+        Returns ``(lease, acquired)`` — ``(None, True)`` means leases are
+        off and the in-process lock alone governs, as before."""
+        conf = self._session.conf
+        if not (conf.fabric_enabled and conf.fabric_lease_enabled and conf.system_path):
+            return None, True
+        from hyperspace_tpu.fabric import lease as lease_mod
+        from hyperspace_tpu.fabric.records import local_node_id
+
+        lease = lease_mod.acquire(
+            conf.system_path,
+            f"refresh/{name}",
+            holder=local_node_id(conf),
+            ttl_s=conf.fabric_lease_ttl_seconds,
+        )
+        if lease is None:
+            return None, False
+        lease.start_heartbeat(conf.fabric_lease_renew_interval_seconds)
+        return lease, True
+
     def refresh_index(self, name: str, mode: str) -> str:
-        """Run one refresh under the per-index single-writer lock; returns
-        the outcome: committed | no-changes | busy | error."""
+        """Run one refresh under the per-index single-writer lock (plus the
+        lake lease when ``hyperspace.fabric.lease.enabled``); returns the
+        outcome: committed | no-changes | busy | fenced | error."""
         from hyperspace_tpu.actions.base import NoChangesException
 
         lock = self._lock_for(name)
         if not lock.acquire(blocking=False):
             _count_refresh(mode, "busy")
             return "busy"
+        lease = None
         try:
-            self._session.index_manager.refresh(name, mode)
-            outcome = "committed"
-        except NoChangesException:
-            # the drift we saw was committed by someone else (or a retried
-            # refresh already landed) — converged, nothing to do
-            outcome = "no-changes"
-        except Exception:
-            # the Action FSM guarantees the prior ACTIVE entry still serves;
-            # the next poll retries the same diff
-            outcome = "error"
+            lease, acquired = self._acquire_lake_lease(name)
+            if not acquired:
+                # a peer process holds the lease: same convergence story as
+                # the in-process lock — skip, the next poll re-checks drift
+                outcome = "busy"
+            else:
+                try:
+                    if lease is not None:
+                        from hyperspace_tpu.fabric.lease import fence_scope
+
+                        with fence_scope(lease):
+                            self._session.index_manager.refresh(name, mode)
+                    else:
+                        self._session.index_manager.refresh(name, mode)
+                    outcome = "committed"
+                except NoChangesException:
+                    # the drift we saw was committed by someone else (or a
+                    # retried refresh already landed) — converged
+                    outcome = "no-changes"
+                except Exception as exc:
+                    from hyperspace_tpu.fabric.lease import LeaseLostError
+
+                    # the Action FSM guarantees the prior ACTIVE entry still
+                    # serves; the next poll retries the same diff. A fenced
+                    # commit means a peer took over — also converged, but
+                    # surfaced distinctly (the zombie-writer signature).
+                    outcome = "fenced" if isinstance(exc, LeaseLostError) else "error"
         finally:
+            if lease is not None:
+                lease.release()
             lock.release()
         _count_refresh(mode, outcome)
         return outcome

@@ -13,6 +13,9 @@ match. Sites wired through the codebase:
 ``pipeline.task``         prefetch-pipeline chunk task (exec/pipeline.py)
 ``join.task``             streamed-join side decode task (exec/join_stream.py)
 ``device.transfer``       host→device staging (exec/device.py)
+``lease.renew``           fabric lease heartbeat renewal (fabric/lease.py)
+``fabric.http``           FrontDoor→worker HTTP dispatch (fabric/frontdoor.py)
+``record.compact``        fsck garbage-collection removal (fabric/fsck.py)
 ========================  ====================================================
 
 Fault kinds: ``transient`` raises :class:`InjectedTransientIOError`,

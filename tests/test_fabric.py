@@ -8,8 +8,10 @@ rides at the bottom behind the ``soak`` marker."""
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import threading
 import time
 import urllib.request
 
@@ -17,16 +19,25 @@ import numpy as np
 import pytest
 
 import hyperspace_tpu as hst
+from hyperspace_tpu import config as C
 from hyperspace_tpu.fabric import records
+from hyperspace_tpu.fabric import lease as lease_mod
+from hyperspace_tpu.fabric.fsck import fsck, main as fsck_main
 from hyperspace_tpu.fabric.frontdoor import (
     FrontDoor,
     WorkerEndpoint,
+    WorkerError,
+    WorkerUnavailable,
     merge_prometheus_texts,
+    rendezvous_order,
     rendezvous_pick,
 )
+from hyperspace_tpu.fabric.health import HealthTracker
+from hyperspace_tpu.fabric.lease import LeaseLostError, fence_scope
 from hyperspace_tpu.lifecycle import CommitEvent, RefreshManager, SnapshotHandle
 from hyperspace_tpu.obs.metrics import REGISTRY
 from hyperspace_tpu.reliability.degrade import QUARANTINE
+from hyperspace_tpu.reliability.faults import FaultRule, fault_scope
 from hyperspace_tpu.serving import QueryServer
 
 from tests.test_lifecycle import write_marked_part
@@ -553,16 +564,809 @@ class TestFrontDoor:
                 assert 'server="qsHttp"' in fd.metrics_text()
                 with urllib.request.urlopen(f"{ep.url}/healthz", timeout=30) as r:
                     health = json.loads(r.read().decode("utf-8"))
-                assert health == {"ok": True, "server": "qsHttp"}
+                # the liveness body carries what stale-worker detection
+                # needs: queue depth, last-applied commit_seq, uptime
+                assert health["ok"] is True and health["server"] == "qsHttp"
+                assert health["queueDepth"] == 0
+                assert health["commitSeq"] == session.lifecycle_bus.commit_seq
+                assert health["uptimeSeconds"] >= 0.0
                 # missing sql -> 400 with a typed error body
                 try:
                     urllib.request.urlopen(f"{ep.url}/query", timeout=30)
                     assert False, "expected HTTP 400"
                 except urllib.error.HTTPError as exc:
                     assert exc.code == 400
+                    body = json.loads(exc.read().decode("utf-8"))
+                    assert body["retryable"] is False
                 # a failing query surfaces as a routed RuntimeError
                 with pytest.raises(RuntimeError, match="failed"):
                     fd.query("SELECT nope FROM missing_table")
+
+
+# --- lake leases + fencing tokens --------------------------------------------
+
+
+class FakeClock:
+    """Injected-clock stand-in: tests move ``t`` explicitly."""
+
+    def __init__(self, t=100.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+
+class TestLease:
+    def test_acquire_busy_and_state(self, tmp_path):
+        sp = str(tmp_path)
+        clk = FakeClock()
+        acquired0 = counter_value("hs_fabric_lease_acquires_total", outcome="acquired")
+        busy0 = counter_value("hs_fabric_lease_acquires_total", outcome="busy")
+        l1 = lease_mod.acquire(sp, "refresh/idx", "n1", ttl_s=10.0, clock=clk)
+        assert l1 is not None and l1.token == 1
+        assert l1.expires_at == pytest.approx(110.0)
+        assert (
+            counter_value("hs_fabric_lease_acquires_total", outcome="acquired")
+            == acquired0 + 1
+        )
+        # a live lease rejects every other claimant
+        assert lease_mod.acquire(sp, "refresh/idx", "n2", ttl_s=10.0, clock=clk) is None
+        assert counter_value("hs_fabric_lease_acquires_total", outcome="busy") == busy0 + 1
+        current, state = lease_mod.read_state(sp, "refresh/idx")
+        assert current == 1 and state["holder"] == "n1"
+
+    def test_renewal_extends_expiry(self, tmp_path):
+        clk = FakeClock()
+        l1 = lease_mod.acquire(str(tmp_path), "r", "n1", ttl_s=10.0, clock=clk)
+        clk.t = 105.0
+        ok0 = counter_value("hs_fabric_lease_renewals_total", outcome="ok")
+        assert l1.renew() is True
+        assert l1.expires_at == pytest.approx(115.0)
+        assert counter_value("hs_fabric_lease_renewals_total", outcome="ok") == ok0 + 1
+
+    def test_expiry_takeover_fences_the_zombie(self, tmp_path):
+        sp = str(tmp_path)
+        clk = FakeClock()
+        l1 = lease_mod.acquire(sp, "r", "n1", ttl_s=10.0, clock=clk)
+        clk.t = 111.0  # past expiry: the holder stopped renewing (crashed)
+        takeover0 = counter_value("hs_fabric_lease_acquires_total", outcome="takeover")
+        l2 = lease_mod.acquire(sp, "r", "n2", ttl_s=10.0, clock=clk)
+        assert l2 is not None and l2.token == 2  # fencing token strictly grows
+        assert (
+            counter_value("hs_fabric_lease_acquires_total", outcome="takeover")
+            == takeover0 + 1
+        )
+        # the zombie's renewal observes the takeover and stops
+        lost0 = counter_value("hs_fabric_lease_renewals_total", outcome="lost")
+        assert l1.renew() is False and l1.lost
+        assert counter_value("hs_fabric_lease_renewals_total", outcome="lost") == lost0 + 1
+        # and its commit-time fence check raises instead of landing a write
+        fenced0 = counter_value("hs_fabric_lease_fenced_total")
+        with pytest.raises(LeaseLostError) as ei:
+            l1.verify()
+        assert ei.value.held_token == 1 and ei.value.current_token == 2
+        assert counter_value("hs_fabric_lease_fenced_total") == fenced0 + 1
+        l2.verify()  # the successor's fence still passes
+
+    def test_release_keeps_the_token_sequence(self, tmp_path):
+        sp = str(tmp_path)
+        clk = FakeClock()
+        l1 = lease_mod.acquire(sp, "r", "n1", ttl_s=10.0, clock=clk)
+        l1.release()
+        # released = immediately claimable, but the sequence never restarts
+        l2 = lease_mod.acquire(sp, "r", "n2", ttl_s=10.0, clock=clk)
+        assert l2 is not None and l2.token == 2
+
+    def test_torn_current_token_is_claimable(self, tmp_path):
+        sp = str(tmp_path)
+        clk = FakeClock()
+        l1 = lease_mod.acquire(sp, "r", "n1", ttl_s=10.0, clock=clk)
+        with open(l1.path, "w") as f:
+            f.write("not json {")  # lake-level corruption of the live token
+        current, state = lease_mod.read_state(sp, "r")
+        assert current == 1 and state is None
+        l2 = lease_mod.acquire(sp, "r", "n2", ttl_s=10.0, clock=clk)
+        assert l2 is not None and l2.token == 2  # claimable, not wedged forever
+
+    def test_claim_race_has_exactly_one_winner(self, tmp_path):
+        sp = str(tmp_path)
+        clk = FakeClock()
+        results = []
+        barrier = threading.Barrier(4)
+
+        def racer(i):
+            barrier.wait()
+            results.append(lease_mod.acquire(sp, "r", f"n{i}", ttl_s=10.0, clock=clk))
+
+        threads = [threading.Thread(target=racer, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        wins = [l for l in results if l is not None]
+        assert len(wins) == 1 and wins[0].token == 1
+
+    def test_renew_write_failure_is_not_a_loss(self, tmp_path):
+        clk = FakeClock()
+        l1 = lease_mod.acquire(str(tmp_path), "r", "n1", ttl_s=10.0, clock=clk)
+        err0 = counter_value("hs_fabric_lease_renewals_total", outcome="error")
+        with fault_scope(FaultRule("lease.renew", "transient")):
+            # the prior expiry still stands; only a takeover loses a lease
+            assert l1.renew() is True
+        assert not l1.lost
+        assert counter_value("hs_fabric_lease_renewals_total", outcome="error") == err0 + 1
+        assert l1.renew() is True  # the next beat retries cleanly
+
+    def test_heartbeat_thread_renews_until_stopped(self, tmp_path):
+        l1 = lease_mod.acquire(str(tmp_path), "hb", "n1", ttl_s=5.0)
+        exp0 = l1.expires_at
+        l1.start_heartbeat(0.05)
+        deadline = time.time() + 5
+        while l1.expires_at <= exp0 and time.time() < deadline:
+            time.sleep(0.02)
+        assert l1.expires_at > exp0, "heartbeat never renewed"
+        l1.release()  # also stops the heartbeat
+
+
+class TestRefreshLease:
+    """RefreshManager + lake lease: single-writer across processes."""
+
+    @pytest.fixture()
+    def lease_nodes(self, tmp_system_path, data_root):
+        extra = {
+            hst.keys.FABRIC_LEASE_ENABLED: True,
+            hst.keys.FABRIC_LEASE_TTL_SECONDS: 30.0,
+            hst.keys.FABRIC_LEASE_RENEW_INTERVAL_SECONDS: 3600.0,
+        }
+        s1 = hst.Session(conf=fabric_conf(tmp_system_path, "n1", **extra))
+        hst.Hyperspace(s1).create_index(
+            s1.read_parquet(data_root),
+            hst.CoveringIndexConfig("fabIdx", ["c1"], ["m"]),
+        )
+        s2 = hst.Session(conf=fabric_conf(tmp_system_path, "n2", **extra))
+        s2.fabric.watcher.poll_once()
+        yield s1, s2
+        s2.fabric.stop()
+        s1.fabric.stop()
+
+    def test_refresh_claims_and_releases_the_lease(
+        self, lease_nodes, data_root, tmp_system_path
+    ):
+        s1, _ = lease_nodes
+        write_marked_part(data_root, 3)
+        assert RefreshManager(s1).refresh_index("fabIdx", "incremental") == "committed"
+        current, state = lease_mod.read_state(tmp_system_path, "refresh/fabIdx")
+        assert current == 1 and state["holder"] == "n1"
+        assert float(state["expiresAt"]) == 0.0  # released for instant takeover
+
+    def test_two_racing_refreshers_one_commits_one_busy(self, lease_nodes, data_root):
+        """The acceptance race: two RefreshManagers (distinct sessions, so
+        the in-process locks cannot arbitrate) race one index — the lake
+        lease serializes them into exactly one ``committed`` and one
+        ``busy``."""
+        s1, s2 = lease_nodes
+        write_marked_part(data_root, 3)
+        rm1, rm2 = RefreshManager(s1), RefreshManager(s2)
+        outcomes = {}
+        # hold the winner inside its refresh (lease held) long enough for
+        # the loser to observe a live lease
+        with fault_scope(FaultRule("log.write", "latency", delay_s=1.0, max_fires=1)):
+            t = threading.Thread(
+                target=lambda: outcomes.__setitem__(
+                    "a", rm1.refresh_index("fabIdx", "incremental")
+                )
+            )
+            t.start()
+            time.sleep(0.4)
+            outcomes["b"] = rm2.refresh_index("fabIdx", "incremental")
+            t.join(timeout=60)
+        assert sorted(outcomes.values()) == ["busy", "committed"], outcomes
+
+    def test_crash_mid_refresh_peer_takes_over_and_fences_late_commit(
+        self, lease_nodes, data_root, tmp_system_path
+    ):
+        """A refresher killed mid-refresh leaves its lease to expire; a peer
+        takes over after TTL, and the zombie's late commit is rejected by
+        the fencing token at the log write — zero duplicate entries."""
+        s1, s2 = lease_nodes
+        # n1's refresher claimed the lease then died: no heartbeat, tiny TTL
+        zombie = lease_mod.acquire(
+            tmp_system_path, "refresh/fabIdx", "n1", ttl_s=0.2
+        )
+        assert zombie is not None and zombie.token == 1
+        write_marked_part(data_root, 3)
+        rm2 = RefreshManager(s2)
+        # before expiry the peer observes a live lease and skips
+        assert rm2.refresh_index("fabIdx", "incremental") == "busy"
+        time.sleep(0.25)  # the dead holder never renews; TTL elapses
+        takeover0 = counter_value("hs_fabric_lease_acquires_total", outcome="takeover")
+        assert rm2.refresh_index("fabIdx", "incremental") == "committed"
+        assert (
+            counter_value("hs_fabric_lease_acquires_total", outcome="takeover")
+            == takeover0 + 1
+        )
+        # the zombie wakes with real drift to commit; its write must not land
+        write_marked_part(data_root, 4)
+        log_dir = os.path.join(tmp_system_path, "fabIdx", C.HYPERSPACE_LOG_DIR)
+        entries_before = sorted(n for n in os.listdir(log_dir) if n.isdigit())
+        fenced0 = counter_value("hs_fabric_lease_fenced_total")
+        with fence_scope(zombie):
+            with pytest.raises(LeaseLostError):
+                s1.index_manager.refresh("fabIdx", "incremental")
+        assert counter_value("hs_fabric_lease_fenced_total") == fenced0 + 1
+        assert (
+            sorted(n for n in os.listdir(log_dir) if n.isdigit()) == entries_before
+        ), "the fenced zombie still landed a log entry"
+
+    def test_refresh_outcome_fenced_when_lease_stolen_mid_refresh(
+        self, tmp_system_path, data_root
+    ):
+        """End-to-end through RefreshManager: the holder stalls past its TTL
+        (no renewals), a peer takes over and commits, and the stalled
+        refresh surfaces the distinct ``fenced`` outcome."""
+        extra = {
+            hst.keys.FABRIC_LEASE_ENABLED: True,
+            hst.keys.FABRIC_LEASE_TTL_SECONDS: 0.25,
+            hst.keys.FABRIC_LEASE_RENEW_INTERVAL_SECONDS: 3600.0,
+        }
+        s1 = hst.Session(conf=fabric_conf(tmp_system_path, "n1", **extra))
+        hst.Hyperspace(s1).create_index(
+            s1.read_parquet(data_root),
+            hst.CoveringIndexConfig("fabIdx", ["c1"], ["m"]),
+        )
+        s2 = hst.Session(conf=fabric_conf(tmp_system_path, "n2", **extra))
+        s2.fabric.watcher.poll_once()
+        try:
+            write_marked_part(data_root, 3)
+            rm1, rm2 = RefreshManager(s1), RefreshManager(s2)
+            outcomes = {}
+            fenced0 = counter_value("hs_lifecycle_refresh_total",
+                                    mode="incremental", outcome="fenced")
+            with fault_scope(
+                FaultRule("log.write", "latency", delay_s=1.0, max_fires=1)
+            ):
+                t = threading.Thread(
+                    target=lambda: outcomes.__setitem__(
+                        "a", rm1.refresh_index("fabIdx", "incremental")
+                    )
+                )
+                t.start()
+                time.sleep(0.5)  # past rm1's TTL: its lease is claimable
+                outcomes["b"] = rm2.refresh_index("fabIdx", "incremental")
+                t.join(timeout=60)
+            assert outcomes["b"] == "committed"
+            assert outcomes["a"] == "fenced", outcomes
+            assert (
+                counter_value("hs_lifecycle_refresh_total",
+                              mode="incremental", outcome="fenced")
+                == fenced0 + 1
+            )
+        finally:
+            s2.fabric.stop()
+            s1.fabric.stop()
+
+
+# --- commit-watcher recovery under compaction --------------------------------
+
+
+class TestCommitWatcherRecovery:
+    def test_compaction_under_live_watcher_keeps_cursor_monotonic(
+        self, two_nodes, data_root, tmp_system_path
+    ):
+        s1, s2 = two_nodes
+        rm = RefreshManager(s1)
+        for marker in (3, 4):
+            write_marked_part(data_root, marker)
+            assert rm.refresh_index("fabIdx", "incremental") == "committed"
+            assert s2.fabric.watcher.poll_once() == 1
+        cursor = s2.fabric.watcher._cursors["fabIdx"]
+        assert cursor >= 2
+        # compact everything retention allows, under the live watcher
+        report = fsck(tmp_system_path, retention_s=0.0)
+        assert report["removed"]["old-record"] >= 2
+        # the high-water record is always kept: ids never restart behind a cursor
+        cdir = records.commits_dir(tmp_system_path, "fabIdx")
+        assert [rid for rid, _ in records.read_commit_records(cdir)] == [cursor]
+        # the next commit numbers past every cursor and replays exactly once
+        write_marked_part(data_root, 5)
+        assert rm.refresh_index("fabIdx", "incremental") == "committed"
+        assert s2.fabric.watcher.poll_once() == 1
+        assert s2.lifecycle_bus.commit_seq == s1.lifecycle_bus.commit_seq
+
+    def test_truncated_directory_still_numbers_past_stale_cursors(self, tmp_path):
+        sp = str(tmp_path)
+        ev = CommitEvent("idxT", 1, "create", origin="n1")
+        for seq in range(6):
+            records.append_commit_record(sp, ev, seq=seq)
+        fsck(sp, retention_s=0.0)
+        cdir = records.commits_dir(sp, "idxT")
+        assert [rid for rid, _ in records.read_commit_records(cdir)] == [5]
+        # max+1 numbering continues from the kept record, not from 0
+        assert records.append_commit_record(sp, ev, seq=7) == 6
+
+    def test_stale_cursor_restart_converges_without_self_replay(
+        self, two_nodes, data_root, tmp_system_path
+    ):
+        s1, s2 = two_nodes
+        write_marked_part(data_root, 3)
+        assert RefreshManager(s1).refresh_index("fabIdx", "incremental") == "committed"
+        # n2 committed something of its own before crashing
+        s2.lifecycle_bus.publish(CommitEvent("fabIdx", None, "refresh-quick"))
+        s2.fabric.stop()
+        # n2 restarts: fresh session, cold cursor, same node id
+        s3 = hst.Session(conf=fabric_conf(tmp_system_path, "n2"))
+        try:
+            skips0 = counter_value("hs_fabric_self_skips_total")
+            # replays exactly the n1-origin records; its own pre-crash commit
+            # is recognized by origin and skipped, not replayed
+            assert s3.fabric.watcher.poll_once() == 2
+            assert counter_value("hs_fabric_self_skips_total") == skips0 + 1
+            assert SnapshotHandle.capture(s3).index_version(
+                "fabIdx"
+            ) == SnapshotHandle.capture(s1).index_version("fabIdx")
+            assert s3.fabric.watcher.poll_once() == 0  # cursor rebuilt, no re-replay
+        finally:
+            s3.fabric.stop()
+
+
+# --- health tracker (unit, injected clock) -----------------------------------
+
+
+class TestHealthTracker:
+    def test_eject_halfopen_readmit_cycle(self):
+        clk = FakeClock(0.0)
+        h = HealthTracker(failure_threshold=2, probe_interval_s=5.0, clock=clk)
+        workers = ["w0", "w1"]
+        assert h.live(workers) == workers
+        ej0 = counter_value(
+            "hs_fabric_node_ejections_total", worker="w0", reason="errors"
+        )
+        h.note_failure("w0")
+        assert h.state_of("w0") == "live"  # below threshold
+        h.note_failure("w0")
+        assert h.state_of("w0") == "ejected"
+        assert (
+            counter_value("hs_fabric_node_ejections_total", worker="w0", reason="errors")
+            == ej0 + 1
+        )
+        assert h.live(workers) == ["w1"]  # tenants re-hash to the survivor
+        clk.t = 6.0  # cooldown elapsed: one probe admitted
+        assert h.live(workers) == workers
+        assert h.state_of("w0") == "half-open"
+        re0 = counter_value("hs_fabric_node_readmissions_total", worker="w0")
+        h.note_ok("w0")
+        assert h.state_of("w0") == "live"
+        assert counter_value("hs_fabric_node_readmissions_total", worker="w0") == re0 + 1
+
+    def test_probe_failure_reejects_and_restarts_cooldown(self):
+        clk = FakeClock(0.0)
+        h = HealthTracker(failure_threshold=1, probe_interval_s=5.0, clock=clk)
+        h.note_failure("w0")
+        clk.t = 6.0
+        assert h.live(["w0", "w1"]) == ["w0", "w1"]  # w0 admitted half-open
+        pf0 = counter_value(
+            "hs_fabric_node_ejections_total", worker="w0", reason="probe-failed"
+        )
+        h.note_failure("w0")
+        assert h.state_of("w0") == "ejected"
+        assert (
+            counter_value(
+                "hs_fabric_node_ejections_total", worker="w0", reason="probe-failed"
+            )
+            == pf0 + 1
+        )
+        clk.t = 8.0  # cooldown restarted at 6.0: not yet eligible again
+        assert h.live(["w0", "w1"]) == ["w1"]
+
+    def test_fail_open_when_everyone_is_ejected(self):
+        h = HealthTracker(failure_threshold=1, probe_interval_s=100.0, clock=FakeClock(0.0))
+        h.note_failure("w0")
+        h.note_failure("w1")
+        # a guess beats a guaranteed refusal
+        assert h.live(["w0", "w1"]) == ["w0", "w1"]
+
+    def test_missed_beats_eject_and_fresh_beat_readmits(self):
+        clk = FakeClock(0.0)
+        h = HealthTracker(heartbeat_interval_s=1.0, missed_beats=3, clock=clk)
+        mb0 = counter_value(
+            "hs_fabric_node_ejections_total", worker="w0", reason="missed-beats"
+        )
+        h.note_beat("w0", age_s=2.0)
+        assert h.state_of("w0") == "live"
+        h.note_beat("w0", age_s=3.5)  # > heartbeat_interval * missed_beats
+        assert h.state_of("w0") == "ejected"
+        assert (
+            counter_value(
+                "hs_fabric_node_ejections_total", worker="w0", reason="missed-beats"
+            )
+            == mb0 + 1
+        )
+        h.note_beat("w0", age_s=0.1)  # the process provably lives: direct readmit
+        assert h.state_of("w0") == "live"
+
+    def test_stale_commit_seq_ejects_wedged_worker(self):
+        h = HealthTracker(max_commit_lag=2, clock=FakeClock(0.0))
+        st0 = counter_value(
+            "hs_fabric_node_ejections_total", worker="w0", reason="stale"
+        )
+        h.note_stale("w0", lag=2)
+        assert h.state_of("w0") == "live"  # at the bound: tolerated
+        h.note_stale("w0", lag=3)
+        assert h.state_of("w0") == "ejected"
+        assert (
+            counter_value("hs_fabric_node_ejections_total", worker="w0", reason="stale")
+            == st0 + 1
+        )
+        # the default max_commit_lag=0 disables stale ejection entirely
+        h2 = HealthTracker(clock=FakeClock(0.0))
+        h2.note_stale("w1", lag=999)
+        assert h2.state_of("w1") == "live"
+
+
+# --- FrontDoor failover, hedging, typed wire errors --------------------------
+
+
+@pytest.fixture()
+def two_endpoints(session, data_root):
+    """Two QueryServers on one session, each behind an HTTP WorkerEndpoint."""
+    session.enable_hyperspace()
+    session.register_view("t", session.read_parquet(data_root))
+    with QueryServer(session, workers=1, name="qsA") as a, QueryServer(
+        session, workers=1, name="qsB"
+    ) as b:
+        with WorkerEndpoint(a) as ea, WorkerEndpoint(b) as eb:
+            yield ea, eb
+
+
+_SQL = "SELECT m FROM t WHERE c1 >= 0"
+
+
+class TestFrontDoorFailover:
+    def test_rendezvous_order_heads_match_pick(self):
+        nodes = ["qs0", "qs1", "qs2", "qs3"]
+        for t in range(30):
+            order = rendezvous_order(f"tenant-{t}", nodes)
+            assert order[0] == rendezvous_pick(f"tenant-{t}", nodes)
+            assert sorted(order) == sorted(nodes)
+            # removing the winner promotes exactly the next entry
+            assert rendezvous_pick(f"tenant-{t}", order[1:]) == order[1]
+
+    def test_transient_failure_fails_over_to_next_candidate(self, two_endpoints):
+        ea, eb = two_endpoints
+        h = HealthTracker(failure_threshold=1, probe_interval_s=3600.0)
+        fd = FrontDoor([ea.url, eb.url], health=h)
+        tenant = "tenant-fo"
+        primary = rendezvous_order(tenant, fd.worker_ids)[0]
+        url = fd._workers[primary]
+        retries0 = counter_value("hs_frontdoor_failover_retries_total", worker=primary)
+        with fault_scope(
+            FaultRule("fabric.http", "transient", path_glob=f"{url}*", max_fires=1)
+        ):
+            res = fd.query(_SQL, tenant=tenant)
+        assert sorted(np.unique(res["m"]).tolist()) == [0, 1, 2]
+        assert (
+            counter_value("hs_frontdoor_failover_retries_total", worker=primary)
+            == retries0 + 1
+        )
+        # threshold 1: the failed primary left the rendezvous set
+        assert h.state_of(primary) == "ejected"
+        assert fd._candidates(tenant)[0] != primary
+
+    def test_nonretryable_failure_is_not_failed_over(self, two_endpoints):
+        ea, eb = two_endpoints
+        fd = FrontDoor([ea.url, eb.url], failover=True)
+        tenant = "tenant-cor"
+        primary = rendezvous_order(tenant, fd.worker_ids)[0]
+        retries0 = counter_value("hs_frontdoor_failover_retries_total", worker=primary)
+        with fault_scope(FaultRule("fabric.http", "corrupt", max_fires=1)):
+            with pytest.raises(Exception, match="injected corrupt"):
+                fd.query(_SQL, tenant=tenant)
+        # retrying corrupt bytes rereads the same wrong bytes: no retry burned
+        assert (
+            counter_value("hs_frontdoor_failover_retries_total", worker=primary)
+            == retries0
+        )
+
+    def test_typed_error_body_survives_the_wire(self, two_endpoints):
+        ea, _ = two_endpoints
+        fd = FrontDoor([ea.url])
+        with pytest.raises(RuntimeError, match="failed") as ei:
+            fd.query("SELECT nope FROM missing_table")
+        # the worker-side classification crossed the wire as a typed error
+        assert isinstance(ei.value, (WorkerError, WorkerUnavailable))
+        assert ei.value.error_type and ei.value.kind in ("transient", "corrupt", "error")
+
+    def test_dead_endpoint_raises_worker_unavailable(self, two_endpoints):
+        ea, eb = two_endpoints
+        dead = f"http://{eb.host}:1"  # nothing listens on port 1
+        fd = FrontDoor([dead])
+        with pytest.raises(WorkerUnavailable, match="unreachable"):
+            fd.query(_SQL, tenant="t")
+
+    def test_deadline_stops_failover_between_candidates(self, two_endpoints):
+        ea, eb = two_endpoints
+
+        class SteppingClock:
+            def __init__(self):
+                self.t = 0.0
+
+            def __call__(self):
+                self.t += 10.0
+                return self.t
+
+        fd = FrontDoor([ea.url, eb.url], failover=True, clock=SteppingClock())
+        tenant = "tenant-dl"
+        second = rendezvous_order(tenant, fd.worker_ids)[1]
+        routed0 = counter_value("hs_fabric_frontdoor_requests_total", worker=second)
+        ex0 = counter_value("hs_frontdoor_failover_exhausted_total")
+        with fault_scope(FaultRule("fabric.http", "transient")):
+            with pytest.raises(WorkerUnavailable):
+                fd.query(_SQL, tenant=tenant, timeout=5.0)
+        # the deadline was spent on the first attempt: no doomed second try
+        assert (
+            counter_value("hs_fabric_frontdoor_requests_total", worker=second) == routed0
+        )
+        assert counter_value("hs_frontdoor_failover_exhausted_total") == ex0 + 1
+
+    def test_all_candidates_exhausted_raises_last_typed_error(self, two_endpoints):
+        ea, eb = two_endpoints
+        fd = FrontDoor([ea.url, eb.url], failover=True)
+        ex0 = counter_value("hs_frontdoor_failover_exhausted_total")
+        with fault_scope(FaultRule("fabric.http", "transient")):
+            with pytest.raises(WorkerUnavailable, match="unreachable"):
+                fd.query(_SQL, tenant="tenant-ex")
+        assert counter_value("hs_frontdoor_failover_exhausted_total") == ex0 + 1
+
+    def test_hedged_query_beats_a_slow_primary(self, two_endpoints):
+        ea, eb = two_endpoints
+        fd = FrontDoor([ea.url, eb.url], failover=True, hedge_ms=50.0)
+        tenant = "tenant-hg"
+        primary = rendezvous_order(tenant, fd.worker_ids)[0]
+        url = fd._workers[primary]
+        # warm both workers so the backup's first answer is fast
+        for wid in fd.worker_ids:
+            FrontDoor([fd._workers[wid]]).query(_SQL, tenant=tenant)
+        hedges0 = counter_value("hs_frontdoor_failover_hedges_total")
+        with fault_scope(
+            FaultRule("fabric.http", "latency", delay_s=5.0, path_glob=f"{url}*")
+        ):
+            t0 = time.monotonic()
+            res = fd.query(_SQL, tenant=tenant)
+            elapsed = time.monotonic() - t0
+        assert sorted(np.unique(res["m"]).tolist()) == [0, 1, 2]
+        assert elapsed < 4.0, "the hedge never fired: waited out the stalled primary"
+        assert counter_value("hs_frontdoor_failover_hedges_total") == hedges0 + 1
+
+    def test_hedge_path_fails_over_on_fast_primary_failure(self, two_endpoints):
+        ea, eb = two_endpoints
+        fd = FrontDoor([ea.url, eb.url], failover=True, hedge_ms=10000.0)
+        tenant = "tenant-hf"
+        primary = rendezvous_order(tenant, fd.worker_ids)[0]
+        url = fd._workers[primary]
+        hedges0 = counter_value("hs_frontdoor_failover_hedges_total")
+        retries0 = counter_value("hs_frontdoor_failover_retries_total", worker=primary)
+        with fault_scope(
+            FaultRule("fabric.http", "transient", path_glob=f"{url}*", max_fires=1)
+        ):
+            res = fd.query(_SQL, tenant=tenant)
+        assert sorted(np.unique(res["m"]).tolist()) == [0, 1, 2]
+        # an outright failure before the hedge delay is a failover, not a hedge
+        assert counter_value("hs_frontdoor_failover_hedges_total") == hedges0
+        assert (
+            counter_value("hs_frontdoor_failover_retries_total", worker=primary)
+            == retries0 + 1
+        )
+
+    def test_probe_beats_and_stale_ejection(self, tmp_system_path, data_root):
+        """The liveness integration loop: /healthz probing learns node ids,
+        sidecar-ledger ages are judged as heartbeats (eject + readmit), and
+        a wedged watcher (commit-seq lag) is ejected by the probe sweep."""
+        s1 = hst.Session(conf=fabric_conf(tmp_system_path, "n1"))
+        hst.Hyperspace(s1).create_index(
+            s1.read_parquet(data_root),
+            hst.CoveringIndexConfig("hzIdx", ["c1"], ["m"]),
+        )
+        s2 = hst.Session(conf=fabric_conf(tmp_system_path, "n2"))
+        s2.fabric.watcher.poll_once()
+        for s in (s1, s2):
+            s.enable_hyperspace()
+            s.register_view("t", s.read_parquet(data_root))
+        h = HealthTracker(
+            failure_threshold=1,
+            probe_interval_s=3600.0,
+            heartbeat_interval_s=1.0,
+            missed_beats=3,
+            max_commit_lag=1,
+        )
+        try:
+            with QueryServer(s1, workers=1, name="hz1") as srv1, QueryServer(
+                s2, workers=1, name="hz2"
+            ) as srv2:
+                with WorkerEndpoint(srv1) as e1, WorkerEndpoint(srv2) as e2:
+                    fd = FrontDoor(
+                        [e1.url, e2.url], health=h, system_path=tmp_system_path
+                    )
+                    wid1, wid2 = fd.worker_ids
+                    bodies = fd.probe()
+                    assert all(b and b["ok"] for b in bodies.values())
+                    assert sorted(fd._nodes.values()) == ["n1", "n2"]
+                    assert h.state_of(wid1) == h.state_of(wid2) == "live"
+                    # heartbeats ride the sidecar node files
+                    s1.fabric.sidecar.publish_once()
+                    s2.fabric.sidecar.publish_once()
+                    ages = fd.check_beats()
+                    assert set(ages) == {wid1, wid2}
+                    assert all(a < 3.0 for a in ages.values())
+                    # n2 goes silent: age its ledger past missed_beats
+                    p2 = os.path.join(records.nodes_dir(tmp_system_path), "n2.json")
+                    with open(p2) as f:
+                        st = json.load(f)
+                    st["updatedAt"] = time.time() - 60
+                    with open(p2, "w") as f:
+                        json.dump(st, f)
+                    fd.check_beats()
+                    assert h.state_of(wid2) == "ejected"
+                    assert (
+                        counter_value(
+                            "hs_fabric_node_ejections_total",
+                            worker=wid2,
+                            reason="missed-beats",
+                        )
+                        >= 1
+                    )
+                    # a fresh beat readmits directly: the process provably lives
+                    s2.fabric.sidecar.publish_once()
+                    fd.check_beats()
+                    assert h.state_of(wid2) == "live"
+                    # a wedged watcher: n1 commits twice while n2 never polls
+                    for marker in (3, 4):
+                        write_marked_part(data_root, marker)
+                        assert (
+                            RefreshManager(s1).refresh_index("hzIdx", "incremental")
+                            == "committed"
+                        )
+                    fd.probe()
+                    assert h.state_of(wid2) == "ejected"
+                    assert (
+                        counter_value(
+                            "hs_fabric_node_ejections_total",
+                            worker=wid2,
+                            reason="stale",
+                        )
+                        >= 1
+                    )
+        finally:
+            s2.fabric.stop()
+            s1.fabric.stop()
+
+    def test_metrics_merge_skips_dead_worker_with_health(self, two_endpoints):
+        ea, eb = two_endpoints
+        dead = f"http://{ea.host}:1"
+        h = HealthTracker(failure_threshold=1, probe_interval_s=3600.0)
+        fd = FrontDoor([ea.url, dead], health=h)
+        merged = fd.metrics_text()  # must not raise; the live worker reports
+        assert 'server="qsA"' in merged
+        dead_wid = [w for w in fd.worker_ids if w.endswith(":1")][-1]
+        assert h.state_of(dead_wid) == "ejected"
+        # without health the old strict behavior is preserved
+        with pytest.raises(Exception):
+            FrontDoor([ea.url, dead]).metrics_text()
+
+
+# --- fsck: lake garbage collection -------------------------------------------
+
+
+class TestFsck:
+    def test_commit_record_gc_keeps_newest_and_removes_torn(self, tmp_path):
+        sp = str(tmp_path)
+        ev = CommitEvent("gcIdx", 1, "create", origin="n1")
+        for seq in range(4):
+            records.append_commit_record(sp, ev, seq=seq)
+        cdir = records.commits_dir(sp, "gcIdx")
+        with open(os.path.join(cdir, f"{1:010d}"), "w") as f:
+            f.write("torn {")
+        runs0 = counter_value("hs_fabric_fsck_runs_total")
+        report = fsck(sp, retention_s=0.0)
+        assert counter_value("hs_fabric_fsck_runs_total") == runs0 + 1
+        assert report["removed"]["torn-record"] == 1
+        assert report["removed"]["old-record"] == 2  # ids 0 and 2
+        assert report["removedTotal"] == 3 and report["skipped"] == 0
+        assert [rid for rid, _ in records.read_commit_records(cdir)] == [3]
+
+    def test_lease_gc_stale_claims_then_expired_lease(self, tmp_path):
+        sp = str(tmp_path)
+        clk = FakeClock(100.0)
+        lease_mod.acquire(sp, "refresh/gcIdx", "n1", ttl_s=10.0, clock=clk)
+        clk.t = 120.0
+        l2 = lease_mod.acquire(sp, "refresh/gcIdx", "n2", ttl_s=10.0, clock=clk)
+        assert l2 is not None and l2.token == 2
+        # within retention: the settled takeover history goes, the live token stays
+        report = fsck(sp, retention_s=3600.0, clock=lambda: 200.0)
+        assert report["removed"]["stale-claim"] == 1
+        assert report["removed"]["expired-lease"] == 0
+        assert lease_mod.read_state(sp, "refresh/gcIdx")[0] == 2
+        # a full retention past expiry (130), the whole lease resets
+        report2 = fsck(sp, retention_s=50.0, clock=lambda: 200.0)
+        assert report2["removed"]["expired-lease"] == 1
+        assert not os.path.isdir(lease_mod.leases_dir(sp, "refresh/gcIdx"))
+        # and the token sequence restarts cleanly with no racers left
+        l3 = lease_mod.acquire(sp, "refresh/gcIdx", "n3", ttl_s=10.0,
+                               clock=FakeClock(200.0))
+        assert l3 is not None and l3.token == 1
+
+    def test_dead_node_ledger_gc(self, tmp_path):
+        sp = str(tmp_path)
+        records.write_node_file(sp, "nFresh", {})
+        records.write_node_file(sp, "nDead", {})
+        dead = os.path.join(records.nodes_dir(sp), "nDead.json")
+        with open(dead) as f:
+            st = json.load(f)
+        st["updatedAt"] = time.time() - 3600
+        with open(dead, "w") as f:
+            json.dump(st, f)
+        report = fsck(sp, dead_node_s=600.0)
+        assert report["removed"]["dead-node"] == 1
+        assert not os.path.exists(dead)
+        assert os.path.exists(os.path.join(records.nodes_dir(sp), "nFresh.json"))
+
+    def test_dry_run_reports_without_removing(self, tmp_path):
+        sp = str(tmp_path)
+        ev = CommitEvent("dryIdx", 1, "create", origin="n1")
+        for seq in range(3):
+            records.append_commit_record(sp, ev, seq=seq)
+        removed0 = counter_value("hs_fabric_fsck_removed_total", kind="old-record")
+        report = fsck(sp, retention_s=0.0, dry_run=True)
+        assert report["dryRun"] is True and report["removed"]["old-record"] == 2
+        cdir = records.commits_dir(sp, "dryIdx")
+        assert len(records.read_commit_records(cdir)) == 3  # nothing deleted
+        # dry runs never count removals as real
+        assert (
+            counter_value("hs_fabric_fsck_removed_total", kind="old-record") == removed0
+        )
+
+    def test_record_compact_fault_skips_and_continues(self, tmp_path):
+        sp = str(tmp_path)
+        ev = CommitEvent("fltIdx", 1, "create", origin="n1")
+        for seq in range(3):
+            records.append_commit_record(sp, ev, seq=seq)
+        with fault_scope(FaultRule("record.compact", "transient", max_fires=1)):
+            report = fsck(sp, retention_s=0.0)
+        # the injected failure skipped one file; the pass still finished
+        assert report["skipped"] == 1
+        assert report["removed"]["old-record"] == 1
+        cdir = records.commits_dir(sp, "fltIdx")
+        assert len(records.read_commit_records(cdir)) == 2
+
+    def test_cli_main_prints_json_report(self, tmp_path, capsys):
+        sp = str(tmp_path)
+        records.append_commit_record(
+            sp, CommitEvent("cliIdx", 1, "create", origin="n1"), seq=1
+        )
+        assert fsck_main([sp, "--dry-run", "--retention-seconds", "0"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["systemPath"] == sp and out["dryRun"] is True
+
+    def test_module_shim_exposes_main(self):
+        import hyperspace_tpu.fsck as shim
+
+        assert shim.main is fsck_main
+
+    def test_runtime_runs_fsck_on_start(self, tmp_system_path, data_root):
+        runs0 = counter_value("hs_fabric_fsck_runs_total")
+        s = hst.Session(
+            conf=fabric_conf(
+                tmp_system_path,
+                "n1",
+                **{
+                    hst.keys.FABRIC_FSCK_ENABLED: True,
+                    hst.keys.FABRIC_FSCK_INTERVAL_SECONDS: 3600.0,
+                },
+            )
+        )
+        try:
+            assert counter_value("hs_fabric_fsck_runs_total") == runs0 + 1
+        finally:
+            s.fabric.stop()
 
 
 # --- default-off byte identity ----------------------------------------------
@@ -761,3 +1565,211 @@ class TestMultiProcessSoak:
                 except Exception:
                     p.kill()
             writer.fabric.stop()
+
+
+# --- crash soak: kill -9 under load ------------------------------------------
+
+_LEASE_HOLDER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, sys.argv[4])
+import hyperspace_tpu as hst
+from hyperspace_tpu.lifecycle import RefreshManager
+from hyperspace_tpu.reliability.faults import FAULTS, FaultRule
+
+root, sys_path, ttl = sys.argv[1], sys.argv[2], float(sys.argv[3])
+sess = hst.Session(conf={
+    hst.keys.SYSTEM_PATH: sys_path,
+    hst.keys.FABRIC_ENABLED: True,
+    hst.keys.FABRIC_NODE_ID: "child",
+    hst.keys.FABRIC_WATCHER_ENABLED: False,
+    hst.keys.FABRIC_SLO_PUBLISH_INTERVAL_SECONDS: 3600,
+    hst.keys.FABRIC_LEASE_ENABLED: True,
+    hst.keys.FABRIC_LEASE_TTL_SECONDS: ttl,
+    hst.keys.FABRIC_LEASE_RENEW_INTERVAL_SECONDS: ttl / 4.0,
+})
+sess.fabric.watcher.poll_once()
+# wedge every log write: this process will be SIGKILLed inside its refresh,
+# lease held, heartbeat renewing -- the crash is what stops the renewals
+FAULTS.install(FaultRule("log.write", "latency", delay_s=600.0))
+print("REFRESHING", flush=True)
+print(RefreshManager(sess).refresh_index("soakLease", "incremental"), flush=True)
+"""
+
+
+@pytest.mark.soak
+@pytest.mark.slow
+class TestCrashSoak:
+    def test_kill9_worker_mid_query_zero_wrong_answers(self, tmp_path):
+        """3 worker subprocesses behind a health FrontDoor; one is SIGKILLed
+        under load. Every subsequent request must still succeed with the
+        full correct answer -- rerouted, never lost, never stale."""
+        root = tmp_path / "kill_data"
+        root.mkdir()
+        n = 60
+        for i in range(3):
+            write_marked_part(str(root), i, n=n)
+        sys_path = tmp_path / "indexes"
+        sys_path.mkdir()
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = []
+        try:
+            for i in range(3):
+                procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-c",
+                            _SOAK_WORKER,
+                            str(root),
+                            str(sys_path),
+                            f"qs{i}",
+                            "3600",
+                            REPO_ROOT,
+                        ],
+                        stdin=subprocess.PIPE,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.PIPE,
+                        text=True,
+                        cwd=REPO_ROOT,
+                        env=env,
+                    )
+                )
+            urls = [p.stdout.readline().strip() for p in procs]
+            assert all(u.startswith("http://") for u in urls), urls
+            h = HealthTracker(failure_threshold=1, probe_interval_s=2.0)
+            fd = FrontDoor(urls, health=h, failover=True)
+            expect = {0: n, 1: n, 2: n}
+            tenants = [f"tenant-{i}" for i in range(6)]
+            for t in tenants:  # warm every worker's first-query compile
+                fd.query("SELECT m FROM t WHERE c1 >= 0", tenant=t)
+            ex0 = counter_value("hs_frontdoor_failover_exhausted_total")
+            os.kill(procs[0].pid, signal.SIGKILL)
+            procs[0].wait(timeout=30)
+            failed, wrong, worst = [], [], 0.0
+            for i in range(30):
+                t = tenants[i % len(tenants)]
+                t0 = time.monotonic()
+                try:
+                    res = fd.query("SELECT m FROM t WHERE c1 >= 0", tenant=t)
+                except Exception as exc:
+                    failed.append((t, repr(exc)))
+                    continue
+                worst = max(worst, time.monotonic() - t0)
+                vals, cnts = np.unique(res["m"], return_counts=True)
+                seen = dict(zip(vals.tolist(), cnts.tolist()))
+                if seen != expect:
+                    wrong.append((t, seen))
+            assert failed == [], failed[:5]
+            assert wrong == [], wrong[:5]
+            assert worst < 15.0, f"failover latency blew the bound: {worst:.1f}s"
+            # nothing was lost: no request exhausted every candidate
+            assert counter_value("hs_frontdoor_failover_exhausted_total") == ex0
+            dead_wid = next(
+                w for w in fd.worker_ids if fd._workers[w] == urls[0].rstrip("/")
+            )
+            assert h.state_of(dead_wid) != "live"
+        finally:
+            for p in procs:
+                try:
+                    p.stdin.close()
+                except Exception:
+                    pass
+            for p in procs:
+                try:
+                    p.wait(timeout=30)
+                except Exception:
+                    p.kill()
+
+    def test_kill9_refresher_mid_refresh_peer_takes_over(self, tmp_path):
+        """A subprocess claims the refresh lease, wedges inside its refresh
+        (injected 600s write latency, heartbeat still renewing), and is
+        SIGKILLed. The parent's RefreshManager observes busy while the
+        zombie's lease lives, then takes over after TTL and commits."""
+        root = tmp_path / "lease_data"
+        root.mkdir()
+        for i in range(3):
+            write_marked_part(str(root), i)
+        sys_path = tmp_path / "indexes"
+        sys_path.mkdir()
+        ttl = 1.0
+        parent = hst.Session(
+            conf=fabric_conf(
+                str(sys_path),
+                "parent",
+                **{
+                    hst.keys.FABRIC_LEASE_ENABLED: True,
+                    hst.keys.FABRIC_LEASE_TTL_SECONDS: ttl,
+                    hst.keys.FABRIC_LEASE_RENEW_INTERVAL_SECONDS: ttl / 4.0,
+                },
+            )
+        )
+        try:
+            hst.Hyperspace(parent).create_index(
+                parent.read_parquet(str(root)),
+                hst.CoveringIndexConfig("soakLease", ["c1"], ["m"]),
+            )
+            write_marked_part(str(root), 3)  # real drift for both refreshers
+            proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-c",
+                    _LEASE_HOLDER,
+                    str(root),
+                    str(sys_path),
+                    str(ttl),
+                    REPO_ROOT,
+                ],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                cwd=REPO_ROOT,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            )
+            try:
+                assert proc.stdout.readline().strip() == "REFRESHING"
+                # wait until the child provably holds the lease on the lake
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    current, state = lease_mod.read_state(
+                        str(sys_path), "refresh/soakLease"
+                    )
+                    if (
+                        current == 1
+                        and state is not None
+                        and state.get("holder") == "child"
+                        and float(state.get("expiresAt", 0.0)) > time.time()
+                    ):
+                        break
+                    time.sleep(0.05)
+                else:
+                    pytest.fail("child never claimed the lease")
+                os.kill(proc.pid, signal.SIGKILL)
+                proc.wait(timeout=30)
+            finally:
+                if proc.poll() is None:
+                    proc.kill()
+            # renewals stopped with the process; after TTL the parent takes over
+            takeover0 = counter_value(
+                "hs_fabric_lease_acquires_total", outcome="takeover"
+            )
+            rm = RefreshManager(parent)
+            deadline = time.time() + 30
+            outcome = "busy"
+            while time.time() < deadline:
+                outcome = rm.refresh_index("soakLease", "incremental")
+                if outcome != "busy":
+                    break
+                time.sleep(0.25)
+            assert outcome == "committed", outcome
+            assert (
+                counter_value("hs_fabric_lease_acquires_total", outcome="takeover")
+                == takeover0 + 1
+            )
+            current, state = lease_mod.read_state(str(sys_path), "refresh/soakLease")
+            assert current == 2  # the takeover token fenced the dead holder
+            assert float(state["expiresAt"]) == 0.0  # and was released after
+        finally:
+            parent.fabric.stop()
