@@ -25,6 +25,7 @@ _RULE_MODULES = (
     "snapshot_pin",
     "io_error_swallow",
     "process_local_state",
+    "trace_context_drop",
 )
 
 

@@ -142,6 +142,14 @@ class keys:
     OBS_SLO_WINDOWS_SECONDS = "hyperspace.obs.slo.windowsSeconds"
     OBS_HTTP_PORT = "hyperspace.obs.http.port"
     OBS_HTTP_HOST = "hyperspace.obs.http.host"
+    # Distributed observability over the serving fabric (obs/spans.py +
+    # fabric/frontdoor.py): trace-context propagation on routed requests,
+    # cross-process span-tree stitching, and federation fan-out bounds.
+    OBS_FABRIC_PROPAGATE = "hyperspace.obs.fabric.propagate"
+    OBS_FABRIC_STITCH_ENABLED = "hyperspace.obs.fabric.stitch.enabled"
+    OBS_FABRIC_STITCH_MAX_SPANS = "hyperspace.obs.fabric.stitch.maxSpans"
+    OBS_FABRIC_STITCH_MAX_BYTES = "hyperspace.obs.fabric.stitch.maxBytes"
+    OBS_FABRIC_FEDERATION_TIMEOUT_SECONDS = "hyperspace.obs.fabric.federationTimeoutSeconds"
     # Static-analysis / runtime-contract checks (hyperspace_tpu/check/):
     # HLO program-contract verification at program-cache-fill time, and the
     # lock-order watcher. Both default off — they are CI/diagnostic tools.
@@ -447,6 +455,22 @@ DEFAULTS: Dict[str, Any] = {
     # ephemeral port (read it from server.telemetry.port).
     keys.OBS_HTTP_PORT: None,
     keys.OBS_HTTP_HOST: "127.0.0.1",
+    # Stamp a W3C traceparent header (plus the stitch budget header when
+    # stitching is on) onto FrontDoor /query requests whenever the router is
+    # tracing. Off => routed requests are byte-identical to a build without
+    # distributed tracing.
+    keys.OBS_FABRIC_PROPAGATE: True,
+    # Ship the worker's serialized span tree back in the /query response so
+    # the router can graft it into one end-to-end trace. Off by default:
+    # it grows every traced response by up to stitch.maxBytes.
+    keys.OBS_FABRIC_STITCH_ENABLED: False,
+    # Bounds on the stitched payload a worker may return: spans survive
+    # tree-prefix truncation up to maxSpans, and the JSON encoding degrades
+    # to the root alone past maxBytes (droppedSpans/truncated stay visible).
+    keys.OBS_FABRIC_STITCH_MAX_SPANS: 512,
+    keys.OBS_FABRIC_STITCH_MAX_BYTES: 262_144,
+    # Per-worker HTTP timeout for /profilez and /statusz federation sweeps.
+    keys.OBS_FABRIC_FEDERATION_TIMEOUT_SECONDS: 30.0,
     # Verify every newly compiled device program against its registered
     # ProgramContract (collective budget + forbidden ops) and bump
     # hs_check_violations_total on breach. Costs one HLO text dump per
@@ -996,6 +1020,26 @@ class HyperspaceConf:
     @property
     def obs_http_host(self) -> str:
         return str(self.get(keys.OBS_HTTP_HOST))
+
+    @property
+    def obs_fabric_propagate(self) -> bool:
+        return bool(self.get(keys.OBS_FABRIC_PROPAGATE))
+
+    @property
+    def obs_fabric_stitch_enabled(self) -> bool:
+        return bool(self.get(keys.OBS_FABRIC_STITCH_ENABLED))
+
+    @property
+    def obs_fabric_stitch_max_spans(self) -> int:
+        return int(self.get(keys.OBS_FABRIC_STITCH_MAX_SPANS))
+
+    @property
+    def obs_fabric_stitch_max_bytes(self) -> int:
+        return int(self.get(keys.OBS_FABRIC_STITCH_MAX_BYTES))
+
+    @property
+    def obs_fabric_federation_timeout_seconds(self) -> float:
+        return float(self.get(keys.OBS_FABRIC_FEDERATION_TIMEOUT_SECONDS))
 
     @property
     def check_hlo_enabled(self) -> bool:

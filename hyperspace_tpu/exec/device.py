@@ -512,16 +512,19 @@ def _pad_to_bucket(arr: np.ndarray, m: int, fill) -> np.ndarray:
 # exactly once per such pair, so first-seen here == one XLA compilation.
 # Survives clear_device_cache() because the jit caches do too.
 import threading as _threading
+import time as _ptime
 
 _COMPILE_SEEN: set = set()
 _COMPILE_SEEN_LOCK = _threading.Lock()
 
 
-def _note_compile(skeleton: str, sig) -> None:
+def _note_compile(skeleton: str, sig) -> bool:
+    """Record one (skeleton, signature) pair; True when first seen — i.e.
+    the next invocation of the jitted program pays the XLA compile."""
     key = (skeleton, sig)
     with _COMPILE_SEEN_LOCK:
         if key in _COMPILE_SEEN:
-            return
+            return False
         _COMPILE_SEEN.add(key)
     from hyperspace_tpu.obs.metrics import REGISTRY
 
@@ -529,6 +532,44 @@ def _note_compile(skeleton: str, sig) -> None:
         "hs_xla_compiles_total",
         "Distinct (device program skeleton, input shape) XLA compilations",
     ).inc()
+    return True
+
+
+def _observe_program(family: str, first_seen: bool, t0: float) -> None:
+    """Per-program-family device timing at the program-cache call sites
+    (ROADMAP item 2's fusion baseline): a wall-clock histogram around the
+    jitted call, a cumulative compile-seconds counter on first-seen
+    signatures, and a span annotation on the active trace.
+
+    Timing caveat (documented in observability.md): JAX dispatch is async —
+    on a cached signature the interval covers dispatch plus whatever host
+    sync the call site performs, NOT necessarily full device execution. On
+    a first-seen signature it is dominated by XLA compilation, which is the
+    cost these hooks exist to attribute.
+    """
+    wall = max(0.0, _ptime.perf_counter() - t0)
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    REGISTRY.histogram(
+        "hs_device_program_seconds",
+        "wall seconds around device program invocations, by program family",
+        program=family,
+    ).observe(wall)
+    if first_seen:
+        REGISTRY.counter(
+            "hs_device_compile_seconds_total",
+            "cumulative wall seconds of first-seen (compiling) device "
+            "program invocations, by program family",
+            program=family,
+        ).inc(wall)
+    from hyperspace_tpu.obs import spans as _obs_spans
+
+    sp = _obs_spans.current_span()
+    if sp is not None:
+        sp.event(
+            "device-program",
+            f"{family}: {wall * 1e3:.2f} ms" + (" (compile)" if first_seen else ""),
+        )
 
 
 # skeleton -> jitted predicate program; the jit object is reused across
@@ -743,10 +784,13 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None, 
         parallel.note_op("filter")
     key = _program_key(skeleton, mesh, sharded=parallel is not None)
     jitted = _cached_predicate_jit(key, fn)
-    _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
+    first = _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
     _hlo_lint.maybe_verify(session.conf, "fused-filter", key, jitted, (dev_cols, lit_values))
+    t0 = _ptime.perf_counter()
     mask = jitted(dev_cols, lit_values)
-    return np.asarray(mask)[:n]
+    out = np.asarray(mask)[:n]
+    _observe_program("fused-filter", first, t0)
+    return out
 
 
 def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], scan_key, extra_columns=None, parallel=None) -> None:
@@ -923,11 +967,13 @@ def device_filtered_aggregate(
 
     key = _program_key(skeleton, mesh)
     jitted = _cached_predicate_jit(key, program)
-    _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
+    first = _note_compile(key, tuple(dev_cols[r].shape for r in sorted(dev_cols)))
     _hlo_lint.maybe_verify(session.conf, "fused-agg", key, jitted, (dev_cols, lit_values, np.int64(n)))
+    t0 = _ptime.perf_counter()
     outs, valids = jitted(dev_cols, lit_values, np.int64(n))
     outs = [np.asarray(o) for o in outs]
     valids = [int(v) for v in valids]
+    _observe_program("fused-agg", first, t0)
 
     result: Dict[str, np.ndarray] = {}
     for (name, fn, c), val, n_valid in zip(aggs, outs, valids):
@@ -1369,13 +1415,14 @@ class GroupedAggStream:
                 program = _grouped_chunk_program(pred_fn, key_specs, self._slots, cap)
             key = _program_key(f"gagg[{cap}]:{base_sk}", mesh, sharded=sharded)
             jitted = _cached_predicate_jit(key, program)
-            _note_compile(key, shapes)
+            first = _note_compile(key, shapes)
             _hlo_lint.maybe_verify(
                 self.session.conf,
                 "sharded-grouped" if sharded else "grouped-agg-chunk",
                 key, jitted,
                 (dev_cols, lit_values, np.int64(n), np.int64(self._row_base)),
             )
+            t0 = _ptime.perf_counter()
             if sharded:
                 n_g_dev, fs, key_out, slot_out = self._parallel.timed_call(
                     "grouped-agg", jitted,
@@ -1386,6 +1433,9 @@ class GroupedAggStream:
                     dev_cols, lit_values, np.int64(n), np.int64(self._row_base)
                 )
             n_g = int(n_g_dev)
+            _observe_program(
+                "sharded-grouped" if sharded else "grouped-agg-chunk", first, t0
+            )
             if n_g > self.max_groups:
                 exc = GroupCapacityExceeded(
                     f"group cardinality {n_g} exceeds maxGroups {self.max_groups}"
@@ -1455,7 +1505,7 @@ class GroupedAggStream:
         key = _program_key(skeleton, mesh)
         program = _grouped_merge_program(key_specs, self._slots, cap_in, cap_out)
         jitted = _cached_predicate_jit(key, program)
-        _note_compile(key, (cap_in, cap_out))
+        first = _note_compile(key, (cap_in, cap_out))
         _hlo_lint.maybe_verify(
             self.session.conf, "grouped-merge", key, jitted,
             (tuple(a["keys"]), tuple(b["keys"]), tuple(a["slots"]), tuple(b["slots"]),
@@ -1469,6 +1519,7 @@ class GroupedAggStream:
                 a["fs"], b["fs"], np.int64(a["n"]), np.int64(b["n"]),
             )
             n_g = int(n_g_dev)
+        _observe_program("grouped-merge", first, t0)
         REGISTRY.counter(
             "hs_agg_merge_seconds_total",
             "Cumulative device partial-aggregate merge time (seconds)",
@@ -2675,12 +2726,14 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
             )
 
     spans = _bucketed_span_program(mesh, axis)
-    _note_compile("join-span", (tuple(lmat_dev.shape), tuple(rmat_dev.shape)))
+    first = _note_compile("join-span", (tuple(lmat_dev.shape), tuple(rmat_dev.shape)))
     _hlo_lint.maybe_verify(
         session.conf, "bucketed-smj-span", _program_key("join-span", mesh),
         spans, (lmat_dev, rmat_dev),
     )
+    t0 = _ptime.perf_counter()
     lo, hi = spans(lmat_dev, rmat_dev)
+    _observe_program("bucketed-smj-span", first, t0)
 
     if plan.how == "inner" and session.conf.join_device_materialize:
         try:

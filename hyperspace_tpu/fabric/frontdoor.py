@@ -38,20 +38,35 @@ defaults routing is the original single-candidate raise-on-failure):
 - **hedged reads**: with ``hedgeMs`` set, a primary silent past the hedge
   delay gets its (idempotent) query mirrored to the next candidate;
   first answer wins (``hs_frontdoor_failover_hedges_total``).
+
+Distributed observability (``hyperspace.obs.fabric.*``, see
+docs/observability.md "Distributed tracing"): with tracing on, every routed
+request roots a ``frontdoor-request`` trace whose ``route`` children record
+each attempt (worker, outcome, hedge/retry siblings); propagation stamps a
+W3C ``traceparent`` header (plus the ``x-hs-stitch`` byte budget when
+stitching is on) so the worker's tree carries the router's trace id, and
+stitching grafts the worker's returned span tree under the attempt span —
+``last_query_profile()`` and the Chrome export then show ONE end-to-end
+trace with per-process attribution. ``/profilez``/``/statusz`` federation
+merges the workers' profile histories and SLO burn views.
 """
 
 from __future__ import annotations
 
 import hashlib
 import json
+import os
 import queue
 import threading
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from collections import deque
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Sequence
+
+from hyperspace_tpu.obs import spans
 
 __all__ = [
     "FrontDoor",
@@ -138,6 +153,12 @@ class WorkerError(RuntimeError):
         super().__init__(message)
         self.error_type = error_type
         self.kind = kind
+
+
+def _registry():
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    return REGISTRY
 
 
 def _count_route(worker: str) -> None:
@@ -246,6 +267,33 @@ class FrontDoor:
         #: worker id -> fabric node id, learned from /healthz bodies; maps
         #: sidecar heartbeat ledgers back onto rendezvous members
         self._nodes: Dict[str, str] = {}
+        # distributed observability (hyperspace.obs.fabric.*): without a
+        # conf the router stays untraced with propagation semantics at their
+        # defaults (headers on when a trace exists, stitching off)
+        self._tracing = bool(conf.obs_tracing_enabled) if conf is not None else False
+        self._trace_max_spans = conf.obs_trace_max_spans if conf is not None else 100_000
+        self._propagate = bool(conf.obs_fabric_propagate) if conf is not None else True
+        self._stitch = bool(conf.obs_fabric_stitch_enabled) if conf is not None else False
+        self._stitch_max_spans = conf.obs_fabric_stitch_max_spans if conf is not None else 512
+        self._stitch_max_bytes = conf.obs_fabric_stitch_max_bytes if conf is not None else 262_144
+        self._fed_timeout = (
+            conf.obs_fabric_federation_timeout_seconds if conf is not None else 30.0
+        )
+        self._profiles: "deque" = deque(
+            maxlen=max(1, conf.obs_profile_history) if conf is not None else 16
+        )
+        self.flight = None
+        self._slow_s = None
+        if conf is not None and conf.obs_slow_query_ms > 0:
+            from hyperspace_tpu.obs.history import FlightRecorder
+
+            self._slow_s = conf.obs_slow_query_ms / 1000.0
+            self.flight = FlightRecorder(
+                max_entries=conf.obs_slow_query_max_entries,
+                directory=conf.obs_slow_query_dir or None,
+                registry=_registry(),
+                server="frontdoor",
+            )
 
     @property
     def worker_ids(self) -> List[str]:
@@ -278,10 +326,45 @@ class FrontDoor:
         collected batch (dict of numpy arrays, like ``collect()``). With
         failover on, a retryable failure moves to the next rendezvous
         candidate while the deadline allows; a non-retryable one raises
-        immediately."""
+        immediately. With tracing on, the request roots a
+        ``frontdoor-request`` trace carrying every attempt (and, when
+        stitching is on, the workers' grafted span trees)."""
+        if not self._tracing and self.flight is None:
+            return self._route(sql, tenant, timeout, None)
+        root = None
+        ctx = None
+        if self._tracing:
+            ctx = spans.TraceContext.new()
+            root = spans.start_trace(
+                "frontdoor-request",
+                cat="fabric",
+                max_spans=self._trace_max_spans,
+                query=sql,
+                tenant=tenant,
+            )
+            root.attrs["trace_id"] = ctx.trace_id
+        info: Dict[str, Any] = {"retries": 0, "hedged": False, "worker": None}
+        t0 = time.monotonic()
+        error: Optional[str] = None
+        try:
+            with spans.attach(root), spans.bind_context(ctx):
+                return self._route(sql, tenant, timeout, info)
+        except Exception as exc:
+            error = type(exc).__name__
+            raise
+        finally:
+            self._seal_route(root, sql, tenant, time.monotonic() - t0, error, info)
+
+    def _route(
+        self,
+        sql: str,
+        tenant: str,
+        timeout: Optional[float],
+        info: Optional[Dict[str, Any]],
+    ) -> Dict[str, Any]:
         candidates = self._candidates(tenant)
         if self._hedge_s > 0 and len(candidates) > 1:
-            return self._hedged_query(candidates, sql, tenant, timeout)
+            return self._hedged_query(candidates, sql, tenant, timeout, info)
         deadline = None if timeout is None else self._clock() + timeout
         last_exc: Optional[BaseException] = None
         for i, wid in enumerate(candidates):
@@ -293,7 +376,7 @@ class FrontDoor:
             _count_route(wid)
             worker = self._workers[wid]
             try:
-                out = self._dispatch(worker, sql, tenant, remaining)
+                out = self._attempt(wid, worker, sql, tenant, remaining)
             except Exception as exc:
                 if not self._failover or not _retryable(exc, worker):
                     if self._health is not None and _retryable(exc, worker):
@@ -302,10 +385,14 @@ class FrontDoor:
                 if self._health is not None:
                     self._health.note_failure(wid)
                 _count_failover_retry(wid)
+                if info is not None:
+                    info["retries"] += 1
                 last_exc = exc
                 continue
             if self._health is not None:
                 self._health.note_ok(wid)
+            if info is not None:
+                info["worker"] = wid
             return out
         _count_failover_exhausted()
         if last_exc is not None:
@@ -314,12 +401,51 @@ class FrontDoor:
             f"no candidate answered for tenant {tenant!r} within the deadline"
         )
 
+    def _attempt(
+        self, wid: str, worker: Any, sql: str, tenant: str, timeout: Optional[float]
+    ) -> Dict[str, Any]:
+        """One dispatch wrapped in a ``route`` span — the per-attempt node
+        that failover retries and hedges appear as siblings of. The hop gets
+        a child TraceContext so the worker's tree records WHICH attempt
+        parented it (``span_id`` here == the worker root's
+        ``parent_span_id``)."""
+        ctx = spans.current_context()
+        hop = ctx.child() if ctx is not None else None
+        with spans.span("route", cat="fabric", worker=wid) as att:
+            if hop is not None:
+                att.set(span_id=hop.span_id)
+            with spans.bind_context(hop):
+                try:
+                    out = self._dispatch(worker, sql, tenant, timeout)
+                except Exception as exc:
+                    att.set(outcome="error", error=type(exc).__name__)
+                    raise
+                att.set(outcome="ok")
+                return out
+
     def _dispatch(
         self, worker: Any, sql: str, tenant: str, timeout: Optional[float]
     ) -> Dict[str, Any]:
         if isinstance(worker, str):
             return self._http_query(worker, sql, tenant, timeout)
-        return worker.query(sql, timeout=timeout, tenant=tenant)
+        cur = spans.current_span()
+        if cur is None:
+            return worker.query(sql, timeout=timeout, tenant=tenant)
+        # traced in-process dispatch: go through submit() so the worker's
+        # span tree (fut.request_root) is graftable; same-process trees share
+        # a perf_counter domain, so anchoring at the worker root's own t0
+        # keeps the stitched alignment exact
+        fut = worker.submit(sql, timeout=timeout, tenant=tenant)
+        t = worker.admission.default_timeout if timeout is None else timeout
+        try:
+            return fut.result(timeout=None if t is None else t + 5.0)
+        finally:
+            wroot = getattr(fut, "request_root", None)
+            if wroot is not None:
+                wire = spans.to_wire(
+                    wroot, self._stitch_max_spans, self._stitch_max_bytes
+                )
+                spans.graft_remote(cur, wire, anchor_t0=wroot.t0)
 
     def _hedged_query(
         self,
@@ -327,21 +453,37 @@ class FrontDoor:
         sql: str,
         tenant: str,
         timeout: Optional[float],
+        info: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Primary + (on silence or failure) one backup, first answer wins.
         Safe because FrontDoor queries are idempotent reads — both answers
-        are correct, we just keep whichever lands first."""
+        are correct, we just keep whichever lands first. Each runner carries
+        the caller's span context across its thread (``spans.attach``), so
+        primary and hedge show as sibling ``route`` spans on one tree."""
         results: "queue.Queue" = queue.Queue()
+        parent = spans.current_span()
+        ctx = spans.current_context()
 
-        def run(wid: str) -> None:
+        def run(wid: str, hedge: bool) -> None:
             _count_route(wid)
-            try:
-                results.put((wid, None, self._dispatch(self._workers[wid], sql, tenant, timeout)))
-            except Exception as exc:  # delivered to the caller via the queue
-                results.put((wid, exc, None))
+            hop = ctx.child() if ctx is not None else None
+            with spans.attach(parent), spans.span(
+                "route", cat="fabric", worker=wid, hedge=hedge
+            ) as att:
+                if hop is not None:
+                    att.set(span_id=hop.span_id)
+                try:
+                    with spans.bind_context(hop):
+                        out = self._dispatch(self._workers[wid], sql, tenant, timeout)
+                except Exception as exc:  # delivered to the caller via the queue
+                    att.set(outcome="error", error=type(exc).__name__)
+                    results.put((wid, exc, None))
+                else:
+                    att.set(outcome="ok")
+                    results.put((wid, None, out))
 
-        def spawn(wid: str) -> None:
-            threading.Thread(target=run, args=(wid,), daemon=True).start()
+        def spawn(wid: str, hedge: bool = False) -> None:
+            threading.Thread(target=run, args=(wid, hedge), daemon=True).start()
 
         spawn(candidates[0])
         outstanding, hedged = 1, False
@@ -353,12 +495,16 @@ class FrontDoor:
                 hedged = True
                 outstanding += 1
                 _count_hedge()
-                spawn(candidates[1])
+                if info is not None:
+                    info["hedged"] = True
+                spawn(candidates[1], hedge=True)
                 continue
             outstanding -= 1
             if exc is None:
                 if self._health is not None:
                     self._health.note_ok(wid)
+                if info is not None:
+                    info["worker"] = wid
                 return out
             if self._health is not None and _retryable(exc, self._workers[wid]):
                 self._health.note_failure(wid)
@@ -370,14 +516,28 @@ class FrontDoor:
                 hedged = True
                 outstanding += 1
                 _count_failover_retry(wid)
+                if info is not None:
+                    info["retries"] += 1
                 spawn(candidates[1])
         _count_failover_exhausted()
         assert first_exc is not None
         raise first_exc
 
-    @staticmethod
+    def _trace_headers(self) -> Dict[str, str]:
+        """Propagation headers for one worker hop: the current context's
+        ``traceparent`` plus the stitch byte budget when stitched-tree
+        shipping is on. Empty (no extra request bytes at all) when no trace
+        is active or propagation is conf'd off."""
+        headers: Dict[str, str] = {}
+        ctx = spans.current_context()
+        if self._propagate and ctx is not None:
+            headers["traceparent"] = ctx.to_traceparent()
+            if self._stitch:
+                headers["x-hs-stitch"] = str(self._stitch_max_bytes)
+        return headers
+
     def _http_query(
-        base: str, sql: str, tenant: str, timeout: Optional[float]
+        self, base: str, sql: str, tenant: str, timeout: Optional[float]
     ) -> Dict[str, Any]:
         import numpy as np
 
@@ -394,7 +554,8 @@ class FrontDoor:
             # like the real connection failure it stands in for
             if FAULTS.active:
                 FAULTS.check("fabric.http", f"{base}/query")
-            with urllib.request.urlopen(url, timeout=http_timeout) as resp:
+            request = urllib.request.Request(url, headers=self._trace_headers())
+            with urllib.request.urlopen(request, timeout=http_timeout) as resp:
                 body = json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as exc:
             # the endpoint replies with a typed JSON error body on 4xx/5xx;
@@ -412,6 +573,17 @@ class FrontDoor:
             raise WorkerUnavailable(
                 f"worker {base} unreachable: {exc}", error_type=type(exc).__name__
             ) from exc
+        remote_trace = body.get("trace")
+        if remote_trace:
+            # stitch the worker's serialized tree under the live attempt
+            # span; anchoring at the attempt's start folds the network hop
+            # into the alignment error (documented in observability.md)
+            cur = spans.current_span()
+            if cur is not None:
+                spans.graft_remote(
+                    cur, remote_trace,
+                    pid=remote_trace.get("pid"), anchor_t0=cur.t0,
+                )
         if "error" in body:
             message = f"worker {base} failed: {body['error']}"
             error_type = str(body.get("errorType", ""))
@@ -424,6 +596,120 @@ class FrontDoor:
                                         kind=kind or "transient")
             raise WorkerError(message, error_type=error_type, kind=kind or "error")
         return {k: np.asarray(v) for k, v in body["columns"].items()}
+
+    def _seal_route(
+        self,
+        root: Optional[Any],
+        sql: str,
+        tenant: str,
+        latency_s: float,
+        error: Optional[str],
+        info: Dict[str, Any],
+    ) -> None:
+        """Routing completion hook (mirrors ``QueryServer._seal``): finish
+        the router-side tree, publish the end-to-end profile, and
+        flight-record slow/errored routed requests with their failover and
+        hedge outcomes."""
+        profile = None
+        if root is not None:
+            root.attrs.update(
+                retries=info["retries"], hedged=info["hedged"],
+                worker=info["worker"],
+            )
+            from hyperspace_tpu.obs.profile import build_profile
+
+            profile = build_profile(root, query=sql, error=error)
+            self._profiles.append(profile)
+        if self.flight is not None and (
+            error is not None
+            or (self._slow_s is not None and latency_s >= self._slow_s)
+        ):
+            self.flight.record(
+                "error" if error is not None else "slow",
+                latency_s,
+                query=sql,
+                tenant=tenant,
+                profile=profile,
+                route=dict(info),
+            )
+
+    # -- routed-request observability ----------------------------------------
+    def last_profiles(self) -> List[Any]:
+        """Most recent routed-request profiles (end-to-end stitched trees
+        when stitching is on), oldest first; empty without tracing."""
+        return list(self._profiles)
+
+    def last_query_profile(self) -> Optional[Any]:
+        """The most recent routed request's :class:`QueryProfile` — the ONE
+        stitched router+worker tree when stitching is on."""
+        return self._profiles[-1] if self._profiles else None
+
+    def last_slow_queries(self) -> List[Any]:
+        """Routed flight-recorder entries (slow/errored), oldest first."""
+        return [] if self.flight is None else self.flight.last_slow_queries()
+
+    # -- federation ----------------------------------------------------------
+    def profilez(self) -> Dict[str, Any]:
+        """Federated ``/profilez``: every worker's ProfileHistory snapshot
+        merged into one fleet view (``obs.history.merge_history_snapshots``
+        — P² sketches combine via n-weighted quantile averaging; see the
+        documented error model). Per-worker reachability rides along under
+        ``workers``."""
+        from hyperspace_tpu.obs.history import merge_history_snapshots
+
+        snaps: Dict[str, Optional[Dict[str, Any]]] = {}
+        for wid, worker in self._workers.items():
+            try:
+                if isinstance(worker, str):
+                    with urllib.request.urlopen(
+                        f"{worker}/profilez", timeout=self._fed_timeout
+                    ) as resp:
+                        snaps[wid] = json.loads(resp.read().decode("utf-8"))
+                else:
+                    history = getattr(worker, "history", None)
+                    snaps[wid] = None if history is None else history.snapshot()
+            except Exception:
+                if self._health is None:
+                    raise
+                self._health.note_failure(wid)
+                snaps[wid] = None
+        merged = merge_history_snapshots([s for s in snaps.values() if s])
+        merged["workers"] = {
+            wid: None if s is None else {
+                "fingerprints": int(s.get("fingerprints", 0) or 0),
+                "evicted": int(s.get("evicted", 0) or 0),
+            }
+            for wid, s in snaps.items()
+        }
+        return merged
+
+    def federated_statusz(self) -> Dict[str, Any]:
+        """Fleet ``/statusz``: the per-worker bodies (:meth:`statusz`,
+        shape unchanged) plus a merged per-tenant SLO view — summed
+        good/bad, fleet compliance, and the WORST per-window burn rate
+        across workers (the alerting-relevant aggregate: one burning worker
+        must not be averaged away by idle peers)."""
+        per = self.statusz()
+        tenants: Dict[str, Dict[str, Any]] = {}
+        for wid, body in per.items():
+            if not isinstance(body, dict):
+                continue
+            slo = body.get("slo") or {}
+            for tenant, st in (slo.get("tenants") or {}).items():
+                cur = tenants.setdefault(
+                    tenant, {"good": 0, "bad": 0, "burnRates": {}}
+                )
+                cur["good"] += int(st.get("good", 0) or 0)
+                cur["bad"] += int(st.get("bad", 0) or 0)
+                for window, rate in (st.get("burnRates") or {}).items():
+                    prev = cur["burnRates"].get(window)
+                    rate = float(rate)
+                    if prev is None or rate > prev:
+                        cur["burnRates"][window] = rate
+        for cur in tenants.values():
+            total = cur["good"] + cur["bad"]
+            cur["compliance"] = (cur["good"] / total) if total else None
+        return {"workers": per, "slo": {"tenants": tenants}}
 
     # -- health observation --------------------------------------------------
     def probe(self, timeout: float = 5.0) -> Dict[str, Optional[dict]]:
@@ -617,6 +903,12 @@ class WorkerEndpoint:
             self._reply(req, 200, "text/plain; version=0.0.4; charset=utf-8", body)
         elif path == "/statusz":
             self._reply_json(req, 200, self.server.statusz())
+        elif path == "/profilez":
+            history = getattr(self.server, "history", None)
+            if history is None:
+                self._reply_json(req, 404, {"error": "profile history disabled"})
+            else:
+                self._reply_json(req, 200, history.snapshot())
         elif path == "/healthz":
             self._reply_json(
                 req, 200, _local_healthz(self.server, started_at=self._started_at)
@@ -625,8 +917,34 @@ class WorkerEndpoint:
             self._reply_json(
                 req, 404,
                 {"error": "not found",
-                 "endpoints": ["/query", "/metrics", "/statusz", "/healthz"]},
+                 "endpoints": ["/query", "/metrics", "/statusz", "/profilez",
+                               "/healthz"]},
             )
+
+    def _stitch_payload(self, fut, stitch_budget: Optional[str]) -> Optional[Dict[str, Any]]:
+        """The bounded span-tree payload for a ``/query`` response, or None
+        when the router did not ask (no ``x-hs-stitch`` header), the budget
+        is malformed, or this worker produced no tree (tracing off).
+        Responses without the header stay byte-identical to a build without
+        stitching."""
+        if not stitch_budget or fut is None:
+            return None
+        root = getattr(fut, "request_root", None)
+        if root is None:
+            return None
+        try:
+            budget = int(stitch_budget)
+        except ValueError:
+            return None
+        conf = self.server.session.conf
+        wire = spans.to_wire(
+            root,
+            max_spans=conf.obs_fabric_stitch_max_spans,
+            max_bytes=max(1, min(budget, conf.obs_fabric_stitch_max_bytes)),
+        )
+        wire["pid"] = os.getpid()
+        wire["server"] = self.server.server_name
+        return wire
 
     def _query(self, req: BaseHTTPRequestHandler, query: Dict[str, list]) -> None:
         sql = (query.get("sql") or [None])[0]
@@ -640,28 +958,39 @@ class WorkerEndpoint:
         tenant = (query.get("tenant") or ["default"])[0]
         timeout_ms = (query.get("timeoutMs") or [None])[0]
         timeout = None if timeout_ms is None else float(timeout_ms) / 1000.0
+        # inbound trace identity: a router's traceparent parents this
+        # worker's span tree; malformed headers degrade to untraced
+        ctx = spans.parse_traceparent(req.headers.get("traceparent"))
+        stitch_budget = req.headers.get("x-hs-stitch")
+        fut = None
         try:
-            batch = self.server.query(sql, timeout=timeout, tenant=tenant)
+            fut = self.server.submit(
+                sql, timeout=timeout, tenant=tenant, trace_context=ctx
+            )
+            t = self.server.admission.default_timeout if timeout is None else timeout
+            batch = fut.result(timeout=None if t is None else t + 5.0)
         except Exception as exc:
             # serialize the reliability classification so the FrontDoor can
             # rebuild the retry/no-retry decision on its side of the wire
             from hyperspace_tpu.reliability import errors as rel_errors
 
             retryable = not rel_errors.is_corrupt(exc)
-            self._reply_json(
-                req,
-                503 if retryable else 400,
-                {
-                    "error": f"{type(exc).__name__}: {exc}",
-                    "errorType": type(exc).__name__,
-                    "kind": "transient" if retryable else "corrupt",
-                    "retryable": retryable,
-                },
-            )
+            body: Dict[str, Any] = {
+                "error": f"{type(exc).__name__}: {exc}",
+                "errorType": type(exc).__name__,
+                "kind": "transient" if retryable else "corrupt",
+                "retryable": retryable,
+            }
+            trace = self._stitch_payload(fut, stitch_budget)
+            if trace is not None:
+                body["trace"] = trace
+            self._reply_json(req, 503 if retryable else 400, body)
             return
-        self._reply_json(
-            req, 200, {"columns": {k: v.tolist() for k, v in batch.items()}}
-        )
+        body = {"columns": {k: v.tolist() for k, v in batch.items()}}
+        trace = self._stitch_payload(fut, stitch_budget)
+        if trace is not None:
+            body["trace"] = trace
+        self._reply_json(req, 200, body)
 
     @staticmethod
     def _reply(req: BaseHTTPRequestHandler, code: int, ctype: str, body: bytes) -> None:

@@ -65,6 +65,24 @@ class CommitWatcher:
         self._mtimes: Dict[str, int] = {}  # commits dir -> st_mtime_ns at last list
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # per-node staleness observability (docs/scale-out.md): how long ago
+        # this node's watcher finished a sweep, and how far behind the log it
+        # was when it did — together they bound observable staleness against
+        # the configured poll interval. -1 = never polled.
+        self._last_poll_at: Optional[float] = None
+        reg = _registry()
+        # a stable unixtime, NOT a live age: re-rendering the exposition
+        # must be byte-identical between sweeps (the /metrics endpoint
+        # contract); scrapers compute age as time() - value
+        reg.gauge(
+            "hs_fabric_watcher_last_poll_seconds",
+            "unixtime at which this node's commit watcher completed its "
+            "latest poll sweep (-1 before the first sweep)",
+            fn=lambda: (
+                -1.0 if self._last_poll_at is None else self._last_poll_at
+            ),
+            server=self.node_id,
+        )
 
     # -- polling -------------------------------------------------------------
     def poll_once(self) -> int:
@@ -79,6 +97,7 @@ class CommitWatcher:
         reg = _registry()
         reg.counter("hs_fabric_polls_total", "commit-watcher poll sweeps").inc()
         replayed = 0
+        newest_ts: Optional[float] = None
         for name in sorted(os.listdir(root)):
             if name.startswith((".", "_")):
                 continue
@@ -119,7 +138,23 @@ class CommitWatcher:
                         "hs_fabric_replay_lag_seconds",
                         "commit-to-replay lag of the most recent replayed record",
                     ).set(max(0.0, time.time() - float(ts)))
+                    if newest_ts is None or float(ts) > newest_ts:
+                        newest_ts = float(ts)
                 replayed += 1
+        # per-node commit lag: distance between remote publish and this
+        # sweep's replay. A sweep that found nothing to replay means this
+        # node is caught up with every record it can see — lag 0, which is
+        # what makes the gauge a staleness BOUND rather than a last-event
+        # memory (docs/scale-out.md).
+        reg.gauge(
+            "hs_fabric_commit_lag_seconds",
+            "publish-to-replay lag of this node against the commit log "
+            "(0 when the last sweep found nothing left to replay)",
+            server=self.node_id,
+        ).set(
+            max(0.0, time.time() - newest_ts) if newest_ts is not None else 0.0
+        )
+        self._last_poll_at = time.time()
         return replayed
 
     # -- thread lifecycle ----------------------------------------------------

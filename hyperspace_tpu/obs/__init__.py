@@ -36,6 +36,7 @@ from hyperspace_tpu.obs.history import (
     FlightRecorder,
     ProfileHistory,
     load_history,
+    merge_history_snapshots,
 )
 from hyperspace_tpu.obs.profile import QueryProfile, build_profile
 from hyperspace_tpu.obs.slo import SloTracker
@@ -43,12 +44,19 @@ from hyperspace_tpu.obs.spans import (
     NULL_SPAN,
     Span,
     Trace,
+    TraceContext,
     add_manual,
     attach,
+    bind_context,
+    current_context,
     current_span,
+    from_wire,
+    graft_remote,
+    parse_traceparent,
     span,
     start_trace,
     to_chrome_trace,
+    to_wire,
     trace,
     wrap,
 )
@@ -67,17 +75,25 @@ __all__ = [
     "FlightRecorder",
     "ProfileHistory",
     "load_history",
+    "merge_history_snapshots",
     "SloTracker",
     "TelemetryEndpoint",
     "NULL_SPAN",
     "Span",
     "Trace",
+    "TraceContext",
     "add_manual",
     "attach",
+    "bind_context",
+    "current_context",
     "current_span",
+    "from_wire",
+    "graft_remote",
+    "parse_traceparent",
     "span",
     "start_trace",
     "to_chrome_trace",
+    "to_wire",
     "trace",
     "wrap",
 ]

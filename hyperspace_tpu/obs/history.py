@@ -45,6 +45,7 @@ __all__ = [
     "FlightEntry",
     "FlightRecorder",
     "load_history",
+    "merge_history_snapshots",
 ]
 
 
@@ -422,6 +423,132 @@ def load_history(path: str, **kwargs) -> ProfileHistory:
 
 
 # --------------------------------------------------------------------------
+# Federation: approximate cross-process snapshot merging
+# --------------------------------------------------------------------------
+
+#: the StreamStat streams a snapshot entry carries, in to_json key form
+_STREAM_KEYS = ("latencySeconds", "rows", "bytes", "compiles")
+
+
+def _merge_stream(acc: Optional[Dict[str, Any]], add: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """Combine two ``StreamStat.to_json`` dicts.
+
+    Exact for ``n``/``min``/``max``; ``mean`` is the exact n-weighted
+    combination. ``ema``/``p50``/``p95`` CANNOT be merged exactly from
+    summaries (the samples are gone), so they combine as n-weighted
+    averages of the per-node values — see ``merge_history_snapshots`` for
+    the error model.
+    """
+    if not acc:
+        return dict(add) if add else None
+    if not add:
+        return acc
+    na, nb = int(acc.get("n", 0) or 0), int(add.get("n", 0) or 0)
+    n = na + nb
+    out: Dict[str, Any] = {"n": n}
+
+    def _pick(key: str, fn):
+        va, vb = acc.get(key), add.get(key)
+        if va is None:
+            return vb
+        if vb is None:
+            return va
+        return fn(va, vb)
+
+    def _weighted(va: float, vb: float) -> float:
+        if n == 0:
+            return 0.0
+        return (float(va) * na + float(vb) * nb) / n
+
+    out["mean"] = _pick("mean", _weighted)
+    out["ema"] = _pick("ema", _weighted)
+    out["min"] = _pick("min", min)
+    out["max"] = _pick("max", max)
+    out["p50"] = _pick("p50", _weighted)
+    out["p95"] = _pick("p95", _weighted)
+    return out
+
+
+def merge_history_snapshots(snapshots: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Merge several ``ProfileHistory.snapshot()`` bodies into one fleet
+    view keyed by fingerprint — the FrontDoor's federated ``/profilez``.
+
+    Error model (documented contract, tested): counts, error counts,
+    first/last-seen, min/max are EXACT sums/extrema. Means are exact
+    n-weighted combinations. Quantiles (p50/p95) and EMAs are
+    **approximate**: each node contributes a P² estimate (itself an
+    approximation that converges with samples), and the merge n-weights
+    those point estimates. The combined quantile is exact when every node
+    saw the same latency distribution; otherwise it lies within
+    ``[min(node quantiles), max(node quantiles)]`` — the error is bounded
+    by the cross-node spread, NOT by the true distribution's tails. Skewed
+    fleets (one slow node) therefore show a merged p95 *below* the true
+    fleet p95; per-worker drill-down (``/profilez`` on the worker) stays
+    the exact source. Derived estimates are recomputed from the merged
+    stats with the same blend ``estimate_cost`` uses.
+    """
+    by_fp: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+    evicted = 0
+    for snap in snapshots:
+        if not snap:
+            continue
+        evicted += int(snap.get("evicted", 0) or 0)
+        for entry in snap.get("entries") or []:
+            fp = entry.get("fingerprint")
+            if not fp:
+                continue
+            cur = by_fp.get(fp)
+            if cur is None:
+                cur = {
+                    "fingerprint": fp,
+                    "query": entry.get("query", ""),
+                    "firstSeen": entry.get("firstSeen"),
+                    "lastSeen": entry.get("lastSeen"),
+                    "count": 0,
+                    "errors": 0,
+                }
+                for key in _STREAM_KEYS:
+                    cur[key] = None
+                by_fp[fp] = cur
+            if not cur["query"] and entry.get("query"):
+                cur["query"] = entry["query"]
+            fs, ls = entry.get("firstSeen"), entry.get("lastSeen")
+            if fs is not None and (cur["firstSeen"] is None or fs < cur["firstSeen"]):
+                cur["firstSeen"] = fs
+            if ls is not None and (cur["lastSeen"] is None or ls > cur["lastSeen"]):
+                cur["lastSeen"] = ls
+            cur["count"] += int(entry.get("count", 0) or 0)
+            cur["errors"] += int(entry.get("errors", 0) or 0)
+            for key in _STREAM_KEYS:
+                cur[key] = _merge_stream(cur[key], entry.get(key))
+    entries = []
+    for cur in by_fp.values():
+        lat = cur.get("latencySeconds") or {}
+        n = int(lat.get("n", 0) or 0)
+        estimate = None
+        if n > 0:
+            p50 = float(lat.get("p50") or 0.0)
+            ema = float(lat.get("ema") if lat.get("ema") is not None else p50)
+            p95 = float(lat.get("p95") or p50)
+            predicted = 0.5 * p50 + 0.5 * ema
+            saturation = min(1.0, n / 20.0)
+            spread = (p95 / p50) if p50 > 0 else 1.0
+            estimate = {
+                "latencySeconds": predicted,
+                "confidence": min(1.0, saturation / max(1.0, spread ** 0.5)),
+                "samples": n,
+            }
+        cur["estimate"] = estimate
+        entries.append(cur)
+    return {
+        "fingerprints": len(entries),
+        "evicted": evicted,
+        "entries": entries,
+        "federated": True,
+    }
+
+
+# --------------------------------------------------------------------------
 # Slow-query flight recorder
 # --------------------------------------------------------------------------
 
@@ -430,12 +557,14 @@ class FlightEntry:
     """One captured outlier query: profile + plan facts + environment."""
 
     __slots__ = ("ts", "reason", "latency_s", "fingerprint", "query", "tenant",
-                 "profile", "plan_summary", "dispatch", "conf_deltas", "path")
+                 "profile", "plan_summary", "dispatch", "conf_deltas", "route",
+                 "path")
 
     def __init__(self, reason: str, latency_s: float, fingerprint: str = "",
                  query: str = "", tenant: str = "", profile=None,
                  plan_summary: str = "", dispatch: str = "",
-                 conf_deltas: Optional[Dict[str, Any]] = None):
+                 conf_deltas: Optional[Dict[str, Any]] = None,
+                 route: Optional[Dict[str, Any]] = None):
         self.ts = time.time()
         self.reason = reason  # "slow" | "error" | "rejected"
         self.latency_s = float(latency_s)
@@ -446,6 +575,9 @@ class FlightEntry:
         self.plan_summary = plan_summary
         self.dispatch = dispatch
         self.conf_deltas = dict(conf_deltas or {})
+        # routed-request outcome (FrontDoor captures): failover retries,
+        # whether a hedge fired, and the worker that answered
+        self.route = dict(route) if route else None
         self.path: Optional[str] = None  # on-disk mirror, when enabled
 
     def chrome_trace(self) -> Optional[Dict[str, Any]]:
@@ -470,6 +602,7 @@ class FlightEntry:
             "planSummary": self.plan_summary,
             "dispatch": self.dispatch,
             "confDeltas": {k: str(v) for k, v in self.conf_deltas.items()},
+            "route": self.route,
             "profile": None if self.profile is None else self.profile.to_json(),
         }
 
@@ -503,7 +636,8 @@ class FlightRecorder:
 
     def record(self, reason: str, latency_s: float, fingerprint: str = "",
                query: str = "", tenant: str = "", profile=None,
-               conf_deltas: Optional[Dict[str, Any]] = None) -> FlightEntry:
+               conf_deltas: Optional[Dict[str, Any]] = None,
+               route: Optional[Dict[str, Any]] = None) -> FlightEntry:
         plan_summary = ""
         dispatch = ""
         if profile is not None:
@@ -514,7 +648,7 @@ class FlightRecorder:
         entry = FlightEntry(
             reason, latency_s, fingerprint=fingerprint, query=query,
             tenant=tenant, profile=profile, plan_summary=plan_summary,
-            dispatch=dispatch, conf_deltas=conf_deltas,
+            dispatch=dispatch, conf_deltas=conf_deltas, route=route,
         )
         if self._registry is not None:
             self._registry.counter(

@@ -20,12 +20,21 @@ the hot paths (bench.py ``--obs-overhead`` pins the bar).
 Export: :func:`to_chrome_trace` renders a finished trace as Chrome
 trace-event JSON (``{"traceEvents": [...]}``, complete ``"ph": "X"`` events)
 loadable in Perfetto / ``chrome://tracing``.
+
+Distributed traces (docs/observability.md "Distributed tracing"): a
+:class:`TraceContext` is the W3C-traceparent-shaped identity that crosses
+process boundaries — the FrontDoor stamps it on ``/query`` requests, the
+worker binds it via :func:`bind_context` so its tree carries the router's
+``trace_id``, and :func:`to_wire`/:func:`from_wire`/:func:`graft_remote`
+move the worker's finished (bounded) span tree back into the router's tree
+with per-process ``pid`` attribution.
 """
 
 from __future__ import annotations
 
 import contextlib
 import contextvars
+import json
 import os
 import threading
 import time
@@ -34,19 +43,101 @@ from typing import Any, Dict, Iterator, List, Optional
 __all__ = [
     "Span",
     "Trace",
+    "TraceContext",
     "span",
     "trace",
     "start_trace",
     "current_span",
+    "current_context",
+    "bind_context",
+    "parse_traceparent",
     "attach",
     "wrap",
     "add_manual",
+    "to_wire",
+    "from_wire",
+    "graft_remote",
+    "graft_span",
     "to_chrome_trace",
 ]
 
 _current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
     "hs_obs_current_span", default=None
 )
+
+_context: "contextvars.ContextVar[Optional[TraceContext]]" = contextvars.ContextVar(
+    "hs_obs_trace_context", default=None
+)
+
+
+class TraceContext:
+    """W3C-traceparent-shaped trace identity that crosses process hops.
+
+    ``trace_id`` (32 hex chars) names the end-to-end request; ``span_id``
+    (16 hex chars) names the sender's active span, which the receiver
+    records as its parent. ``sampled`` carries the sender's keep/drop
+    decision so a worker never traces a request its router is not keeping.
+    """
+
+    __slots__ = ("trace_id", "span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str, sampled: bool = True):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def new(cls, sampled: bool = True) -> "TraceContext":
+        return cls(os.urandom(16).hex(), os.urandom(8).hex(), sampled)
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — what an attempt/hedge hop sends."""
+        return TraceContext(self.trace_id, os.urandom(8).hex(), self.sampled)
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_traceparent()})"
+
+
+def parse_traceparent(header: Optional[str]) -> Optional["TraceContext"]:
+    """Parse a ``traceparent`` header; None on anything malformed (an
+    unparseable header must degrade to an untraced request, never a 500)."""
+    if not header:
+        return None
+    parts = str(header).strip().split("-")
+    if len(parts) != 4:
+        return None
+    version, trace_id, span_id, flags = parts
+    if len(trace_id) != 32 or len(span_id) != 16 or len(version) != 2:
+        return None
+    try:
+        int(trace_id, 16), int(span_id, 16), int(flags, 16)
+    except ValueError:
+        return None
+    if set(trace_id) == {"0"} or set(span_id) == {"0"}:
+        return None
+    return TraceContext(trace_id, span_id, sampled=bool(int(flags, 16) & 1))
+
+
+def current_context() -> Optional[TraceContext]:
+    """The context's active :class:`TraceContext` (None when untraced)."""
+    return _context.get()
+
+
+@contextlib.contextmanager
+def bind_context(ctx: Optional[TraceContext]):
+    """Make ``ctx`` the context's trace identity for the block; ``None`` is
+    a no-op so callers can pass a maybe-absent context."""
+    if ctx is None:
+        yield None
+        return
+    token = _context.set(ctx)
+    try:
+        yield ctx
+    finally:
+        _context.reset(token)
 
 
 class Trace:
@@ -70,7 +161,7 @@ class Span:
     ``attrs`` carries operator facts (rows, bytes, index names); ``events``
     carries point annotations (the dispatch-trace kind/detail pairs)."""
 
-    __slots__ = ("name", "cat", "t0", "t1", "attrs", "events", "children", "tid", "trace")
+    __slots__ = ("name", "cat", "t0", "t1", "attrs", "events", "children", "tid", "trace", "pid")
 
     def __init__(self, name: str, cat: str = "", trace: Optional[Trace] = None):
         self.name = name
@@ -82,6 +173,9 @@ class Span:
         self.children: List["Span"] = []
         self.tid = threading.get_ident()
         self.trace = trace
+        # process attribution for stitched cross-process trees: None means
+        # "this process"; grafted remote spans carry their origin's os pid
+        self.pid: Optional[int] = None
 
     def set(self, **attrs) -> "Span":
         self.attrs.update(attrs)
@@ -279,6 +373,150 @@ def add_manual(parent: Span, name: str, cat: str, t0: float, t1: float, **attrs)
 
 
 # --------------------------------------------------------------------------
+# Cross-process stitching: bounded wire serialization + grafting
+# --------------------------------------------------------------------------
+
+
+def _span_to_dict(sp: Span, base: float, budget: List[int]) -> Optional[Dict[str, Any]]:
+    """One span as a JSON-able dict with times relative to ``base`` (the
+    serialized root's t0, in seconds). ``budget[0]`` is the remaining span
+    allowance; a subtree past it is dropped (tree-prefix truncation keeps
+    parentage valid) and counted in ``budget[1]``."""
+    if budget[0] <= 0:
+        budget[1] += sum(1 for _ in sp.walk())
+        return None
+    budget[0] -= 1
+    end = sp.t1 if sp.t1 is not None else time.perf_counter()
+    out: Dict[str, Any] = {
+        "name": sp.name,
+        "cat": sp.cat,
+        "start": round(sp.t0 - base, 9),
+        "dur": round(max(0.0, end - sp.t0), 9),
+        "tid": sp.tid,
+    }
+    if sp.attrs:
+        out["attrs"] = {k: _jsonable(v) for k, v in sp.attrs.items()}
+    if sp.events:
+        out["events"] = [[k, d] for k, d in sp.events]
+    kids = []
+    for c in list(sp.children):
+        d = _span_to_dict(c, base, budget)
+        if d is not None:
+            kids.append(d)
+    if kids:
+        out["children"] = kids
+    return out
+
+
+def to_wire(
+    root: Span, max_spans: int = 512, max_bytes: int = 262144
+) -> Dict[str, Any]:
+    """Serialize a finished span tree for the ``/query`` response.
+
+    Doubly bounded: at most ``max_spans`` spans survive (tree-prefix
+    truncation, remainder counted in ``droppedSpans``), and if the JSON
+    encoding still exceeds ``max_bytes`` the payload degrades to the root
+    alone with ``truncated: true`` — a worker must never inflate a response
+    past the router's stated budget.
+    """
+    budget = [max(1, int(max_spans)), 0]
+    tree = _span_to_dict(root, root.t0, budget)
+    out: Dict[str, Any] = {"root": tree}
+    dropped = budget[1]
+    if root.trace is not None and root.trace.dropped:
+        dropped += root.trace.dropped
+    if dropped:
+        out["droppedSpans"] = int(dropped)
+    encoded = json.dumps(out, default=str)
+    if len(encoded) > int(max_bytes):
+        solo = dict(tree)
+        solo.pop("children", None)
+        out = {"root": solo, "truncated": True}
+        if dropped:
+            out["droppedSpans"] = int(dropped)
+    return out
+
+
+def _span_from_dict(
+    d: Dict[str, Any], shift: float, pid: Optional[int], trace: Optional[Trace]
+) -> Span:
+    sp = Span.__new__(Span)
+    sp.name = str(d.get("name", "?"))
+    sp.cat = str(d.get("cat", ""))
+    sp.t0 = float(d.get("start", 0.0)) + shift
+    sp.t1 = sp.t0 + float(d.get("dur", 0.0))
+    sp.attrs = dict(d.get("attrs") or {})
+    sp.events = [tuple(e) for e in (d.get("events") or [])]
+    sp.tid = int(d.get("tid", 0))
+    sp.trace = trace
+    sp.pid = pid
+    sp.children = [
+        _span_from_dict(c, shift, pid, trace) for c in (d.get("children") or [])
+    ]
+    return sp
+
+
+def from_wire(
+    wire: Dict[str, Any], anchor_t0: Optional[float] = None, pid: Optional[int] = None
+) -> Optional[Span]:
+    """Rebuild a :func:`to_wire` payload as a local Span tree.
+
+    ``anchor_t0`` (a local ``perf_counter`` reading, normally the dispatch
+    span's start) re-bases the remote tree's relative times onto this
+    process's clock: remote offsets are exact *within* the remote tree, but
+    the anchor inherits the network hop — cross-process alignment is
+    approximate by one request latency, which is the honest best available
+    without synchronized clocks.
+    """
+    tree = (wire or {}).get("root")
+    if not isinstance(tree, dict):
+        return None
+    shift = time.perf_counter() if anchor_t0 is None else float(anchor_t0)
+    return _span_from_dict(tree, shift, pid, None)
+
+
+def graft_span(parent: Span, child_root: Optional[Span]) -> Optional[Span]:
+    """Attach an existing span tree under ``parent``, charging the subtree
+    against the parent's trace budget (overflow counts as dropped, and the
+    subtree is kept whole — grafting never slices a remote tree)."""
+    if child_root is None:
+        return None
+    size = sum(1 for _ in child_root.walk())
+    tr = parent.trace
+    if tr is not None:
+        if tr.count + size > tr.max_spans:
+            tr.dropped += size
+            return None
+        tr.count += size
+        for sp in child_root.walk():
+            sp.trace = tr
+    parent.children.append(child_root)
+    return child_root
+
+
+def graft_remote(
+    parent: Span,
+    wire: Dict[str, Any],
+    pid: Optional[int] = None,
+    anchor_t0: Optional[float] = None,
+) -> Optional[Span]:
+    """Rebuild a worker's wire payload and graft it under ``parent`` (the
+    router's dispatch span). Returns the grafted root, or None when the
+    payload is empty/unparseable or the local budget rejects it."""
+    remote = from_wire(
+        wire, anchor_t0=parent.t0 if anchor_t0 is None else anchor_t0, pid=pid
+    )
+    if remote is None:
+        return None
+    dropped = int((wire or {}).get("droppedSpans", 0) or 0)
+    if dropped:
+        remote.attrs.setdefault("dropped_spans", dropped)
+    if (wire or {}).get("truncated"):
+        remote.attrs.setdefault("truncated", True)
+    return graft_span(parent, remote)
+
+
+# --------------------------------------------------------------------------
 # Chrome trace-event export (Perfetto / chrome://tracing)
 # --------------------------------------------------------------------------
 
@@ -312,7 +550,26 @@ def to_chrome_trace(root: Span, pid: Optional[int] = None) -> Dict[str, Any]:
             "args": {"name": "hyperspace_tpu"},
         }
     )
+    named_pids = {pid}
     for sp in root.walk():
+        sp_pid = sp.pid if sp.pid is not None else pid
+        if sp_pid not in named_pids:
+            # stitched remote spans show on their own process track, named
+            # by the worker that produced them when the graft recorded one
+            named_pids.add(sp_pid)
+            server = sp.attrs.get("server")
+            events.append(
+                {
+                    "name": "process_name",
+                    "ph": "M",
+                    "pid": sp_pid,
+                    "tid": 0,
+                    "args": {
+                        "name": f"hyperspace_tpu worker {server}" if server
+                        else f"hyperspace_tpu pid {sp_pid}"
+                    },
+                }
+            )
         end = sp.t1 if sp.t1 is not None else time.perf_counter()
         args = {k: _jsonable(v) for k, v in sp.attrs.items()}
         if sp.events:
@@ -324,7 +581,7 @@ def to_chrome_trace(root: Span, pid: Optional[int] = None) -> Dict[str, Any]:
                 "ph": "X",
                 "ts": round((sp.t0 - base) * 1e6, 3),
                 "dur": round(max(0.0, end - sp.t0) * 1e6, 3),
-                "pid": pid,
+                "pid": sp_pid,
                 "tid": sp.tid,
                 "args": args,
             }

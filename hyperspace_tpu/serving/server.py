@@ -205,6 +205,30 @@ class QueryServer:
         self.tracing_enabled = bool(conf.obs_tracing_enabled)
         self._trace_max_spans = conf.obs_trace_max_spans
         self._profiles: "deque" = deque(maxlen=max(1, conf.obs_profile_history))
+        # identity facts every exposition should carry: the always-1 build
+        # gauge makes merged/federated scrapes attributable to a version and
+        # fabric node, and the commit-seq gauge puts each process's applied
+        # log position beside its serving series
+        from hyperspace_tpu.fabric.records import local_node_id
+        from hyperspace_tpu.version import __version__
+
+        self.node_id = local_node_id(conf)
+        self.registry.gauge(
+            "hs_build_info",
+            "always 1; the labels carry the build version, fabric node, and "
+            "server identity of this exposition",
+            version=__version__, node=self.node_id, server=self.server_name,
+        ).set(1.0)
+        if conf.fabric_enabled:
+            # fabric-off keeps the exposition free of hs_fabric_* families
+            # (the default-off byte-identity contract in docs/scale-out.md)
+            bus_ref = self.session.lifecycle_bus
+            self.registry.gauge(
+                "hs_fabric_commit_seq",
+                "last-applied commit sequence of this process's invalidation bus",
+                fn=lambda: float(getattr(bus_ref, "commit_seq", 0) or 0),
+                server=self.server_name, node=self.node_id,
+            )
 
         # query intelligence: fingerprint history, SLO tracking, slow-query
         # flight recorder, optional HTTP telemetry endpoint (obs/history.py,
@@ -361,24 +385,38 @@ class QueryServer:
         self.shutdown()
 
     # -- submission ----------------------------------------------------------
-    def submit(self, query: Any, timeout: Optional[float] = None, tenant: str = "default") -> "Future":
+    def submit(
+        self,
+        query: Any,
+        timeout: Optional[float] = None,
+        tenant: str = "default",
+        trace_context: Optional[spans.TraceContext] = None,
+    ) -> "Future":
         """Admit a query (SQL text or DataFrame) and return a Future yielding
         the collected batch (dict of numpy arrays, like ``collect()``).
         Raises :class:`AdmissionRejected` immediately when the queue is full
         and :class:`ServerClosed` after shutdown. ``tenant`` labels the
-        request's SLO accounting and per-tenant completion counters."""
+        request's SLO accounting and per-tenant completion counters.
+        ``trace_context`` (or the ambient :func:`spans.current_context`)
+        parents this request's span tree under a routing caller's trace."""
         if self._closed or not self._started:
             raise ServerClosed("server is not running (call start() or use as a context manager)")
         enabled = bool(self.session.hyperspace_enabled)
         query_text = query if isinstance(query, str) else type(query).__name__
+        ctx = trace_context if trace_context is not None else spans.current_context()
         root = None
-        if self.tracing_enabled:
+        if self.tracing_enabled and (ctx is None or ctx.sampled):
             root = spans.start_trace(
                 "request",
                 max_spans=self._trace_max_spans,
                 server=self.server_name,
                 query=query_text,
             )
+            if ctx is not None:
+                # cross-process parentage: the router's trace id + the span
+                # that issued this hop, checkable after stitching
+                root.attrs["trace_id"] = ctx.trace_id
+                root.attrs["parent_span_id"] = ctx.span_id
         with spans.attach(root):
             plan, fp = self._parse(query)
         # pin the data version at admission: the token, the brand, and every

@@ -1,0 +1,23 @@
+"""Seeded trace-context-drop violations: a request-handling function that
+spawns a bare thread, and a /query fetch with no traceparent header."""
+
+import json
+import threading
+import urllib.request
+
+
+def hedged_dispatch(workers, sql, tenant):
+    results = []
+
+    def run(worker):
+        results.append(worker.query(sql, tenant=tenant))
+
+    for worker in workers:
+        threading.Thread(target=run, args=(worker,), daemon=True).start()
+    return results
+
+
+def fetch_remote(base, sql):
+    url = f"{base}/query?sql={sql}"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read().decode("utf-8"))

@@ -1,0 +1,42 @@
+"""Clean counterpart to bad_trace_drop.py: the same seams with the trace
+context carried across them — spans.attach on the spawned thread, a
+traceparent header on the /query hop — plus a lifecycle thread that
+handles no request state and needs no marker."""
+
+import json
+import threading
+import urllib.request
+
+from hyperspace_tpu.obs import spans
+
+
+def hedged_dispatch(workers, sql, tenant):
+    results = []
+    parent = spans.current_span()
+    ctx = spans.current_context()
+
+    def run(worker):
+        with spans.attach(parent), spans.bind_context(ctx):
+            results.append(worker.query(sql, tenant=tenant))
+
+    for worker in workers:
+        threading.Thread(target=run, args=(worker,), daemon=True).start()
+    return results
+
+
+def fetch_remote(base, sql, ctx):
+    url = f"{base}/query?sql={sql}"
+    request = urllib.request.Request(
+        url, headers={"traceparent": ctx.to_traceparent()}
+    )
+    with urllib.request.urlopen(request, timeout=30) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+class Poller:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass

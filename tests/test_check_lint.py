@@ -118,6 +118,28 @@ class TestRulePairs:
         # dunders, and function/class-body mutables all pass.
         assert lint_one(fixture("clean_process_local.py"), "process-local-state") == []
 
+    def test_trace_context_drop_bad(self):
+        found = lint_one(fixture("fabric", "bad_trace_drop.py"), "trace-context-drop")
+        assert len(found) == 2
+        messages = " | ".join(f.message for f in found)
+        assert "does not cross thread creation" in messages
+        assert "traceparent" in messages
+        assert [f.line for f in found] == [16, 22]
+
+    def test_trace_context_drop_clean(self):
+        # spans.attach/bind_context on the spawned thread, a traceparent
+        # header on the /query hop, and a request-free lifecycle thread
+        # all pass.
+        assert lint_one(fixture("fabric", "clean_trace_drop.py"), "trace-context-drop") == []
+
+    def test_trace_context_drop_only_fires_under_fabric_or_serving(self):
+        from hyperspace_tpu.check.rules.trace_context_drop import _in_scope
+
+        assert _in_scope(os.path.join("hyperspace_tpu", "fabric", "x.py"))
+        assert _in_scope(os.path.join("hyperspace_tpu", "serving", "x.py"))
+        assert not _in_scope(os.path.join("hyperspace_tpu", "obs", "x.py"))
+        assert not _in_scope("bench.py")
+
     def test_process_local_state_only_fires_under_serving_or_reliability(self):
         # Full-scope runs keep the rule off layers whose module state the
         # fabric does not reason about — bad_jit.py lives outside them.
@@ -153,6 +175,7 @@ class TestRunLint:
             "snapshot-pin",
             "io-error-swallow",
             "process-local-state",
+            "trace-context-drop",
         }
 
     def test_default_scope_excludes_tests(self):
