@@ -150,12 +150,19 @@ class ProgramContract:
     HLO (``max=None`` = unbounded). Ops not listed must not appear at all —
     a contract says everything it permits. ``forbid`` names which
     :data:`FORBIDDEN_PATTERNS` rules apply (default: all).
+
+    ``single_fusion`` asserts the whole-plan-fusion guarantee: the family
+    compiles to ONE executable — exactly one ``HloModule`` with exactly one
+    ``ENTRY`` computation in the compiled text. (Backends still split an
+    entry into internal ``fusion`` computations; the per-stage promise is
+    one module and one entry, i.e. one dispatch, not one backend kernel.)
     """
 
     family: str
     collectives: Dict[str, Tuple[int, Optional[int]]] = field(default_factory=dict)
     forbid: Tuple[str, ...] = tuple(name for name, _, _ in FORBIDDEN_PATTERNS)
     description: str = ""
+    single_fusion: bool = False
 
 
 _CONTRACTS: Dict[str, ProgramContract] = {}
@@ -167,6 +174,7 @@ def register_contract(
     collectives: Optional[Dict[str, Tuple[int, Optional[int]]]] = None,
     forbid: Optional[Tuple[str, ...]] = None,
     description: str = "",
+    single_fusion: bool = False,
 ) -> ProgramContract:
     """Declare (or re-declare, idempotently) a program family's contract.
     Called next to the program builders so the budget lives with the code it
@@ -176,6 +184,7 @@ def register_contract(
         collectives=dict(collectives or {}),
         forbid=tuple(forbid) if forbid is not None else tuple(n for n, _, _ in FORBIDDEN_PATTERNS),
         description=description,
+        single_fusion=bool(single_fusion),
     )
     with _CONTRACTS_LOCK:
         _CONTRACTS[family] = c
@@ -223,6 +232,23 @@ def verify_hlo(family: str, hlo_text: str, program: str = "") -> List[Finding]:
                         f"{budget} (all counts: {got})"
                     ),
                     detail={"family": family, "op": op, "count": n},
+                )
+            )
+    if contract.single_fusion:
+        n_mod = len(re.findall(r"^HloModule\b", hlo_text, flags=re.MULTILINE))
+        n_entry = len(re.findall(r"^ENTRY\b", hlo_text, flags=re.MULTILINE))
+        if n_mod != 1 or n_entry != 1:
+            findings.append(
+                Finding(
+                    rule="single-fusion",
+                    path=f"hlo:{label}",
+                    line=0,
+                    message=(
+                        f"{family}: whole-plan-fusion contract expects ONE "
+                        f"executable (1 HloModule / 1 ENTRY), compiled text "
+                        f"has {n_mod} module(s) / {n_entry} entry computation(s)"
+                    ),
+                    detail={"family": family, "modules": n_mod, "entries": n_entry},
                 )
             )
     active = {name for name in contract.forbid}

@@ -26,6 +26,7 @@ _RULE_MODULES = (
     "io_error_swallow",
     "process_local_state",
     "trace_context_drop",
+    "donated_buffer_reuse",
 )
 
 

@@ -58,13 +58,19 @@ def _is_jit_decorator(dec: ast.AST) -> bool:
 
 def _jitted_by_name(tree: ast.Module) -> Set[str]:
     """Function names passed positionally into any ``*jit*``-named wrapper
-    (``jax.jit(fn)``, ``_cached_predicate_jit(key, fn)``, …)."""
+    (``jax.jit(fn)``, ``_cached_predicate_jit(key, fn)``, …) or into any
+    call carrying a ``donate_argnums`` keyword — the stage compiler
+    (``compile_stage(skeleton, fn, donate_argnums=...)``) jits exactly like
+    ``jax.jit`` does."""
     names: Set[str] = set()
     for node in ast.walk(tree):
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
-        if callee is None or "jit" not in callee.rsplit(".", 1)[-1]:
+        donating = any(kw.arg == "donate_argnums" for kw in node.keywords)
+        if not donating and (
+            callee is None or "jit" not in callee.rsplit(".", 1)[-1]
+        ):
             continue
         for arg in node.args:
             if isinstance(arg, ast.Name):

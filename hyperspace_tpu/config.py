@@ -87,6 +87,13 @@ class keys:
     EXEC_TOPK_ENABLED = "hyperspace.exec.topk.enabled"
     EXEC_TOPK_MAX_K = "hyperspace.exec.topk.maxK"
     EXEC_TOPK_THRESHOLD_PUSHDOWN = "hyperspace.exec.topk.thresholdPushdown"
+    # Whole-plan fusion (exec/stage_ir.py): compile a chunk's
+    # filter→project→fold chain into ONE jitted stage program per
+    # (pipeline skeleton, shape bucket, mesh fingerprint), and donate the
+    # streamed fold state so it updates in place instead of reallocating
+    # every chunk.
+    EXEC_FUSION_ENABLED = "hyperspace.exec.fusion.enabled"
+    EXEC_FUSION_DONATION = "hyperspace.exec.fusion.donation"
     # Query-serving runtime (hyperspace_tpu/serving/): concurrent request
     # admission, compiled-plan caching, micro-batching, bucket prefetch.
     SERVING_QUEUE_DEPTH = "hyperspace.serving.queueDepth"
@@ -367,6 +374,17 @@ DEFAULTS: Dict[str, Any] = {
     # min/max pruning as a dynamic filter (only row groups that provably
     # cannot beat the current k-th candidate are skipped).
     keys.EXEC_TOPK_THRESHOLD_PUSHDOWN: True,
+    # Whole-plan fusion: fold each streamed chunk with ONE jitted program
+    # (chunk select + state merge in a single XLA executable) instead of the
+    # per-family chunk-then-merge dispatch pair. Default off this release:
+    # the per-family path stays the reference; flip on after soak. Results
+    # are byte-identical either way (proved by the fusion test tier).
+    keys.EXEC_FUSION_ENABLED: False,
+    # With fusion on, pass the device-resident fold state via
+    # `donate_argnums` so XLA reuses its buffers for the outputs (in-place
+    # update, no per-chunk HBM realloc). Only consulted when fusion is
+    # enabled; off = same fused program without donation.
+    keys.EXEC_FUSION_DONATION: True,
     # Serving runtime. Queue depth bounds memory under overload: submits
     # beyond it are REJECTED (AdmissionRejected), never silently queued.
     keys.SERVING_QUEUE_DEPTH: 64,
@@ -832,6 +850,14 @@ class HyperspaceConf:
     @property
     def topk_threshold_pushdown(self) -> bool:
         return bool(self.get(keys.EXEC_TOPK_THRESHOLD_PUSHDOWN))
+
+    @property
+    def fusion_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_FUSION_ENABLED))
+
+    @property
+    def fusion_donation(self) -> bool:
+        return bool(self.get(keys.EXEC_FUSION_DONATION))
 
     # Serving runtime --------------------------------------------------------
     @property

@@ -702,6 +702,22 @@ _hlo_lint.register_contract(
     collectives={},
     description="bucketed sort-merge join span search: the shuffle-freedom claim itself",
 )
+_hlo_lint.register_contract(
+    "fused-stage-agg",
+    collectives={"all-gather": _ANY, "all-reduce": _ANY},
+    description="whole-stage filter+group+state-merge with donated fold state: one executable per chunk",
+    single_fusion=True,
+)
+_hlo_lint.register_contract(
+    "fused-stage-agg-sharded",
+    collectives={"all-gather": (1, None), "all-reduce": _ANY},
+    description="shard_map whole-stage grouped fold: gathers per-shard partial TABLES (>=1), one executable",
+    single_fusion=True,
+)
+
+# whole-plan fusion helpers (stage compiler, dispatch counter, HBM gauge);
+# stage_ir imports device only lazily inside functions, so this is acyclic
+from hyperspace_tpu.exec import stage_ir as _stage_ir
 
 
 def _dry_codecs(batch: B.Batch, refs) -> Dict[str, ColumnCodec]:
@@ -788,6 +804,7 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None, 
     _hlo_lint.maybe_verify(session.conf, "fused-filter", key, jitted, (dev_cols, lit_values))
     t0 = _ptime.perf_counter()
     mask = jitted(dev_cols, lit_values)
+    _stage_ir.count_dispatch("fused-filter")
     out = np.asarray(mask)[:n]
     _observe_program("fused-filter", first, t0)
     return out
@@ -971,6 +988,7 @@ def device_filtered_aggregate(
     _hlo_lint.maybe_verify(session.conf, "fused-agg", key, jitted, (dev_cols, lit_values, np.int64(n)))
     t0 = _ptime.perf_counter()
     outs, valids = jitted(dev_cols, lit_values, np.int64(n))
+    _stage_ir.count_dispatch("fused-agg")
     outs = [np.asarray(o) for o in outs]
     valids = [int(v) for v in valids]
     _observe_program("fused-agg", first, t0)
@@ -1245,6 +1263,59 @@ def _dev_pad(arr, target, fill):
     return jnp.concatenate([arr, jnp.full((target - n,), fill, arr.dtype)])
 
 
+def _fused_grouped_update_program(pred_fn, key_specs, slot_specs, cap):
+    """Whole-stage grouped fold (``hyperspace.exec.fusion.enabled``): the
+    chunk's filter+group+segment-reduce AND the merge into the running
+    partial as ONE program, so a streamed chunk costs a single dispatch and
+    the fold state can be donated (args 0-2) for in-place buffer reuse.
+
+    Overflow contract: the rank-compressed group counts are exact even above
+    ``cap``, so ``n_b > cap`` (chunk-local) or ``n_m > cap`` (merged) flags a
+    lost-groups hazard; every state output then selects the ORIGINAL state
+    via ``jnp.where`` — with donation the buffers were reused, but their
+    VALUES round-trip unchanged, and the host redoes the chunk per-family.
+    """
+    import jax.numpy as jnp
+
+    chunk = _grouped_chunk_program(pred_fn, key_specs, slot_specs, cap)
+
+    def program(state_keys, state_slots, state_fs, state_n, cols, lits, n_valid, row_base):
+        n_b, fs_b, key_b, slot_b = chunk(cols, lits, n_valid, row_base)
+        idx = jnp.arange(cap)
+        mask = jnp.concatenate([idx < state_n, idx < n_b])
+        kcat = tuple(jnp.concatenate([a, b]) for a, b in zip(state_keys, key_b))
+        scat = tuple(jnp.concatenate([a, b]) for a, b in zip(state_slots, slot_b))
+        fs_cat = jnp.concatenate([state_fs, fs_b])
+        n_m, fs_m, key_m, slot_m = _merge_concat_parts(
+            key_specs, slot_specs, cap, kcat, scat, fs_cat, mask
+        )
+        ok = (n_b <= cap) & (n_m <= cap)
+        n_out = jnp.where(ok, n_m, state_n)
+        fs_out = jnp.where(ok, fs_m, state_fs)
+        keys_out = tuple(jnp.where(ok, m, s) for m, s in zip(key_m, state_keys))
+        slots_out = tuple(jnp.where(ok, m, s) for m, s in zip(slot_m, state_slots))
+        return n_b, n_m, n_out, fs_out, keys_out, slots_out
+
+    return program
+
+
+def _fused_state_dtypes(key_specs, slot_specs):
+    """(key dtype per group key, slot dtype per state slot) of the fused fold
+    state — must match the chunk/merge program outputs EXACTLY or the
+    overflow ``jnp.where`` selects would promote and break donation
+    aliasing."""
+    import jax.numpy as jnp
+
+    key_dts = tuple(jnp.float64 if tag == "f" else jnp.int64 for _, tag in key_specs)
+    slot_dts = tuple(
+        jnp.int64
+        if (kind in ("cntm", "cnt") or (isint and kind in ("sum", "min", "max")))
+        else jnp.float64
+        for kind, _, isint in slot_specs
+    )
+    return key_dts, slot_dts
+
+
 class GroupedAggStream:
     """Streaming grouped aggregation with device-resident partials.
 
@@ -1404,6 +1475,19 @@ class GroupedAggStream:
         cap = group_capacity(max(self._cap_hint, 1), self.cap_floor)
         shapes = tuple(dev_cols[r].shape for r in sorted(dev_cols))
         sharded = self._parallel is not None
+        if _stage_ir.fusion_wanted(self.session.conf) and not any(
+            tag == "s" for tag, _, _ in keys_schema
+        ):
+            # whole-stage fold: chunk select + state merge in ONE dispatch,
+            # fold state donated. String group keys stay per-family (their
+            # chunk->global dictionary remap is a host step between the chunk
+            # and merge programs that fusion removes).
+            if self._update_fused(
+                mesh, sharded, dev_cols, lit_values, pred_fn, key_specs,
+                base_sk, n, shapes,
+            ):
+                return
+            trace.fallback("fusion", "grouped-overflow")
         while True:
             if sharded:
                 from hyperspace_tpu.parallel import collectives as _collectives
@@ -1432,6 +1516,7 @@ class GroupedAggStream:
                 n_g_dev, fs, key_out, slot_out = jitted(
                     dev_cols, lit_values, np.int64(n), np.int64(self._row_base)
                 )
+            _stage_ir.count_dispatch("sharded-grouped" if sharded else "grouped-agg-chunk")
             n_g = int(n_g_dev)
             _observe_program(
                 "sharded-grouped" if sharded else "grouped-agg-chunk", first, t0
@@ -1457,6 +1542,101 @@ class GroupedAggStream:
             self._partial = new
         else:
             self._merge(new)
+        _stage_ir.note_peak_bytes()
+
+    def _ensure_fused_state(self, key_specs, cap):
+        """The running partial as (keys, slots, fs, n) device arrays padded
+        to ``cap`` — zero-filled when the stream is fresh (``state_n == 0``
+        masks them out of the fused merge)."""
+        import jax.numpy as jnp
+
+        key_dts, slot_dts = _fused_state_dtypes(key_specs, self._slots)
+        p = self._partial
+        if p is None:
+            keys = tuple(jnp.zeros(cap, dtype=dt) for dt in key_dts)
+            slots = tuple(jnp.zeros(cap, dtype=dt) for dt in slot_dts)
+            fs = jnp.full(cap, _FS_SENTINEL, dtype=jnp.int64)
+            return keys, slots, fs, 0
+        if p["cap"] < cap:
+            p["fs"] = _dev_pad(p["fs"], cap, _FS_SENTINEL)
+            p["keys"] = [_dev_pad(k, cap, 0 if k.dtype != np.float64 else np.nan) for k in p["keys"]]
+            p["slots"] = [_dev_pad(s, cap, 0) for s in p["slots"]]
+            p["cap"] = cap
+        return tuple(p["keys"]), tuple(p["slots"]), p["fs"], int(p["n"])
+
+    def _update_fused(self, mesh, sharded, dev_cols, lit_values, pred_fn,
+                      key_specs, base_sk, n, shapes) -> bool:
+        """One-dispatch whole-stage fold of this chunk. Returns False on
+        capacity overflow — the state values round-tripped unchanged through
+        the (possibly donated) buffers and the caller redoes the chunk on the
+        per-family path."""
+        conf = self.session.conf
+        cap = group_capacity(max(self._cap_hint, 1), self.cap_floor)
+        if self._partial is not None:
+            cap = max(cap, self._partial["cap"])
+        state_keys, state_slots, state_fs, state_n = self._ensure_fused_state(
+            key_specs, cap
+        )
+        # donation stays off under shard_map: XLA cannot reliably alias the
+        # replicated fold state there, and an unhonored donation both warns
+        # and silently loses the in-place win
+        donate = _stage_ir.donation_wanted(conf) and not sharded
+        if sharded:
+            from hyperspace_tpu.parallel import collectives as _collectives
+
+            program = _collectives.sharded_fused_grouped_program(
+                mesh, mesh.axis_names[0], pred_fn, key_specs, self._slots, cap
+            )
+        else:
+            program = _fused_grouped_update_program(
+                pred_fn, key_specs, self._slots, cap
+            )
+        family = "fused-stage-agg-sharded" if sharded else "fused-stage-agg"
+        key = _program_key(
+            f"gaggfused[{cap}{'+d' if donate else ''}]:{base_sk}",
+            mesh, sharded=sharded,
+        )
+        jitted = _stage_ir.compile_stage(
+            key, program, donate_argnums=(0, 1, 2) if donate else ()
+        )
+        first = _note_compile(key, shapes + ((cap,),))
+        args = (
+            state_keys, state_slots, state_fs, np.int64(state_n),
+            dev_cols, lit_values, np.int64(n), np.int64(self._row_base),
+        )
+        _hlo_lint.maybe_verify(conf, family, key, jitted, args)
+        t0 = _ptime.perf_counter()
+        if sharded:
+            n_b_d, n_m_d, n_out_d, fs_out, keys_out, slots_out = (
+                self._parallel.timed_call("grouped-agg", jitted, *args)
+            )
+        else:
+            n_b_d, n_m_d, n_out_d, fs_out, keys_out, slots_out = jitted(*args)
+        _stage_ir.count_dispatch(family)
+        n_b, n_m = int(n_b_d), int(n_m_d)
+        _observe_program(family, first, t0)
+        # the donated state is consumed either way: rebind the partial to the
+        # returned (aliased) buffers, which carry the original values on
+        # overflow
+        self._partial = {
+            "cap": cap, "n": int(n_out_d), "fs": fs_out,
+            "keys": list(keys_out), "slots": list(slots_out),
+        }
+        _stage_ir.note_peak_bytes()
+        if n_b > cap or n_m > cap:
+            self._cap_hint = max(self._cap_hint, n_b, n_m)
+            if state_n == 0:
+                self._partial = None  # nothing folded yet; keep the redo cheap
+            return False
+        self._row_base += n
+        self._cap_hint = max(self._cap_hint, n_m)
+        if n_m > self.max_groups:
+            exc = GroupCapacityExceeded(
+                f"group cardinality {n_m} exceeds maxGroups {self.max_groups}"
+            )
+            exc.folded = True  # the chunk IS in the stored partial
+            raise exc
+        return True
 
     def _remap_string_key(self, name, dev_codes, codec: ColumnCodec, n_g: int, cap: int):
         """Chunk-local dictionary codes -> global int64 codes (host remap of
@@ -1518,6 +1698,7 @@ class GroupedAggStream:
                 tuple(a["slots"]), tuple(b["slots"]),
                 a["fs"], b["fs"], np.int64(a["n"]), np.int64(b["n"]),
             )
+            _stage_ir.count_dispatch("grouped-merge")
             n_g = int(n_g_dev)
         _observe_program("grouped-merge", first, t0)
         REGISTRY.counter(
@@ -2733,6 +2914,7 @@ def device_bucketed_join(session, plan: L.Join, _compat=None, _setup=None) -> B.
     )
     t0 = _ptime.perf_counter()
     lo, hi = spans(lmat_dev, rmat_dev)
+    _stage_ir.count_dispatch("bucketed-smj-span")
     _observe_program("bucketed-smj-span", first, t0)
 
     if plan.how == "inner" and session.conf.join_device_materialize:

@@ -1338,6 +1338,9 @@ class Executor:
         # materializes the whole scan to size its decision, which is exactly
         # what the out-of-core path exists to avoid
         if not with_file_names:
+            got = self._try_fused_join_aggregate(plan)
+            if got is not None:
+                return got
             got = self._try_streaming_aggregate(plan)
             if got is not None:
                 trace.record("agg", "streamed-partial")
@@ -1623,6 +1626,53 @@ class Executor:
             + (f"-repaired:{repaired}" if repaired else ""),
         )
         return {c: np.asarray(v)[out_idx] for c, v in total.items()}
+
+    def _try_fused_join_aggregate(self, plan: L.Aggregate) -> Optional[B.Batch]:
+        """Whole-plan fused q3 shape: Aggregate over (Filter over) an inner
+        broadcast Join compiles to ONE donated XLA program per chunk
+        (exec/stage_ir.stream_join_aggregate) instead of the per-family
+        probe/verify/postjoin/fold/merge dispatch chain. Returns None (caller
+        falls through to the per-family streaming and materialized paths)
+        unless ``hyperspace.exec.fusion.enabled`` is set and the shape fuses."""
+        conf = self.session.conf
+        try:
+            from hyperspace_tpu.exec import device as D
+            from hyperspace_tpu.exec import join_stream as JS
+            from hyperspace_tpu.exec import stage_ir
+        except ImportError:
+            return None
+        if not (
+            conf.device_execution_enabled
+            and conf.agg_device_grouped_enabled
+            and stage_ir.fusion_wanted(conf)
+        ):
+            return None
+        if not plan.keys:
+            return None
+        if any(fn not in _STREAMABLE_AGGS or fn.endswith("_distinct")
+               for _, fn, _ in plan.aggs):
+            return None
+        node = plan.child
+        post_filter = None
+        if isinstance(node, L.Filter) and isinstance(node.child, L.Join):
+            post_filter, node = node.condition, node.child
+        if not isinstance(node, L.Join):
+            return None
+        spec = JS.broadcast_spec(self.session, node)
+        if spec is None:
+            return None
+        try:
+            return stage_ir.stream_join_aggregate(
+                self, node, spec, post_filter, list(plan.keys), list(plan.aggs)
+            )
+        except D.DeviceUnsupported:
+            trace.fallback("fusion", "join-agg-unsupported")
+            return None
+        except Exception:
+            # same discipline as the per-family streamed aggregate: the fused
+            # path must never break a query the materialized path can answer
+            trace.record("agg", "stream-fallback")
+            return None
 
     def _try_streaming_aggregate(self, plan: L.Aggregate) -> Optional[B.Batch]:
         """Out-of-core aggregate: when the child is a scan chain over more

@@ -255,6 +255,9 @@ def build_hash_side(session, build_plan: L.LogicalPlan, build_cols: List[str],
     planes = tuple(_pad_plane(hash_input_uint32(batch[k]), np.uint32(0)) for k in bkeys)
     prog = _hash_build_program(len(bkeys))
     table, order = prog(planes, np.int64(n))
+    from hyperspace_tpu.exec import stage_ir as _stage_ir
+
+    _stage_ir.count_dispatch("hash-build")
     sig = (len(bkeys), planes[0].shape[0])
     _note_compile("hash-build", sig)
     _hlo_lint.maybe_verify(
@@ -320,6 +323,9 @@ def _probe_chunk(session, build: BuildSide, chunk: B.Batch,
     padded = tuple(_pad_plane(p, np.uint32(0)) for p in planes)
     prog = _hash_probe_program(len(planes))
     lo_d, hi_d = prog(build.table, np.int64(build.n), padded)
+    from hyperspace_tpu.exec import stage_ir as _stage_ir
+
+    _stage_ir.count_dispatch("hash-probe")
     sig = (len(planes), int(build.table.shape[0]), padded[0].shape[0])
     _note_compile("hash-probe", sig)
     _hlo_lint.maybe_verify(
@@ -489,6 +495,9 @@ def _device_postjoin_mask(session, condition, pbatch: B.Batch, build: BuildSide,
         _program_key(f"fused-postjoin/{hash(sig)}", session.mesh), jitted, args,
     )
     mask = jitted(*args)
+    from hyperspace_tpu.exec import stage_ir as _stage_ir
+
+    _stage_ir.count_dispatch("fused-postjoin")
     return np.asarray(mask)[:n]
 
 

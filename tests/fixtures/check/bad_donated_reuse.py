@@ -1,0 +1,20 @@
+"""Seeded donated-buffer-reuse violations: reads of donated buffers after
+the jitted call that deleted them."""
+
+import jax
+
+
+def compile_stage(skeleton, fn, *, donate_argnums=()):
+    return jax.jit(fn, donate_argnums=donate_argnums)
+
+
+def fold_reads_dead_state(state, chunk):
+    jitted = compile_stage("fuse[F>G]", lambda s, c: s + c, donate_argnums=(0,))
+    out = jitted(state, chunk)
+    return out + state.sum()  # VIOLATION: state's buffer was donated
+
+
+def direct_jit_form(state, x):
+    out = jax.jit(lambda s, v: s * v, donate_argnums=0)(state, x)
+    total = state.mean()  # VIOLATION: donated via the inline jit call
+    return out, total

@@ -1,0 +1,26 @@
+"""Clean donation idioms: rebind-before-reuse, non-donated args read
+freely, starred calls conservatively skipped."""
+
+import jax
+
+
+def compile_stage(skeleton, fn, *, donate_argnums=()):
+    return jax.jit(fn, donate_argnums=donate_argnums)
+
+
+def fold_rebinds_state(state, chunk):
+    jitted = compile_stage("fuse[F>G]", lambda s, c: s + c, donate_argnums=(0,))
+    state = jitted(state, chunk)  # rebound to the call's result: fine
+    return state.sum()
+
+
+def non_donated_arg_read_is_fine(state, chunk):
+    jitted = compile_stage("fuse[F>G]", lambda s, c: s + c, donate_argnums=(0,))
+    out = jitted(state, chunk)
+    return out + chunk.sum()  # chunk (argnum 1) was not donated
+
+
+def starred_call_is_skipped(args):
+    jitted = compile_stage("fuse[F>G]", lambda s, c: s + c, donate_argnums=(0,))
+    out = jitted(*args)
+    return out, args

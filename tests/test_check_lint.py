@@ -132,6 +132,34 @@ class TestRulePairs:
         # all pass.
         assert lint_one(fixture("fabric", "clean_trace_drop.py"), "trace-context-drop") == []
 
+    def test_donated_buffer_reuse_bad(self):
+        found = lint_one(fixture("bad_donated_reuse.py"), "donated-buffer-reuse")
+        assert len(found) == 2
+        messages = " | ".join(f.message for f in found)
+        assert "'state'" in messages
+        assert "donate_argnums" in messages
+
+    def test_donated_buffer_reuse_clean(self):
+        # rebinding to the call's result, reading a non-donated argnum, and
+        # starred calls (positions unknowable) all pass.
+        assert lint_one(fixture("clean_donated_reuse.py"), "donated-buffer-reuse") == []
+
+    def test_donation_compiler_counts_as_jit_for_purity(self):
+        # compile_stage(skeleton, fn, donate_argnums=...) jits fn — a host
+        # numpy call inside fn must fire jit-purity just like jax.jit(fn)
+        import ast as _ast
+
+        from hyperspace_tpu.check.rules.jit_purity import scan_tree
+
+        src = (
+            "def fold(s, c):\n"
+            "    import numpy as np\n"
+            "    return np.add(s, c)\n"
+            "jitted = compile_stage('fuse[F>G]', fold, donate_argnums=(0,))\n"
+        )
+        hits = scan_tree(_ast.parse(src))
+        assert hits and "np.add" in hits[0][1]
+
     def test_trace_context_drop_only_fires_under_fabric_or_serving(self):
         from hyperspace_tpu.check.rules.trace_context_drop import _in_scope
 
@@ -176,6 +204,7 @@ class TestRunLint:
             "io-error-swallow",
             "process-local-state",
             "trace-context-drop",
+            "donated-buffer-reuse",
         }
 
     def test_default_scope_excludes_tests(self):
