@@ -27,6 +27,7 @@ _RULE_MODULES = (
     "process_local_state",
     "trace_context_drop",
     "donated_buffer_reuse",
+    "native_fallback",
 )
 
 

@@ -71,6 +71,9 @@ class keys:
     # decode-pool width, chunk prefetch depth/budget, and row-group pruning.
     EXEC_IO_DECODE_THREADS = "hyperspace.exec.io.decodeThreads"
     EXEC_IO_ROWGROUP_PRUNING = "hyperspace.exec.io.rowGroupPruning"
+    EXEC_IO_NATIVE_ENABLED = "hyperspace.exec.io.native.enabled"
+    EXEC_IO_NATIVE_ROWGROUP = "hyperspace.exec.io.native.rowGroupDecode"
+    EXEC_IO_NATIVE_MAX_DICT = "hyperspace.exec.io.native.maxDictEntries"
     EXEC_PIPELINE_ENABLED = "hyperspace.exec.pipeline.enabled"
     EXEC_PIPELINE_DEPTH = "hyperspace.exec.pipeline.depth"
     EXEC_PIPELINE_MAX_BUFFERED_BYTES = "hyperspace.exec.pipeline.maxBufferedBytes"
@@ -340,6 +343,16 @@ DEFAULTS: Dict[str, Any] = {
     # statistics so definitely-non-matching row groups are never decoded
     # (three-valued, conservative — pruning never changes results).
     keys.EXEC_IO_ROWGROUP_PRUNING: True,
+    # Native decode fast path (exec/io.py + native/hs_native.cc). `enabled`
+    # gates all native decode (row-group fast path AND the per-file
+    # native-first reader); `rowGroupDecode` gates just the parallel
+    # row-group fast path that decodes straight into device-ready padded
+    # buffers; `maxDictEntries` bounds the dictionary size under which
+    # RLE_DICTIONARY string columns ship codes+dictionary to the device
+    # instead of expanded values (0 disables dictionary shipping).
+    keys.EXEC_IO_NATIVE_ENABLED: True,
+    keys.EXEC_IO_NATIVE_ROWGROUP: True,
+    keys.EXEC_IO_NATIVE_MAX_DICT: 4096,
     # Pipelined streamed scans (exec/pipeline.py): while the chain executes
     # over chunk k, up to `depth` later chunks decode on the pipeline pool
     # (and pre-stage their H2D transfer). depth=1 is classic double
@@ -814,6 +827,18 @@ class HyperspaceConf:
     @property
     def rowgroup_pruning_enabled(self) -> bool:
         return bool(self.get(keys.EXEC_IO_ROWGROUP_PRUNING))
+
+    @property
+    def io_native_enabled(self) -> bool:
+        return bool(self.get(keys.EXEC_IO_NATIVE_ENABLED))
+
+    @property
+    def io_native_rowgroup(self) -> bool:
+        return bool(self.get(keys.EXEC_IO_NATIVE_ROWGROUP))
+
+    @property
+    def io_native_max_dict_entries(self) -> int:
+        return int(self.get(keys.EXEC_IO_NATIVE_MAX_DICT))
 
     @property
     def pipeline_enabled(self) -> bool:

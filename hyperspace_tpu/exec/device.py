@@ -85,14 +85,17 @@ class ColumnCodec:
 
 
 def encode_column(arr: np.ndarray) -> Tuple[np.ndarray, ColumnCodec]:
+    # already-device-dtype columns pass through uncopied (asarray/view, not
+    # astype): the native decode fast path hands us prefix views of padded
+    # buffers, and a copy here would break the zero-copy staging handoff
     kind = arr.dtype.kind
     if kind in ("i", "u", "b"):
-        return arr.astype(np.int64), ColumnCodec("numeric")
+        return np.asarray(arr, dtype=np.int64), ColumnCodec("numeric")
     if kind == "f":
-        return arr.astype(np.float64), ColumnCodec("numeric")
+        return np.asarray(arr, dtype=np.float64), ColumnCodec("numeric")
     if kind == "M":
         unit = np.datetime_data(arr.dtype)[0]
-        return arr.view("int64").astype(np.int64), ColumnCodec("datetime", unit=unit)
+        return arr.view("int64"), ColumnCodec("datetime", unit=unit)
     if kind in ("U", "S", "O"):
         from hyperspace_tpu.ops.encode import factorize_strings
 
@@ -498,12 +501,34 @@ def bucket_rows(n: int, floor: int = _BUCKET_FLOOR) -> int:
 
 def _pad_to_bucket(arr: np.ndarray, m: int, fill) -> np.ndarray:
     """Pad axis 0 to the shape bucket for len(arr), rounded up to a multiple
-    of ``m`` (the device count) so sharding stays even."""
+    of ``m`` (the device count) so sharding stays even.
+
+    Zero-copy handoff: when ``arr`` is a prefix view of a buffer that is
+    *already* exactly this padded shape — the native decode fast path
+    (exec/io.py) allocates its per-column buffers that way — and the buffer's
+    tail holds ``fill``, the base buffer is adopted as-is; ``device_put``
+    then ships the very memory the C decoder wrote."""
     n = arr.shape[0]
     target = bucket_rows(n)
     target += (-target) % m
     if target == n:
         return arr
+    base = arr.base
+    if (
+        arr.ndim == 1
+        and isinstance(base, np.ndarray)
+        and base.ndim == 1
+        and base.shape[0] == target
+        and base.dtype.itemsize == arr.dtype.itemsize
+        and arr.__array_interface__["data"][0] == base.__array_interface__["data"][0]
+    ):
+        adopted = base if base.dtype == arr.dtype else base.view(arr.dtype)
+        # the fast path pre-fills the tail, but a coincidentally-shaped slice
+        # of someone else's buffer must not leak its tail garbage: verify
+        tail = adopted[n:]
+        ok = bool(np.isnan(tail).all()) if fill != fill else bool((tail == fill).all())
+        if ok:
+            return adopted
     pad = np.full((target - n,) + arr.shape[1:], fill, dtype=arr.dtype)
     return np.concatenate([arr, pad])
 
@@ -714,6 +739,12 @@ _hlo_lint.register_contract(
     description="shard_map whole-stage grouped fold: gathers per-shard partial TABLES (>=1), one executable",
     single_fusion=True,
 )
+_hlo_lint.register_contract(
+    "dict-expand",
+    collectives={},
+    description="on-device dictionary expansion: codes gather a replicated remap table, shuffle-free",
+    single_fusion=True,
+)
 
 # whole-plan fusion helpers (stage compiler, dispatch counter, HBM gauge);
 # stage_ir imports device only lazily inside functions, so this is acyclic
@@ -735,6 +766,57 @@ def _dry_codecs(batch: B.Batch, refs) -> Dict[str, ColumnCodec]:
         else:
             raise DeviceUnsupported(f"unsupported column dtype {batch[r].dtype}")
     return out
+
+
+def _dict_expand_fn(codes, remap):
+    import jax.numpy as jnp
+
+    return jnp.where(codes >= 0, remap[jnp.maximum(codes, 0)], jnp.int32(-1))
+
+
+def _put_encoded(session, mesh, sharding, n_dev, arr):
+    """Encode + bucket-pad + ``device_put`` one column; returns
+    (device array, codec, staged bytes).
+
+    Dict-backed string columns (B.DictBackedArray, produced by the native
+    decode fast path) skip host factorization entirely: the int32 codes ship
+    as-is — bytes×rows becomes 4×rows over PCIe — plus a small replicated
+    code→sorted-rank remap table, and the fused collective-free "dict-expand"
+    gather rewrites codes into sorted-dictionary space on device. The result
+    (array + ColumnCodec) is identical to the factorize_strings path, so
+    _literal_bounds' searchsorted contract holds."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    codes = getattr(arr, "hs_dict_codes", None)
+    uniques = getattr(arr, "hs_dict_uniques", None)
+    if codes is not None and uniques is not None and codes.shape[0] == arr.shape[0]:
+        order = np.argsort(uniques)
+        su = uniques[order]
+        k = int(order.shape[0])
+        rank = np.empty(k, dtype=np.int32)
+        rank[order] = np.arange(k, dtype=np.int32)
+        cap = 1
+        while cap < max(k, 1):
+            cap *= 2  # power-of-two remap shapes cap distinct XLA signatures
+        remap = np.zeros(cap, dtype=np.int32)
+        remap[:k] = rank
+        padded = _pad_to_bucket(codes, n_dev, 0)
+        dev_codes = jax.device_put(padded, sharding)
+        dev_remap = jax.device_put(remap, NamedSharding(mesh, P()))
+        key = _program_key("dict-expand", mesh)
+        jitted = _cached_predicate_jit(key, _dict_expand_fn)
+        first = _note_compile(key, (padded.shape, remap.shape))
+        _hlo_lint.maybe_verify(session.conf, "dict-expand", key, jitted, (dev_codes, dev_remap))
+        t0 = _ptime.perf_counter()
+        dev = jitted(dev_codes, dev_remap)
+        _stage_ir.count_dispatch("dict-expand")
+        _observe_program("dict-expand", first, t0)
+        return dev, ColumnCodec("string", uniques=su), int(padded.nbytes + remap.nbytes)
+    enc, codec = encode_column(arr)
+    padded = _pad_to_bucket(enc, n_dev, 0 if enc.dtype != np.float64 else np.nan)
+    dev = jax.device_put(padded, sharding)
+    return dev, codec, int(padded.nbytes)
 
 
 def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None, parallel=None) -> np.ndarray:
@@ -783,13 +865,11 @@ def device_filter_mask(session, batch: B.Batch, condition: Expr, scan_key=None, 
         compile_predicate(condition, _dry_codecs(batch, refs))
 
         for r in missing:
-            arr, codec = encode_column(batch[r])
-            padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
-            dev = jax.device_put(padded, sharding)
+            dev, codec, nbytes = _put_encoded(session, mesh, sharding, n_dev, batch[r])
             dev_cols[r] = dev
             codecs[r] = codec
             if scan_key is not None:
-                _device_cache_put((scan_key, r, fp), (dev, codec, n), int(padded.nbytes))
+                _device_cache_put((scan_key, r, fp), (dev, codec, n), nbytes)
 
     fn, lit_values = compile_predicate(condition, codecs)
     skeleton = predicate_skeleton(condition, codecs)
@@ -851,10 +931,8 @@ def stage_filter_columns(session, batch: B.Batch, condition: Optional[Expr], sca
                 cached = _device_cache_get(ckey)
                 if cached is not None and cached[2] == n:
                     continue
-                arr, codec = encode_column(batch[r])
-                padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
-                dev = jax.device_put(padded, sharding)
-                _device_cache_put(ckey, (dev, codec, n), int(padded.nbytes))
+                dev, codec, nbytes = _put_encoded(session, mesh, sharding, n_dev, batch[r])
+                _device_cache_put(ckey, (dev, codec, n), nbytes)
     except DeviceUnsupported:
         return  # the consumer's host fallback will handle this chunk
 
@@ -1444,15 +1522,15 @@ class GroupedAggStream:
             if cached is not None and cached[2] == n:
                 dev_cols[col], codecs[col] = cached[0], cached[1]
                 continue
-            arr, codec = encode_column(batch[col])
-            if codec.kind == "string" and col in agg_inputs:
+            if col in agg_inputs and batch[col].dtype.kind in ("U", "S", "O"):
                 raise DeviceUnsupported("string aggregate inputs stay host-side")
-            padded = _pad_to_bucket(arr, n_dev, 0 if arr.dtype != np.float64 else np.nan)
-            dev = jax.device_put(padded, sharding)
+            dev, codec, nbytes = _put_encoded(
+                self.session, mesh, sharding, n_dev, batch[col]
+            )
             dev_cols[col] = dev
             codecs[col] = codec
             if ckey is not None:
-                _device_cache_put(ckey, (dev, codec, n), int(padded.nbytes))
+                _device_cache_put(ckey, (dev, codec, n), nbytes)
         for col in agg_inputs:
             if codecs[col].kind == "string":
                 raise DeviceUnsupported("string aggregate inputs stay host-side")

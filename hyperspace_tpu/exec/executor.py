@@ -637,6 +637,18 @@ class Executor:
     def __init__(self, session):
         self.session = session
 
+    def _prime_staging_pad(self) -> None:
+        """Materialize the session mesh before the first scan decode so the
+        native fast path pads its buffers to the device count up front
+        (session._note_mesh -> io.set_staging_pad) — otherwise the first
+        query's chunks decode with pad=1 and lose the zero-copy device_put
+        handoff. A mesh failure must never kill a host-path query."""
+        if self.session.conf.io_native_enabled:
+            try:
+                self.session.mesh
+            except Exception:
+                pass
+
     def execute(
         self,
         plan: L.LogicalPlan,
@@ -644,6 +656,8 @@ class Executor:
         prepruned: bool = False,
     ) -> B.Batch:
         from hyperspace_tpu.plan.expr import subquery_scope
+
+        self._prime_staging_pad()
 
         # execution-time column pruning for EVERY plan (Catalyst runs
         # ColumnPruning unconditionally; ApplyHyperspace only prunes plans
@@ -699,6 +713,7 @@ class Executor:
         from hyperspace_tpu.plan.expr import subquery_scope
         from hyperspace_tpu.rules.utils import prune_columns, shared_subplan_ids
 
+        self._prime_staging_pad()
         try:
             plan = prune_columns(plan)
         except Exception:

@@ -334,6 +334,457 @@ def _read_row_groups(
     return got
 
 
+# ---------------------------------------------------------------------------
+# Native row-group fast path: one scan decodes every surviving (file × row
+# group × column) chunk in parallel on the hs-decode pool, each chunk writing
+# straight into its slot of ONE √2-shape-bucket-padded buffer per column.
+# Assembly is concat-free — the batch's column arrays are prefix views of the
+# padded buffers, and the H2D staging hook (exec/device.py) detects the padded
+# base and hands jax.device_put the exact memory the C decoder wrote.
+# ---------------------------------------------------------------------------
+
+_NATIVE_ENABLED = True  # hyperspace.exec.io.native.enabled
+_NATIVE_RG = True  # hyperspace.exec.io.native.rowGroupDecode
+_MAX_DICT = 4096  # hyperspace.exec.io.native.maxDictEntries
+_STAGING_PAD = 1  # device-count multiple for padded buffers (set lazily)
+
+
+def set_native_options(
+    enabled: Optional[bool] = None,
+    rowgroup: Optional[bool] = None,
+    max_dict_entries: Optional[int] = None,
+) -> None:
+    """Record the conf-requested native decode knobs (called on Session
+    construction, most-recent-wins — same contract as set_decode_threads)."""
+    global _NATIVE_ENABLED, _NATIVE_RG, _MAX_DICT
+    if enabled is not None:
+        _NATIVE_ENABLED = bool(enabled)
+    if rowgroup is not None:
+        _NATIVE_RG = bool(rowgroup)
+    if max_dict_entries is not None:
+        _MAX_DICT = int(max_dict_entries)
+
+
+def set_staging_pad(m: int) -> None:
+    """Device-count multiple the staging padder rounds to; wired when a
+    session materializes its mesh. A stale value only costs the zero-copy
+    handoff (device._pad_to_bucket falls back to a pad copy), never rows."""
+    global _STAGING_PAD
+    _STAGING_PAD = max(1, int(m))
+
+
+def _native_decode_counter(codec: str):
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    return REGISTRY.counter(
+        "hs_native_decode_total",
+        "Column chunks decoded by the native row-group fast path",
+        codec=codec,
+    )
+
+
+def _native_bytes_counter():
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    return REGISTRY.counter(
+        "hs_native_decode_bytes_total",
+        "Logical bytes written into decode buffers by the native fast path",
+    )
+
+
+def _native_fallback_counter(reason: str):
+    from hyperspace_tpu.obs.metrics import REGISTRY
+
+    return REGISTRY.counter(
+        "hs_native_fallback_total",
+        "Decode attempts that left the native path for pyarrow",
+        reason=reason,
+    )
+
+
+def _padded_rows(n: int) -> int:
+    """Rows to allocate for ``n`` decoded rows: the √2 shape bucket the device
+    padder would pick, rounded up to the mesh's device-count multiple — so the
+    staged array IS the decode buffer, no pad copy."""
+    if n <= 0:
+        return 0
+    from hyperspace_tpu.exec.device import bucket_rows
+
+    t = bucket_rows(n)
+    m = max(1, _STAGING_PAD)
+    return t + (-t) % m
+
+
+def _native_rg_scan(
+    files: List[str],
+    columns: Optional[List[str]],
+    schemas: List[pa.Schema],
+    predicate,
+    concat_key,
+) -> Optional[B.Batch]:
+    """Decode a whole scan natively at row-group granularity; None when the
+    scan can't be answered natively end to end (caller falls back to the
+    per-file path, which keeps its own native-first discipline).
+
+    Requirements checked here: every file opens in the native dialect, every
+    requested column decodes to one consistent dtype, and nothing about the
+    scan is already cached. Row-group pruning applies per file with the same
+    counter accounting as _read_row_groups; a pruned scan skips all cache
+    writes (a pruned batch under an unpruned key would poison later readers).
+    """
+    from hyperspace_tpu import native
+
+    env = os.environ.get("HS_NATIVE_RG")
+    if env is not None and env.strip().lower() in ("0", "false", "off"):
+        return None
+    if not (_NATIVE_ENABLED and _NATIVE_RG) or not files:
+        return None
+    cols = list(columns) if columns is not None else list(schemas[0].names)
+    if not cols:
+        return None
+    hints = _dtype_hints(schemas[0], cols)
+    if hints is None:
+        return None  # per-file path counts the dtype fallback
+    # one shared buffer per column needs ONE dtype: identical arrow types
+    # across files (same-name/new-type evolution goes through the per-file path)
+    t0 = {c: schemas[0].field(c).type for c in cols}
+    for s in schemas[1:]:
+        if any(not s.field(c).type.equals(t0[c]) for c in cols):
+            return None
+
+    handles: List[native.NativeParquetFile] = []
+    try:
+        try:
+            for f in files:
+                handles.append(native.NativeParquetFile(f))
+        except native.NativeUnsupported:
+            return None  # per-file path retries native and counts the fallback
+        except OSError as exc:
+            rerr.count_io_error("io.decode", exc, swallowed=True)
+            _native_fallback_counter("io-error").inc()
+            return None
+        return _native_rg_decode(files, cols, columns, hints, predicate, concat_key, handles)
+    finally:
+        for h in handles:
+            h.close()
+
+
+def _native_rg_decode(
+    files: List[str],
+    cols: List[str],
+    columns: Optional[List[str]],
+    hints: Dict[str, np.dtype],
+    predicate,
+    concat_key,
+    handles,
+) -> Optional[B.Batch]:
+    from hyperspace_tpu import native
+
+    # -- per-column plan: buffer dtype (None = strings -> object array) ------
+    col_dtype: Dict[str, Optional[np.dtype]] = {}
+    col_scratch32 = set()  # date32: int32 chunk scratch astype'd into datetime64[D]
+    col_opt: Dict[str, bool] = {}
+    try:
+        for c in cols:
+            nd = handles[0].column_numpy_dtype(c)
+            hint = hints.get(c)
+            if nd is None:
+                dt = None
+            elif hint is not None and nd.kind in ("i", "u"):
+                if hint.itemsize == nd.itemsize:
+                    dt = hint  # timestamps/date64: decode int64 straight into the view
+                elif hint.kind == "M":
+                    dt = hint
+                    col_scratch32.add(c)
+                else:
+                    dt = nd
+            else:
+                dt = nd
+            col_dtype[c] = dt
+            col_opt[c] = any(h.column_optional(c) for h in handles)
+    except native.NativeUnsupported:
+        return None  # per-file path retries native and counts the fallback
+
+    # -- per-file row plan + pruning (same counters as _read_row_groups) -----
+    per_file_keep: List[List[int]] = []
+    file_rows: List[int] = []
+    file_skip: List[Optional[tuple]] = []  # (groups skipped, bytes skipped)
+    fully_pruned: List[bool] = []
+    pruned_any = False
+    try:
+        for f, h in zip(files, handles):
+            keep = prune_row_groups(f, predicate) if predicate is not None else None
+            if keep is None:
+                ks = list(range(h.num_row_groups))
+                file_skip.append(None)
+            else:
+                pruned_any = True
+                ks = keep
+                kept = set(ks)
+                md = pq.read_metadata(f)
+                sk_bytes = sum(
+                    md.row_group(i).total_byte_size
+                    for i in range(h.num_row_groups)
+                    if i not in kept
+                )
+                scanned_c, skipped_c, bytes_c = _rg_counters()
+                scanned_c.inc(len(ks))
+                skipped_c.inc(h.num_row_groups - len(ks))
+                bytes_c.inc(sk_bytes)
+                file_skip.append((h.num_row_groups - len(ks), int(sk_bytes)))
+            per_file_keep.append(ks)
+            fully_pruned.append(keep is not None and not ks)
+            file_rows.append(sum(h.rg_rows[g] for g in ks))
+    except (OSError, pa.ArrowInvalid) as exc:
+        rerr.count_io_error("io.footer", exc, swallowed=True)
+        _native_fallback_counter("io-error").inc()
+        return None
+
+    total = sum(file_rows)
+    starts: List[int] = []
+    acc = 0
+    for r in file_rows:
+        starts.append(acc)
+        acc += r
+    padded = _padded_rows(total)
+
+    # -- shared decode buffers, tail pre-filled like device._pad_to_bucket ---
+    buffers: Dict[str, np.ndarray] = {}
+    validity: Dict[str, np.ndarray] = {}
+    for c in cols:
+        dt = col_dtype[c]
+        if dt is None:
+            buffers[c] = np.empty(total, dtype=object)
+        else:
+            buf = np.empty(padded, dtype=dt)
+            if padded > total:
+                if dt == np.float64:
+                    buf[total:] = np.nan
+                elif dt.kind == "M":
+                    buf.view(np.int64)[total:] = 0
+                else:
+                    buf[total:] = 0
+            buffers[c] = buf
+            if col_opt[c]:
+                validity[c] = np.ones(total, dtype=np.uint8)
+
+    # -- dictionary-shipping plan for low-cardinality string columns ---------
+    # every surviving chunk must be fully dictionary-encoded with a dictionary
+    # within maxDictEntries; chunk dictionaries remap into one global one so
+    # codes are consistent across files/row groups
+    chunks = [(fi, g) for fi in range(len(files)) for g in per_file_keep[fi]]
+    dict_plan: Dict[str, tuple] = {}  # c -> (codes buffer, remaps, global uniques)
+    if _MAX_DICT > 0:
+        for c in cols:
+            if col_dtype[c] is not None:
+                continue
+            try:
+                if not all(
+                    0 < handles[fi].rg_dict_count(g, c) <= _MAX_DICT for fi, g in chunks
+                ):
+                    continue
+                dicts = [handles[fi].read_dict_rg_arrow(g, c) for fi, g in chunks]
+            # not a pyarrow fallback: the column still decodes natively below,
+            # just with materialized strings instead of shipped codes
+            except native.NativeUnsupported:  # hscheck: disable=native-fallback
+                continue
+            # one global dictionary + per-chunk remaps from a single C++
+            # hash pass (arrow dictionary_encode over the decoder's raw
+            # buffers): a per-entry Python merge loop here once cost as much
+            # as the C decode itself, and only the global uniques ever
+            # materialize as Python strings
+            remaps: Dict[tuple, np.ndarray] = {}
+            if dicts:
+                ends = np.cumsum([len(d) for d in dicts])
+                enc = (
+                    pa.concat_arrays(dicts) if len(dicts) > 1 else dicts[0]
+                ).dictionary_encode()
+                gu = enc.dictionary.to_numpy(zero_copy_only=False)
+                inv = enc.indices.to_numpy().astype(np.int32, copy=False)
+                remaps = {
+                    key: inv[end - len(d) : end]
+                    for key, d, end in zip(chunks, dicts, ends)
+                }
+            else:
+                gu = np.empty(0, dtype=object)
+            cbuf = np.empty(padded, dtype=np.int32)
+            if padded > total:
+                cbuf[total:] = 0
+            dict_plan[c] = (cbuf, remaps, gu)
+
+    # -- parallel chunk decode ------------------------------------------------
+    bytes_c = _native_bytes_counter()
+
+    def _decode_chunk(fi: int, g: int, c: str, start: int, nrows: int) -> None:
+        h = handles[fi]
+        codec = h.rg_codec(g, c)
+        plan = dict_plan.get(c)
+        if plan is not None:
+            cbuf, remaps, _gu = plan
+            codes = h.read_codes_rg(g, c)
+            rm = remaps[(fi, g)]
+            cbuf[start : start + nrows] = np.where(
+                codes >= 0, rm[np.maximum(codes, 0)], np.int32(-1)
+            )
+            nb = nrows * 4
+        elif col_dtype[c] is None:
+            vals, v8, nb = h.read_binary_rg(g, c)
+            if v8 is not None and not v8.all():
+                vals[v8 == 0] = None
+            buffers[c][start : start + nrows] = vals
+        else:
+            dst = buffers[c][start : start + nrows]
+            v8 = validity[c][start : start + nrows] if c in validity else None
+            if c in col_scratch32:
+                scratch = np.empty(nrows, dtype=np.int32)
+                h.read_fixed_rg_into(g, c, scratch, v8)
+                dst[...] = scratch.astype(col_dtype[c])
+            else:
+                h.read_fixed_rg_into(g, c, dst, v8)
+            nb = nrows * col_dtype[c].itemsize
+        _native_decode_counter(codec).inc()
+        bytes_c.inc(int(nb))
+
+    # per-file scans (partition attach, file-name columns) call
+    # read_parquet_batch FROM a decode-pool worker; submitting chunk tasks
+    # back onto that same pool and blocking would deadlock once every worker
+    # is such a caller — decode inline on this thread instead (still
+    # zero-copy into the shared buffers, just serial for this one file)
+    inline = threading.current_thread().name.startswith("hs-decode")
+    pool = None if inline else _decode_pool()
+    errors: Dict[int, List[BaseException]] = {}
+    futs_by_file: List[list] = []
+    all_futs: list = []
+    try:
+        for fi, f in enumerate(files):
+            futs: list = []
+            futs_by_file.append(futs)
+            try:
+                if FAULTS.active:
+                    FAULTS.check("io.decode", f)  # the "before the C call" seam
+            except Exception as exc:
+                errors.setdefault(fi, []).append(exc)
+                continue
+            row = starts[fi]
+            for g in per_file_keep[fi]:
+                nrows = handles[fi].rg_rows[g]
+                for c in cols:
+                    if inline:
+                        try:
+                            _decode_chunk(fi, g, c, row, nrows)
+                        except Exception as exc:
+                            errors.setdefault(fi, []).append(exc)
+                    else:
+                        futs.append(
+                            pool.submit(_decode_chunk, fi, g, c, row, nrows)
+                        )
+                row += nrows
+            all_futs.extend(futs)
+        for fi, f in enumerate(files):
+            for fut in futs_by_file[fi]:
+                try:
+                    fut.result()
+                except Exception as exc:
+                    errors.setdefault(fi, []).append(exc)
+            if fi not in errors and FAULTS.active:
+                try:
+                    FAULTS.check("io.decode", f)  # the "after the C call" seam
+                except Exception as exc:
+                    errors.setdefault(fi, []).append(exc)
+    finally:
+        # handles close right after we return — nothing may still be decoding
+        if all_futs:
+            from concurrent.futures import wait as _futures_wait
+
+            _futures_wait(all_futs)
+
+    if errors:
+        # corrupt data surfaces typed and strikes quarantine — falling back
+        # would re-read the same bad bytes (mirrors read_one's discipline)
+        for fi, es in errors.items():
+            for e in es:
+                err = (
+                    e
+                    if isinstance(e, rerr.ReliabilityError)
+                    else rerr.classify(e, path=files[fi])
+                    if isinstance(e, (OSError, pa.ArrowInvalid, pa.ArrowTypeError))
+                    else None
+                )
+                if isinstance(err, rerr.CorruptDataError):
+                    rerr.count_io_error("io.decode", err)
+                    if QUARANTINE.enabled:
+                        QUARANTINE.note_corrupt(files[fi])
+                    raise err from e
+        # transient/dialect failures: count, then the per-file path answers
+        # (with retry) — a consumed one-shot fault must not go unrecorded
+        for es in errors.values():
+            for e in es:
+                if isinstance(e, native.NativeUnsupported):
+                    _native_fallback_counter("dialect").inc()
+                else:
+                    rerr.count_io_error("io.decode", e, swallowed=True)
+                    _native_fallback_counter("io-error").inc()
+        return None
+
+    # -- assemble: prefix views of the padded buffers, pyarrow null parity ---
+    out: B.Batch = {}
+    for c in cols:
+        plan = dict_plan.get(c)
+        if plan is not None:
+            cbuf, _remaps, gu = plan
+            codes_v = cbuf[:total]
+            if gu.size:
+                nulls = codes_v < 0
+                if nulls.any():
+                    exp = gu[np.where(nulls, np.int32(0), codes_v)]
+                    exp[nulls] = None
+                else:
+                    exp = gu[codes_v]
+            else:
+                exp = np.full(total, None, dtype=object)
+            out[c] = B.dict_backed(np.asarray(exp, dtype=object), codes_v, gu)
+        elif col_dtype[c] is None:
+            out[c] = buffers[c]
+        else:
+            vals = buffers[c][:total]
+            v8 = validity.get(c)
+            if v8 is not None and not v8.all():
+                # parity with pyarrow's to_numpy (see native.read_columns)
+                if vals.dtype.kind == "f":
+                    vals = vals.copy()
+                    vals[v8 == 0] = np.nan
+                elif vals.dtype.kind == "M":
+                    vals = vals.copy()
+                    vals[v8 == 0] = np.datetime64("NaT")
+                elif vals.dtype.kind == "b":
+                    vals = vals.astype(object)
+                    vals[v8 == 0] = None
+                elif vals.dtype.kind in ("i", "u"):
+                    vals = vals.astype(np.float64)
+                    vals[v8 == 0] = np.nan
+            out[c] = vals
+
+    for fi, f in enumerate(files):
+        with spans.span("decode", cat="io", file=os.path.basename(f)) as dsp:
+            dsp.set(rows=file_rows[fi])
+            if file_skip[fi] is not None:
+                dsp.set(
+                    rowgroups_skipped=file_skip[fi][0],
+                    rowgroup_bytes_skipped=file_skip[fi][1],
+                )
+            trace.record("decode", "rowgroup-pruned" if fully_pruned[fi] else "native-rg")
+        if QUARANTINE.enabled:
+            QUARANTINE.note_ok(f)
+
+    if not pruned_any:
+        for fi, f in enumerate(files):
+            s, e = starts[fi], starts[fi] + file_rows[fi]
+            _io_cache_put(_io_cache_key(f, columns), {c: out[c][s:e] for c in cols})
+        if concat_key is not None:
+            _io_cache_put(concat_key, dict(out))
+    return out
+
+
 def read_parquet_batch(
     files: List[str], columns: Optional[List[str]], predicate=None
 ) -> B.Batch:
@@ -449,14 +900,35 @@ def read_parquet_batch(
     except OSError as exc:
         rerr.count_io_error("io.footer", exc, swallowed=True)
         return _dataset_read()
+    evolved: set = set()
+    unified: Optional[pa.Schema] = None
     if columns is None:
         names0 = list(schemas[0].names)
         if any(list(s.names) != names0 for s in schemas[1:]):
             return _dataset_read()
     else:
-        for s in schemas:
-            if any(c not in s.names for c in columns):
+        missing = [f for f, s in zip(files, schemas) if any(c not in s.names for c in columns)]
+        if missing:
+            # schema-evolved files decode per file against the unified schema
+            # (null-filling their missing columns) while native-dialect
+            # siblings keep the native path — the old all-or-nothing gate sent
+            # the WHOLE scan through one pyarrow dataset read
+            if len(missing) == len(files):
                 return _dataset_read()
+            try:
+                unified = pa.unify_schemas(schemas)
+            except (pa.ArrowInvalid, pa.ArrowTypeError) as exc:
+                rerr.count_io_error("io.footer", exc, swallowed=True)
+                return _dataset_read()
+            if any(c not in unified.names for c in columns):
+                return _dataset_read()  # nested projection paths etc.
+            evolved = set(missing)
+            _native_fallback_counter("schema-evolved").inc(len(missing))
+
+    if not evolved and not any(b is not None for b in cached):
+        got = _native_rg_scan(files, columns, schemas, predicate, concat_key)
+        if got is not None:
+            return got
 
     def read_one(f: str, schema) -> B.Batch:
         with spans.span("decode", cat="io", file=os.path.basename(f)) as dsp:
@@ -465,23 +937,39 @@ def read_parquet_batch(
             if got is not None:
                 trace.record("decode", "cached")
                 return got
-            if predicate is not None:
+            if predicate is not None and f not in evolved:
                 keep = prune_row_groups(f, predicate)
                 if keep is not None:
                     return _read_row_groups(f, columns, schema, keep, dsp)
             def _decode() -> B.Batch:
                 if FAULTS.active:
                     FAULTS.check("io.decode", f)
+                if f in evolved:
+                    # decode against the unified schema so this file's missing
+                    # columns null-fill with their siblings' types
+                    trace.record("decode", "pyarrow")
+                    t = pads.dataset([f], format="parquet", schema=unified).to_table(
+                        columns=columns
+                    )
+                    return B.table_to_batch(t)
                 try:
                     cols = list(columns) if columns is not None else list(schema.names)
-                    hints = _dtype_hints(schema, cols)
-                    out = native.read_columns(f, cols, hints) if hints is not None else None
+                    hints = _dtype_hints(schema, cols) if _NATIVE_ENABLED else None
+                    if hints is None:
+                        if _NATIVE_ENABLED:
+                            _native_fallback_counter("dtype").inc()
+                        out = None
+                    else:
+                        out = native.read_columns(f, cols, hints)
                 except (native.NativeUnsupported, OSError, KeyError) as e:
                     # dialect mismatches are the expected fallback path; real
                     # IO failures falling through to the pyarrow re-read are
                     # classified and counted, never silently ignored
-                    if not isinstance(e, native.NativeUnsupported):
+                    if isinstance(e, native.NativeUnsupported):
+                        _native_fallback_counter("dialect").inc()
+                    else:
                         rerr.count_io_error("io.decode", e, swallowed=True)
+                        _native_fallback_counter("io-error").inc()
                     if os.environ.get("HS_DEBUG_DECODE_FALLBACK"):
                         import sys
 

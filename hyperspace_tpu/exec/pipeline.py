@@ -3,15 +3,23 @@
 Stage model (the classic accelerator input pipeline):
 
   1. **host decode** — chunk k+1's parquet -> numpy materialization runs on
-     the pipeline pool (fanning per-file work onto the shared decode pool);
+     the pipeline pool. Native-dialect chunks take the row-group fast path
+     (exec/io.py ``_native_rg_scan``): every surviving (file × row group ×
+     column) chunk decodes in parallel on the shared decode pool, each C call
+     writing its slot of ONE √2-bucket-padded buffer per column — assembly is
+     concat-free. Everything else fans per-file work onto the decode pool as
+     before;
   2. **H2D staging** — an optional ``stage`` hook runs right after decode on
      the same worker, typically ``device.stage_filter_columns``: encode, pad
      to a shape bucket, and ``jax.device_put`` the chunk's filter columns so
-     the device cache is warm before the consumer asks. When the mesh-sharded
-     path is on (``hyperspace.parallel.enabled``) the hook places columns
-     with the executor mesh's ``NamedSharding`` and brands the cache entries
-     with its fingerprint, so the consumer's shard_map programs hit the same
-     staged columns;
+     the device cache is warm before the consumer asks. For fast-path chunks
+     the pad step adopts the decoder's own padded buffer (pointer-identical —
+     zero extra host copies), and dict-backed string columns ship int32 codes
+     + dictionary, expanding on device via the fused ``dict-expand`` program.
+     When the mesh-sharded path is on (``hyperspace.parallel.enabled``) the
+     hook places columns with the executor mesh's ``NamedSharding`` and
+     brands the cache entries with its fingerprint, so the consumer's
+     shard_map programs hit the same staged columns;
   3. **device compute** — the consumer thread executes chunk k's jitted
      program while stages 1–2 of chunk k+1 proceed concurrently.
 

@@ -75,6 +75,21 @@ def _build() -> None:
     res = subprocess.run(
         base + flags + ["-o", _SO_PATH], capture_output=True, text=True, cwd=_SRC_DIR
     )
+    if res.returncode != 0 and _missing(res.stderr, "zstd", "zstd.h"):
+        # the dev package (zstd.h + libzstd.so symlink) is absent but the
+        # runtime library often still is: declare ZSTD's stable ABI by hand
+        # (-DHS_ZSTD_COMPAT) and link the versioned soname before dropping
+        # the codec outright
+        compat = [f if f != "-lzstd" else "-l:libzstd.so.1" for f in flags]
+        res2 = subprocess.run(
+            base + ["-DHS_ZSTD_COMPAT"] + compat + ["-o", _SO_PATH],
+            capture_output=True,
+            text=True,
+            cwd=_SRC_DIR,
+        )
+        if res2.returncode == 0:
+            res = res2
+            flags = compat
     for lib, header, define in (("z", "zlib.h", "-DHS_NO_ZLIB"),
                                 ("zstd", "zstd.h", "-DHS_NO_ZSTD")):
         if res.returncode == 0:
@@ -167,6 +182,61 @@ def _wire_symbols(lib: ctypes.CDLL) -> None:
             ctypes.c_void_p,
             ctypes.c_void_p,
         ]
+        # row-group-granular ABI (parallel decode; errors via per-call buffer)
+        lib.hsn_num_row_groups.restype = ctypes.c_int32
+        lib.hsn_num_row_groups.argtypes = [ctypes.c_void_p]
+        lib.hsn_rg_num_rows.restype = ctypes.c_int64
+        lib.hsn_rg_num_rows.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.hsn_rg_codec.restype = ctypes.c_int32
+        lib.hsn_rg_codec.argtypes = [ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32]
+        lib.hsn_read_fixed_rg.restype = ctypes.c_int64
+        lib.hsn_read_fixed_rg.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
+        lib.hsn_read_binary_rg.restype = ctypes.c_int64
+        lib.hsn_read_binary_rg.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
+        lib.hsn_read_codes_rg.restype = ctypes.c_int64
+        lib.hsn_read_codes_rg.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
+        lib.hsn_rg_dict_count.restype = ctypes.c_int64
+        lib.hsn_rg_dict_count.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
+        lib.hsn_read_dict_binary_rg.restype = ctypes.c_int64
+        lib.hsn_read_dict_binary_rg.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.c_void_p,
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_int32,
+        ]
         lib.hsn_merge_spans.restype = None
         lib.hsn_merge_spans.argtypes = [
             ctypes.c_void_p,
@@ -226,6 +296,12 @@ _FIXED_DTYPES = {
     _T_DOUBLE: np.dtype(np.float64),
 }
 
+#: per-call error buffer size for the row-group ABI (the C side truncates)
+_ERR_CAP = 256
+
+#: parquet CompressionCodec ids the dialect decodes, as metric-label names
+CODEC_NAMES = {0: "uncompressed", 1: "snappy", 2: "gzip", 6: "zstd"}
+
 
 class NativeParquetFile:
     """One open parquet file. Use as a context manager."""
@@ -248,6 +324,12 @@ class NativeParquetFile:
         for i in range(lib.hsn_num_columns(self._h)):
             self.columns.append(lib.hsn_column_name(self._h, i).decode())
             self._types.append(lib.hsn_column_type(self._h, i))
+        self.num_row_groups = int(lib.hsn_num_row_groups(self._h))
+        #: rows per row group, in file order (row-group g starts at
+        #: sum(rg_rows[:g]) within the file)
+        self.rg_rows: List[int] = [
+            int(lib.hsn_rg_num_rows(self._h, g)) for g in range(self.num_row_groups)
+        ]
 
     def __enter__(self) -> "NativeParquetFile":
         return self
@@ -310,6 +392,165 @@ class NativeParquetFile:
             out = arr.to_numpy(zero_copy_only=False)
             return out, validity
         raise NativeUnsupported(f"unsupported physical type {t}")
+
+    # -- row-group-granular decode (parallel fan-out) -------------------------
+
+    def _col_index(self, name: str) -> int:
+        if name not in self.columns:
+            raise NativeUnsupported(f"column {name!r} not in file")
+        return self.columns.index(name)
+
+    def column_optional(self, name: str) -> bool:
+        return self._lib.hsn_column_optional(self._h, self._col_index(name)) == 1
+
+    def column_numpy_dtype(self, name: str) -> Optional[np.dtype]:
+        """Decoded numpy dtype for a column, or None for BYTE_ARRAY (strings
+        materialize as object arrays, which have no flat buffer to decode
+        into). Raises NativeUnsupported for physical types outside the dialect."""
+        t = self._types[self._col_index(name)]
+        if t in _FIXED_DTYPES:
+            return _FIXED_DTYPES[t]
+        if t == _T_BYTE_ARRAY:
+            return None
+        raise NativeUnsupported(f"unsupported physical type {t}")
+
+    def rg_codec(self, rg: int, name: str) -> str:
+        """Codec name of one chunk ("uncompressed"/"snappy"/"gzip"/"zstd"),
+        or "other" for ids outside the dialect."""
+        c = self._lib.hsn_rg_codec(self._h, rg, self._col_index(name))
+        return CODEC_NAMES.get(int(c), "other")
+
+    def read_fixed_rg_into(
+        self, rg: int, name: str, out: np.ndarray, validity: Optional[np.ndarray] = None
+    ) -> None:
+        """Decode one (row group × column) chunk into ``out`` — typically a
+        slice of a larger per-column buffer; the C side writes through the
+        slice's data pointer, so the caller controls the row offset and
+        parallel workers fill disjoint slots of one shared array."""
+        col = self._col_index(name)
+        t = self._types[col]
+        if t not in _FIXED_DTYPES:
+            raise NativeUnsupported(f"not a fixed-width column: {name!r}")
+        n = self.rg_rows[rg]
+        if out.shape[0] != n or out.dtype.itemsize != _FIXED_DTYPES[t].itemsize:
+            raise ValueError(
+                f"read_fixed_rg_into: buffer shape {out.shape}/{out.dtype} does "
+                f"not match row group ({n} rows of {_FIXED_DTYPES[t]})"
+            )
+        if not out.flags["C_CONTIGUOUS"] or not out.flags["WRITEABLE"]:
+            raise ValueError("read_fixed_rg_into: need a contiguous writable buffer")
+        vptr = validity.ctypes.data_as(ctypes.c_void_p) if validity is not None else None
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self._lib.hsn_read_fixed_rg(
+            self._h, rg, col, out.ctypes.data_as(ctypes.c_void_p), vptr, err, _ERR_CAP
+        )
+        if rc != n:
+            raise NativeUnsupported(err.value.decode() or "native row-group decode failed")
+
+    def read_binary_rg(
+        self, rg: int, name: str
+    ) -> Tuple[np.ndarray, Optional[np.ndarray], int]:
+        """Decode one BYTE_ARRAY chunk to (object array of str, validity,
+        utf8 payload bytes)."""
+        col = self._col_index(name)
+        if self._types[col] != _T_BYTE_ARRAY:
+            raise NativeUnsupported(f"not a BYTE_ARRAY column: {name!r}")
+        n = self.rg_rows[rg]
+        optional = self._lib.hsn_column_optional(self._h, col) == 1
+        validity = np.ones(n, dtype=np.uint8) if optional else None
+        vptr = validity.ctypes.data_as(ctypes.c_void_p) if validity is not None else None
+        offsets = np.empty(n + 1, dtype=np.int64)
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self._lib.hsn_read_binary_rg(
+            self._h, rg, col, offsets.ctypes.data_as(ctypes.c_void_p), None, vptr,
+            err, _ERR_CAP,
+        )
+        if rc != n:
+            raise NativeUnsupported(err.value.decode() or "native row-group decode failed")
+        data = np.empty(int(offsets[n]), dtype=np.uint8)
+        rc = self._lib.hsn_read_binary_rg(
+            self._h,
+            rg,
+            col,
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            data.ctypes.data_as(ctypes.c_void_p),
+            vptr,
+            err,
+            _ERR_CAP,
+        )
+        if rc != n:
+            raise NativeUnsupported(err.value.decode() or "native row-group decode failed")
+        import pyarrow as pa
+
+        arr = pa.Array.from_buffers(
+            pa.large_utf8(), n, [None, pa.py_buffer(offsets), pa.py_buffer(data)]
+        )
+        return arr.to_numpy(zero_copy_only=False), validity, int(offsets[n])
+
+    def read_codes_rg(self, rg: int, name: str) -> np.ndarray:
+        """Dictionary codes (int32; -1 = null) for a fully dictionary-encoded
+        chunk. Raises NativeUnsupported when any page fell back to PLAIN —
+        callers retry with value decode."""
+        col = self._col_index(name)
+        n = self.rg_rows[rg]
+        codes = np.empty(n, dtype=np.int32)
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self._lib.hsn_read_codes_rg(
+            self._h, rg, col, codes.ctypes.data_as(ctypes.c_void_p), err, _ERR_CAP
+        )
+        if rc != n:
+            raise NativeUnsupported(err.value.decode() or "native codes decode failed")
+        return codes
+
+    def rg_dict_count(self, rg: int, name: str) -> int:
+        """Dictionary entry count for a chunk (0 = no dictionary page)."""
+        col = self._col_index(name)
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        rc = self._lib.hsn_rg_dict_count(self._h, rg, col, err, _ERR_CAP)
+        if rc < 0:
+            raise NativeUnsupported(err.value.decode() or "native dict probe failed")
+        return int(rc)
+
+    def read_dict_rg(self, rg: int, name: str) -> np.ndarray:
+        """The BYTE_ARRAY dictionary payload of one chunk as an object array
+        of str (entry i is the value behind code i)."""
+        return self.read_dict_rg_arrow(rg, name).to_numpy(zero_copy_only=False)
+
+    def read_dict_rg_arrow(self, rg: int, name: str):
+        """The BYTE_ARRAY dictionary payload of one chunk as an arrow
+        large_utf8 Array over the decoder's buffers — no per-entry Python
+        string is materialized, so dictionary merges across many chunks stay
+        in C (callers concat + dictionary_encode arrow-side)."""
+        col = self._col_index(name)
+        if self._types[col] != _T_BYTE_ARRAY:
+            raise NativeUnsupported(f"not a BYTE_ARRAY column: {name!r}")
+        err = ctypes.create_string_buffer(_ERR_CAP)
+        count = self._lib.hsn_rg_dict_count(self._h, rg, col, err, _ERR_CAP)
+        if count < 0:
+            raise NativeUnsupported(err.value.decode() or "native dict probe failed")
+        offsets = np.empty(int(count) + 1, dtype=np.int64)
+        rc = self._lib.hsn_read_dict_binary_rg(
+            self._h, rg, col, offsets.ctypes.data_as(ctypes.c_void_p), None, err, _ERR_CAP
+        )
+        if rc != count:
+            raise NativeUnsupported(err.value.decode() or "native dict decode failed")
+        data = np.empty(int(offsets[count]), dtype=np.uint8)
+        rc = self._lib.hsn_read_dict_binary_rg(
+            self._h,
+            rg,
+            col,
+            offsets.ctypes.data_as(ctypes.c_void_p),
+            data.ctypes.data_as(ctypes.c_void_p),
+            err,
+            _ERR_CAP,
+        )
+        if rc != count:
+            raise NativeUnsupported(err.value.decode() or "native dict decode failed")
+        import pyarrow as pa
+
+        return pa.Array.from_buffers(
+            pa.large_utf8(), int(count), [None, pa.py_buffer(offsets), pa.py_buffer(data)]
+        )
 
 
 def read_columns(path: str, columns: List[str], dtype_hints: Optional[Dict[str, np.dtype]] = None) -> Dict[str, np.ndarray]:

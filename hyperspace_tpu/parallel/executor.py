@@ -39,6 +39,11 @@ class ShardedExecutor:
         self.mesh = mesh
         self.axis = mesh.axis_names[0]
         self.fingerprint = mesh_fingerprint(mesh)
+        # the sharded path stages with THIS mesh's device count: align the
+        # native decode fast path's buffer padding so staging stays zero-copy
+        from hyperspace_tpu.exec import io as _io
+
+        _io.set_staging_pad(int(mesh.devices.size))
         self.min_rows = conf.parallel_min_rows
         from hyperspace_tpu.obs.metrics import REGISTRY
 

@@ -9,7 +9,9 @@ match. Sites wired through the codebase:
 ``log.read``              operation-log entry read (models/log_manager.py)
 ``log.write``             operation-log entry write
 ``io.footer``             parquet footer/metadata/schema read (exec/io.py)
-``io.decode``             per-file parquet decode (exec/io.py read_one)
+``io.decode``             parquet decode — fires on the per-file path
+                          (exec/io.py read_one) AND before/after the native
+                          row-group fast path's C calls (_native_rg_scan)
 ``pipeline.task``         prefetch-pipeline chunk task (exec/pipeline.py)
 ``join.task``             streamed-join side decode task (exec/join_stream.py)
 ``device.transfer``       host→device staging (exec/device.py)

@@ -40,6 +40,11 @@ class Session:
         from hyperspace_tpu.exec import io as _io
 
         _io.set_decode_threads(self.conf.io_decode_threads)
+        _io.set_native_options(
+            enabled=self.conf.io_native_enabled,
+            rowgroup=self.conf.io_native_rowgroup,
+            max_dict_entries=self.conf.io_native_max_dict_entries,
+        )
         # check-layer runtime switches are process-global for the same
         # reason (compile sites without a session in scope consult them).
         # HLO verification: most recent session's conf wins, like decode
@@ -289,11 +294,22 @@ class Session:
 
             devices = np.array(jax.devices())
             self._mesh = Mesh(devices, (self.conf.mesh_axis,))
+            self._note_mesh(self._mesh)
         return self._mesh
 
     def set_mesh(self, mesh) -> "Session":
         self._mesh = mesh
+        self._note_mesh(mesh)
         return self
+
+    @staticmethod
+    def _note_mesh(mesh) -> None:
+        # tell the decode fast path the device-count multiple staged arrays
+        # pad to, so its buffers come out device-put-ready (exec/io.py); a
+        # stale value only costs the zero-copy handoff, never correctness
+        from hyperspace_tpu.exec import io as _io
+
+        _io.set_staging_pad(int(mesh.devices.size))
 
 
 _current: Optional[Session] = None

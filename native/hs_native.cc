@@ -22,7 +22,19 @@
 #ifndef HS_NO_ZLIB
 #include <zlib.h>
 #endif
-#ifndef HS_NO_ZSTD
+#if defined(HS_ZSTD_COMPAT)
+// Header-less build against a runtime libzstd.so.1 (dev package absent).
+// These four symbols are ZSTD's stable public ABI since 1.0 — declaring them
+// by hand keeps the codec alive on hosts that ship the library but not zstd.h.
+extern "C" {
+typedef struct ZSTD_DCtx_s ZSTD_DCtx;
+ZSTD_DCtx* ZSTD_createDCtx(void);
+size_t ZSTD_freeDCtx(ZSTD_DCtx* dctx);
+size_t ZSTD_decompressDCtx(ZSTD_DCtx* dctx, void* dst, size_t dst_capacity,
+                           const void* src, size_t src_size);
+unsigned ZSTD_isError(size_t code);
+}
+#elif !defined(HS_NO_ZSTD)
 #include <zstd.h>
 #endif
 #include <sys/mman.h>
@@ -31,6 +43,7 @@
 
 #include <algorithm>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <memory>
 #include <string>
@@ -325,7 +338,20 @@ static void decode_rle_hybrid(const uint8_t* p, const uint8_t* end, int bit_widt
       if (end - p < bytes) throw ThriftError("rle: truncated bit-packed run");
       int64_t take = std::min(vals, n - i);
       uint64_t bitpos = 0;
-      for (int64_t k = 0; k < take; k++) {
+      int64_t k = 0;
+      // bit_width <= 32 and bit offset <= 7, so one unaligned 8-byte load
+      // always covers a value; run the run body branch-free while a full
+      // load stays inside the run, then finish the tail byte-exactly
+      if (bytes >= 8) {
+        const int64_t fast = std::min(take, ((bytes - 8) * 8) / bit_width + 1);
+        for (; k < fast; k++) {
+          uint64_t word;
+          std::memcpy(&word, p + (bitpos >> 3), 8);
+          out[i + k] = static_cast<int32_t>((word >> (bitpos & 7)) & mask);
+          bitpos += bit_width;
+        }
+      }
+      for (; k < take; k++) {
         uint64_t byte_idx = bitpos >> 3;
         int bit_off = static_cast<int>(bitpos & 7);
         uint64_t word = 0;
@@ -705,6 +731,316 @@ static int physical_width(int32_t t, int32_t type_length) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// per-chunk decoders (one row group × one column). These are the shared
+// bodies behind both the whole-file readers and the row-group-granular ABI:
+// they touch only caller-provided buffers and throw on malformed input, so
+// concurrent calls on one read-only Handle are thread-safe.
+// ---------------------------------------------------------------------------
+
+// Fixed-width chunk into `dst` (chunk-local row 0 at dst[0]). Returns rows.
+static int64_t decode_fixed_chunk(const Handle* h, const SchemaElement& se,
+                                  const ColumnMeta& cm, int width, uint8_t* dst,
+                                  uint8_t* validity) {
+  if (!codec_supported(cm.codec))
+    throw ThriftError("unsupported codec " + std::to_string(cm.codec));
+  ChunkCursor cur(h, &cm, se.repetition == 1);
+  PageData pd;
+  std::vector<int32_t> idx;
+  int64_t row = 0;
+  while (next_data_page(cur, pd)) {
+    const int64_t n = pd.num_values;
+    int64_t present = n;
+    if (!pd.defs.empty()) {
+      present = 0;
+      for (int32_t d : pd.defs) present += (d != 0);
+    }
+    if (pd.encoding == E_PLAIN) {
+      if (se.type == T_BOOLEAN) {
+        // bit-packed LSB-first
+        std::vector<uint8_t> vals(present);
+        if (pd.values_len * 8 < static_cast<size_t>(present))
+          throw ThriftError("truncated boolean page");
+        for (int64_t k = 0; k < present; k++)
+          vals[k] = (pd.values[k >> 3] >> (k & 7)) & 1;
+        if (pd.defs.empty()) {
+          std::memcpy(dst + row * width, vals.data(), present);
+          if (validity) std::memset(validity + row, 1, n);
+        } else {
+          int64_t vi = 0;
+          for (int64_t k = 0; k < n; k++) {
+            bool v = pd.defs[k] != 0;
+            dst[(row + k)] = v ? vals[vi++] : 0;
+            if (validity) validity[row + k] = v;
+          }
+        }
+        row += n;
+        continue;
+      }
+      if (pd.values_len < static_cast<size_t>(present) * width)
+        throw ThriftError("truncated PLAIN page");
+      if (pd.defs.empty()) {
+        std::memcpy(dst + row * width, pd.values, static_cast<size_t>(n) * width);
+        if (validity) std::memset(validity + row, 1, n);
+      } else {
+        int64_t vi = 0;
+        for (int64_t k = 0; k < n; k++) {
+          if (pd.defs[k] != 0) {
+            std::memcpy(dst + (row + k) * width, pd.values + vi * width, width);
+            vi++;
+          } else {
+            std::memset(dst + (row + k) * width, 0, width);
+          }
+          if (validity) validity[row + k] = pd.defs[k] != 0;
+        }
+      }
+      row += n;
+    } else if (pd.encoding == E_RLE && se.type == T_BOOLEAN) {
+      // RLE boolean values (data page v2 writes booleans this way):
+      // 4-byte LE length prefix, then RLE/bit-packed hybrid at width 1
+      if (pd.values_len < 4) throw ThriftError("truncated RLE boolean page");
+      uint32_t rlen;
+      std::memcpy(&rlen, pd.values, 4);
+      if (pd.values_len < 4 + static_cast<size_t>(rlen))
+        throw ThriftError("truncated RLE boolean page body");
+      idx.assign(present, 0);
+      decode_rle_hybrid(pd.values + 4, pd.values + 4 + rlen, 1, present, idx.data());
+      int64_t vi = 0;
+      for (int64_t k = 0; k < n; k++) {
+        bool v = pd.defs.empty() || pd.defs[k] != 0;
+        dst[row + k] = v ? static_cast<uint8_t>(idx[vi++]) : 0;
+        if (validity) validity[row + k] = v;
+      }
+      row += n;
+    } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
+      if (!cur.dict) throw ThriftError("dictionary page missing");
+      if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
+      int bw = pd.values[0];
+      if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
+      if (static_cast<uint64_t>(cur.dict_count) * width > cur.dict_len)
+        throw ThriftError("truncated dictionary");  // header claims more entries than payload holds
+      idx.assign(present, 0);
+      decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
+      // hoist the bounds check out of the gather: one pass over the codes,
+      // then width-specialized branch-free copies (the per-value check +
+      // variable-width memcpy pair dominated dict-coded decode)
+      int32_t lo = 0, hi = -1;
+      for (int64_t k = 0; k < present; k++) {
+        lo = std::min(lo, idx[k]);
+        hi = std::max(hi, idx[k]);
+      }
+      if (present > 0 && (lo < 0 || hi >= cur.dict_count))
+        throw ThriftError("dictionary index out of range");
+      if (pd.defs.empty()) {
+        uint8_t* d = dst + row * width;
+        if (width == 8) {
+          for (int64_t k = 0; k < n; k++)
+            std::memcpy(d + k * 8, cur.dict + static_cast<int64_t>(idx[k]) * 8, 8);
+        } else if (width == 4) {
+          for (int64_t k = 0; k < n; k++)
+            std::memcpy(d + k * 4, cur.dict + static_cast<int64_t>(idx[k]) * 4, 4);
+        } else {
+          for (int64_t k = 0; k < n; k++)
+            std::memcpy(d + k * width, cur.dict + static_cast<int64_t>(idx[k]) * width, width);
+        }
+        if (validity) std::memset(validity + row, 1, n);
+      } else {
+        int64_t vi = 0;
+        for (int64_t k = 0; k < n; k++) {
+          bool v = pd.defs[k] != 0;
+          if (v) {
+            std::memcpy(dst + (row + k) * width,
+                        cur.dict + static_cast<int64_t>(idx[vi++]) * width, width);
+          } else {
+            std::memset(dst + (row + k) * width, 0, width);
+          }
+          if (validity) validity[row + k] = v;
+        }
+      }
+      row += n;
+    } else {
+      throw ThriftError("unsupported encoding " + std::to_string(pd.encoding));
+    }
+  }
+  return row;
+}
+
+// BYTE_ARRAY chunk. `offsets` points at this chunk's first row slot and
+// `offsets[0]` must already hold *nbytes (the running payload offset in the
+// shared `data` buffer, which is NOT pre-offset). With data == NULL only
+// offsets/validity are filled (sizing pass). Returns rows; advances *nbytes.
+static int64_t decode_binary_chunk(const Handle* h, const SchemaElement& se,
+                                   const ColumnMeta& cm, int64_t* offsets,
+                                   uint8_t* data, uint8_t* validity,
+                                   int64_t* nbytes) {
+  if (!codec_supported(cm.codec))
+    throw ThriftError("unsupported codec " + std::to_string(cm.codec));
+  ChunkCursor cur(h, &cm, se.repetition == 1);
+  PageData pd;
+  std::vector<int32_t> idx;
+  // dictionary spans: resolved lazily per chunk
+  std::vector<std::pair<const uint8_t*, uint32_t>> dict_spans;
+  bool dict_resolved = false;
+  int64_t row = 0;
+  while (next_data_page(cur, pd)) {
+    const int64_t n = pd.num_values;
+    int64_t present = n;
+    if (!pd.defs.empty()) {
+      present = 0;
+      for (int32_t d : pd.defs) present += (d != 0);
+    }
+    if (pd.encoding == E_PLAIN) {
+      const uint8_t* p = pd.values;
+      const uint8_t* bend = pd.values + pd.values_len;
+      int64_t vi = 0;
+      for (int64_t k = 0; k < n; k++) {
+        bool v = pd.defs.empty() || pd.defs[k] != 0;
+        uint32_t len = 0;
+        if (v) {
+          if (bend - p < 4) throw ThriftError("truncated byte array length");
+          std::memcpy(&len, p, 4);
+          p += 4;
+          if (static_cast<size_t>(bend - p) < len) throw ThriftError("truncated byte array");
+          if (data) std::memcpy(data + *nbytes, p, len);
+          p += len;
+          vi++;
+        }
+        *nbytes += len;
+        offsets[row + k + 1] = *nbytes;
+        if (validity) validity[row + k] = v;
+      }
+      row += n;
+    } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
+      if (!cur.dict) throw ThriftError("dictionary page missing");
+      if (!dict_resolved) {
+        dict_spans.clear();
+        const uint8_t* p = cur.dict;
+        // bound by the dictionary PAYLOAD length: a decompressed dict
+        // lives in heap scratch, so any file-offset bound (h->map +
+        // cur.end) is meaningless for it — comparing heap pointers
+        // against mmap offsets made decode fail or pass depending on
+        // address-space layout
+        const uint8_t* dend = cur.dict + cur.dict_len;
+        for (int64_t d = 0; d < cur.dict_count; d++) {
+          if (dend - p < 4) throw ThriftError("truncated dictionary");
+          uint32_t len;
+          std::memcpy(&len, p, 4);
+          p += 4;
+          if (static_cast<size_t>(dend - p) < len) throw ThriftError("truncated dictionary");
+          dict_spans.emplace_back(p, len);
+          p += len;
+        }
+        dict_resolved = true;
+      }
+      if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
+      int bw = pd.values[0];
+      if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
+      idx.assign(present, 0);
+      decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
+      int64_t vi = 0;
+      for (int64_t k = 0; k < n; k++) {
+        bool v = pd.defs.empty() || pd.defs[k] != 0;
+        uint32_t len = 0;
+        if (v) {
+          int32_t di = idx[vi++];
+          if (di < 0 || di >= (int32_t)dict_spans.size())
+            throw ThriftError("dictionary index out of range");
+          len = dict_spans[di].second;
+          if (data) std::memcpy(data + *nbytes, dict_spans[di].first, len);
+        }
+        *nbytes += len;
+        offsets[row + k + 1] = *nbytes;
+        if (validity) validity[row + k] = v;
+      }
+      row += n;
+    } else {
+      throw ThriftError("unsupported encoding " + std::to_string(pd.encoding));
+    }
+  }
+  return row;
+}
+
+// Dictionary codes for a fully dictionary-encoded chunk: codes[k] is the
+// dictionary index of row k, -1 for nulls. Any PLAIN page (dictionary
+// fallback overflow) throws — the caller falls back to value decode.
+static int64_t decode_codes_chunk(const Handle* h, const SchemaElement& se,
+                                  const ColumnMeta& cm, int32_t* codes) {
+  if (!codec_supported(cm.codec))
+    throw ThriftError("unsupported codec " + std::to_string(cm.codec));
+  ChunkCursor cur(h, &cm, se.repetition == 1);
+  PageData pd;
+  std::vector<int32_t> idx;
+  int64_t row = 0;
+  while (next_data_page(cur, pd)) {
+    const int64_t n = pd.num_values;
+    int64_t present = n;
+    if (!pd.defs.empty()) {
+      present = 0;
+      for (int32_t d : pd.defs) present += (d != 0);
+    }
+    if (pd.encoding != E_RLE_DICTIONARY && pd.encoding != E_PLAIN_DICTIONARY)
+      throw ThriftError("page not dictionary-encoded");
+    if (!cur.dict) throw ThriftError("dictionary page missing");
+    if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
+    int bw = pd.values[0];
+    if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
+    if (pd.defs.empty()) {
+      // required column: unpack straight into the caller's codes slab (no
+      // staging copy), then validate the whole page in one pass
+      decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, n, codes + row);
+      int32_t lo = 0, hi = -1;
+      for (int64_t k = 0; k < n; k++) {
+        lo = std::min(lo, codes[row + k]);
+        hi = std::max(hi, codes[row + k]);
+      }
+      if (n > 0 && (lo < 0 || hi >= cur.dict_count))
+        throw ThriftError("dictionary index out of range");
+    } else {
+      idx.assign(present, 0);
+      decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
+      int32_t lo = 0, hi = -1;
+      for (int64_t k = 0; k < present; k++) {
+        lo = std::min(lo, idx[k]);
+        hi = std::max(hi, idx[k]);
+      }
+      if (present > 0 && (lo < 0 || hi >= cur.dict_count))
+        throw ThriftError("dictionary index out of range");
+      int64_t vi = 0;
+      for (int64_t k = 0; k < n; k++)
+        codes[row + k] = pd.defs[k] != 0 ? idx[vi++] : -1;
+    }
+    row += n;
+  }
+  return row;
+}
+
+// Per-call error reporting for the row-group ABI: concurrent workers share
+// one Handle, so Handle::error (a std::string) is off limits there.
+static void fill_err(char* err, int32_t cap, const char* msg) {
+  if (!err || cap <= 0) return;
+  std::snprintf(err, static_cast<size_t>(cap), "%s", msg);
+}
+
+static const ColumnMeta* rg_column(Handle* h, int32_t rg, int32_t col,
+                                   const SchemaElement** se_out, char* err,
+                                   int32_t err_cap) {
+  if (col < 0 || col >= (int32_t)h->leaf_schema_idx.size()) {
+    fill_err(err, err_cap, "column index out of range");
+    return nullptr;
+  }
+  if (rg < 0 || rg >= (int32_t)h->meta.row_groups.size()) {
+    fill_err(err, err_cap, "row group index out of range");
+    return nullptr;
+  }
+  const auto& g = h->meta.row_groups[rg];
+  if (col >= (int32_t)g.columns.size()) {
+    fill_err(err, err_cap, "row group missing column");
+    return nullptr;
+  }
+  *se_out = &h->meta.schema[h->leaf_schema_idx[col]];
+  return &g.columns[col];
+}
+
 }  // namespace hsn
 
 // ---------------------------------------------------------------------------
@@ -784,7 +1120,6 @@ int64_t hsn_read_fixed(void* hp, int32_t col, void* out, uint8_t* validity) {
     return -1;
   }
   const auto& se = h->meta.schema[h->leaf_schema_idx[col]];
-  const bool optional = se.repetition == 1;
   const int width = se.type == T_BOOLEAN ? 1 : physical_width(se.type, se.type_length);
   if (width <= 0) {
     h->error = "not a fixed-width column";
@@ -795,102 +1130,8 @@ int64_t hsn_read_fixed(void* hp, int32_t col, void* out, uint8_t* validity) {
   try {
     for (const auto& rg : h->meta.row_groups) {
       if (col >= (int32_t)rg.columns.size()) throw ThriftError("row group missing column");
-      const ColumnMeta& cm = rg.columns[col];
-      if (!codec_supported(cm.codec))
-        throw ThriftError("unsupported codec " + std::to_string(cm.codec));
-      ChunkCursor cur(h, &cm, optional);
-      PageData pd;
-      std::vector<int32_t> idx;
-      while (next_data_page(cur, pd)) {
-        const int64_t n = pd.num_values;
-        int64_t present = n;
-        if (!pd.defs.empty()) {
-          present = 0;
-          for (int32_t d : pd.defs) present += (d != 0);
-        }
-        if (pd.encoding == E_PLAIN) {
-          if (se.type == T_BOOLEAN) {
-            // bit-packed LSB-first
-            std::vector<uint8_t> vals(present);
-            if (pd.values_len * 8 < static_cast<size_t>(present))
-              throw ThriftError("truncated boolean page");
-            for (int64_t k = 0; k < present; k++)
-              vals[k] = (pd.values[k >> 3] >> (k & 7)) & 1;
-            if (pd.defs.empty()) {
-              std::memcpy(dst + row * width, vals.data(), present);
-              if (validity) std::memset(validity + row, 1, n);
-            } else {
-              int64_t vi = 0;
-              for (int64_t k = 0; k < n; k++) {
-                bool v = pd.defs[k] != 0;
-                dst[(row + k)] = v ? vals[vi++] : 0;
-                if (validity) validity[row + k] = v;
-              }
-            }
-            row += n;
-            continue;
-          }
-          if (pd.values_len < static_cast<size_t>(present) * width)
-            throw ThriftError("truncated PLAIN page");
-          if (pd.defs.empty()) {
-            std::memcpy(dst + row * width, pd.values, static_cast<size_t>(n) * width);
-            if (validity) std::memset(validity + row, 1, n);
-          } else {
-            int64_t vi = 0;
-            for (int64_t k = 0; k < n; k++) {
-              if (pd.defs[k] != 0) {
-                std::memcpy(dst + (row + k) * width, pd.values + vi * width, width);
-                vi++;
-              } else {
-                std::memset(dst + (row + k) * width, 0, width);
-              }
-              if (validity) validity[row + k] = pd.defs[k] != 0;
-            }
-          }
-          row += n;
-        } else if (pd.encoding == E_RLE && se.type == T_BOOLEAN) {
-          // RLE boolean values (data page v2 writes booleans this way):
-          // 4-byte LE length prefix, then RLE/bit-packed hybrid at width 1
-          if (pd.values_len < 4) throw ThriftError("truncated RLE boolean page");
-          uint32_t rlen;
-          std::memcpy(&rlen, pd.values, 4);
-          if (pd.values_len < 4 + static_cast<size_t>(rlen))
-            throw ThriftError("truncated RLE boolean page body");
-          idx.assign(present, 0);
-          decode_rle_hybrid(pd.values + 4, pd.values + 4 + rlen, 1, present, idx.data());
-          int64_t vi = 0;
-          for (int64_t k = 0; k < n; k++) {
-            bool v = pd.defs.empty() || pd.defs[k] != 0;
-            dst[row + k] = v ? static_cast<uint8_t>(idx[vi++]) : 0;
-            if (validity) validity[row + k] = v;
-          }
-          row += n;
-        } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
-          if (!cur.dict) throw ThriftError("dictionary page missing");
-          if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
-          int bw = pd.values[0];
-          if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
-          if (static_cast<uint64_t>(cur.dict_count) * width > cur.dict_len)
-            throw ThriftError("truncated dictionary");  // header claims more entries than payload holds
-          idx.assign(present, 0);
-          decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
-          int64_t vi = 0;
-          for (int64_t k = 0; k < n; k++) {
-            bool v = pd.defs.empty() || pd.defs[k] != 0;
-            if (v) {
-              int32_t di = idx[vi++];
-              if (di < 0 || di >= cur.dict_count) throw ThriftError("dictionary index out of range");
-              std::memcpy(dst + (row + k) * width, cur.dict + static_cast<int64_t>(di) * width, width);
-            } else {
-              std::memset(dst + (row + k) * width, 0, width);
-            }
-            if (validity) validity[row + k] = v;
-          }
-          row += n;
-        } else {
-          throw ThriftError("unsupported encoding " + std::to_string(pd.encoding));
-        }
-      }
+      row += decode_fixed_chunk(h, se, rg.columns[col], width, dst + row * width,
+                                validity ? validity + row : nullptr);
     }
   } catch (const std::exception& e) {
     h->error = e.what();
@@ -915,103 +1156,181 @@ int64_t hsn_read_binary(void* hp, int32_t col, int64_t* offsets, uint8_t* data,
     h->error = "not a BYTE_ARRAY column";
     return -1;
   }
-  const bool optional = se.repetition == 1;
   int64_t row = 0;
   int64_t nbytes = 0;
   offsets[0] = 0;
   try {
     for (const auto& rg : h->meta.row_groups) {
       if (col >= (int32_t)rg.columns.size()) throw ThriftError("row group missing column");
-      const ColumnMeta& cm = rg.columns[col];
-      if (!codec_supported(cm.codec))
-        throw ThriftError("unsupported codec " + std::to_string(cm.codec));
-      ChunkCursor cur(h, &cm, optional);
-      PageData pd;
-      std::vector<int32_t> idx;
-      // dictionary spans: resolved lazily per chunk
-      std::vector<std::pair<const uint8_t*, uint32_t>> dict_spans;
-      bool dict_resolved = false;
-      while (next_data_page(cur, pd)) {
-        const int64_t n = pd.num_values;
-        int64_t present = n;
-        if (!pd.defs.empty()) {
-          present = 0;
-          for (int32_t d : pd.defs) present += (d != 0);
-        }
-        if (pd.encoding == E_PLAIN) {
-          const uint8_t* p = pd.values;
-          const uint8_t* bend = pd.values + pd.values_len;
-          int64_t vi = 0;
-          for (int64_t k = 0; k < n; k++) {
-            bool v = pd.defs.empty() || pd.defs[k] != 0;
-            uint32_t len = 0;
-            if (v) {
-              if (bend - p < 4) throw ThriftError("truncated byte array length");
-              std::memcpy(&len, p, 4);
-              p += 4;
-              if (static_cast<size_t>(bend - p) < len) throw ThriftError("truncated byte array");
-              if (data) std::memcpy(data + nbytes, p, len);
-              p += len;
-              vi++;
-            }
-            nbytes += len;
-            offsets[row + k + 1] = nbytes;
-            if (validity) validity[row + k] = v;
-          }
-          row += n;
-        } else if (pd.encoding == E_RLE_DICTIONARY || pd.encoding == E_PLAIN_DICTIONARY) {
-          if (!cur.dict) throw ThriftError("dictionary page missing");
-          if (!dict_resolved) {
-            dict_spans.clear();
-            const uint8_t* p = cur.dict;
-            // bound by the dictionary PAYLOAD length: a decompressed dict
-            // lives in heap scratch, so any file-offset bound (h->map +
-            // cur.end) is meaningless for it — comparing heap pointers
-            // against mmap offsets made decode fail or pass depending on
-            // address-space layout
-            const uint8_t* dend = cur.dict + cur.dict_len;
-            for (int64_t d = 0; d < cur.dict_count; d++) {
-              if (dend - p < 4) throw ThriftError("truncated dictionary");
-              uint32_t len;
-              std::memcpy(&len, p, 4);
-              p += 4;
-              if (static_cast<size_t>(dend - p) < len) throw ThriftError("truncated dictionary");
-              dict_spans.emplace_back(p, len);
-              p += len;
-            }
-            dict_resolved = true;
-          }
-          if (pd.values_len < 1) throw ThriftError("empty dictionary-encoded page");
-          int bw = pd.values[0];
-          if (bw < 0 || bw > 32) throw ThriftError("bad dictionary bit width");
-          idx.assign(present, 0);
-          decode_rle_hybrid(pd.values + 1, pd.values + pd.values_len, bw, present, idx.data());
-          int64_t vi = 0;
-          for (int64_t k = 0; k < n; k++) {
-            bool v = pd.defs.empty() || pd.defs[k] != 0;
-            uint32_t len = 0;
-            if (v) {
-              int32_t di = idx[vi++];
-              if (di < 0 || di >= (int32_t)dict_spans.size())
-                throw ThriftError("dictionary index out of range");
-              len = dict_spans[di].second;
-              if (data) std::memcpy(data + nbytes, dict_spans[di].first, len);
-            }
-            nbytes += len;
-            offsets[row + k + 1] = nbytes;
-            if (validity) validity[row + k] = v;
-          }
-          row += n;
-        } else {
-          throw ThriftError("unsupported encoding " + std::to_string(pd.encoding));
-        }
-      }
+      row += decode_binary_chunk(h, se, rg.columns[col], offsets + row, data,
+                                 validity ? validity + row : nullptr, &nbytes);
     }
   } catch (const std::exception& e) {
     h->error = e.what();
     return -1;
   }
   return row;
+}
+
+// ---------------------------------------------------------------------------
+// Row-group-granular ABI. One call decodes one (row group × column) chunk
+// into caller-provided buffers; the Python side offsets the output pointers
+// to the chunk's row slot, so a thread pool fans out across (file, row group,
+// column) tasks writing disjoint slices of shared per-column buffers. These
+// entry points never touch Handle::error — errors go to the per-call `err`
+// buffer (err_cap bytes) — so concurrent calls on one handle are safe.
+// ---------------------------------------------------------------------------
+
+int32_t hsn_num_row_groups(void* hp) {
+  return static_cast<int32_t>(static_cast<Handle*>(hp)->meta.row_groups.size());
+}
+
+int64_t hsn_rg_num_rows(void* hp, int32_t rg) {
+  auto* h = static_cast<Handle*>(hp);
+  if (rg < 0 || rg >= (int32_t)h->meta.row_groups.size()) return -1;
+  return h->meta.row_groups[rg].num_rows;
+}
+
+// Parquet codec id (0=uncompressed 1=snappy 2=gzip 6=zstd) of one chunk;
+// -1 when out of range. Feeds the hs_native_decode_total{codec} label.
+int32_t hsn_rg_codec(void* hp, int32_t rg, int32_t col) {
+  auto* h = static_cast<Handle*>(hp);
+  if (rg < 0 || rg >= (int32_t)h->meta.row_groups.size()) return -1;
+  const auto& g = h->meta.row_groups[rg];
+  if (col < 0 || col >= (int32_t)g.columns.size()) return -1;
+  return g.columns[col].codec;
+}
+
+// Fixed-width chunk decode; `out`/`validity` point at the chunk's first row.
+// Returns rows decoded or -1 (message in `err`).
+int64_t hsn_read_fixed_rg(void* hp, int32_t rg, int32_t col, void* out,
+                          uint8_t* validity, char* err, int32_t err_cap) {
+  auto* h = static_cast<Handle*>(hp);
+  const SchemaElement* se = nullptr;
+  const ColumnMeta* cm = rg_column(h, rg, col, &se, err, err_cap);
+  if (!cm) return -1;
+  const int width = se->type == T_BOOLEAN ? 1 : physical_width(se->type, se->type_length);
+  if (width <= 0) {
+    fill_err(err, err_cap, "not a fixed-width column");
+    return -1;
+  }
+  try {
+    return decode_fixed_chunk(h, *se, *cm, width, static_cast<uint8_t*>(out), validity);
+  } catch (const std::exception& e) {
+    fill_err(err, err_cap, e.what());
+    return -1;
+  }
+}
+
+// BYTE_ARRAY chunk decode with chunk-local offsets (offsets[0] = 0; must hold
+// chunk rows + 1 int64s). data == NULL sizes only. Returns rows or -1.
+int64_t hsn_read_binary_rg(void* hp, int32_t rg, int32_t col, int64_t* offsets,
+                           uint8_t* data, uint8_t* validity, char* err,
+                           int32_t err_cap) {
+  auto* h = static_cast<Handle*>(hp);
+  const SchemaElement* se = nullptr;
+  const ColumnMeta* cm = rg_column(h, rg, col, &se, err, err_cap);
+  if (!cm) return -1;
+  if (se->type != T_BYTE_ARRAY) {
+    fill_err(err, err_cap, "not a BYTE_ARRAY column");
+    return -1;
+  }
+  int64_t nbytes = 0;
+  offsets[0] = 0;
+  try {
+    return decode_binary_chunk(h, *se, *cm, offsets, data, validity, &nbytes);
+  } catch (const std::exception& e) {
+    fill_err(err, err_cap, e.what());
+    return -1;
+  }
+}
+
+// Dictionary codes for a fully dictionary-encoded chunk (codes[k] = dict
+// index, -1 = null). Fails — distinct "page not dictionary-encoded" message —
+// if any data page fell back to PLAIN, so callers can retry as values.
+int64_t hsn_read_codes_rg(void* hp, int32_t rg, int32_t col, int32_t* codes,
+                          char* err, int32_t err_cap) {
+  auto* h = static_cast<Handle*>(hp);
+  const SchemaElement* se = nullptr;
+  const ColumnMeta* cm = rg_column(h, rg, col, &se, err, err_cap);
+  if (!cm) return -1;
+  try {
+    return decode_codes_chunk(h, *se, *cm, codes);
+  } catch (const std::exception& e) {
+    fill_err(err, err_cap, e.what());
+    return -1;
+  }
+}
+
+// Dictionary entry count for a chunk: 0 when the chunk has no dictionary
+// page, -1 on error. Cheap — parses page headers up to the first data page.
+int64_t hsn_rg_dict_count(void* hp, int32_t rg, int32_t col, char* err,
+                          int32_t err_cap) {
+  auto* h = static_cast<Handle*>(hp);
+  const SchemaElement* se = nullptr;
+  const ColumnMeta* cm = rg_column(h, rg, col, &se, err, err_cap);
+  if (!cm) return -1;
+  if (!codec_supported(cm->codec)) {
+    fill_err(err, err_cap, "unsupported codec");
+    return -1;
+  }
+  try {
+    ChunkCursor cur(h, cm, se->repetition == 1);
+    PageData pd;
+    next_data_page(cur, pd);  // resolves a leading dictionary page if present
+    return cur.dict ? cur.dict_count : 0;
+  } catch (const std::exception& e) {
+    fill_err(err, err_cap, e.what());
+    return -1;
+  }
+}
+
+// BYTE_ARRAY dictionary payload for one chunk. `offsets` must hold
+// dict_count + 1 int64s; with data == NULL only offsets are filled (sizing
+// pass). Returns the entry count or -1.
+int64_t hsn_read_dict_binary_rg(void* hp, int32_t rg, int32_t col,
+                                int64_t* offsets, uint8_t* data, char* err,
+                                int32_t err_cap) {
+  auto* h = static_cast<Handle*>(hp);
+  const SchemaElement* se = nullptr;
+  const ColumnMeta* cm = rg_column(h, rg, col, &se, err, err_cap);
+  if (!cm) return -1;
+  if (se->type != T_BYTE_ARRAY) {
+    fill_err(err, err_cap, "not a BYTE_ARRAY column");
+    return -1;
+  }
+  if (!codec_supported(cm->codec)) {
+    fill_err(err, err_cap, "unsupported codec");
+    return -1;
+  }
+  try {
+    ChunkCursor cur(h, cm, se->repetition == 1);
+    PageData pd;
+    next_data_page(cur, pd);
+    if (!cur.dict) {
+      fill_err(err, err_cap, "no dictionary page");
+      return -1;
+    }
+    const uint8_t* p = cur.dict;
+    const uint8_t* dend = cur.dict + cur.dict_len;
+    int64_t nbytes = 0;
+    offsets[0] = 0;
+    for (int64_t d = 0; d < cur.dict_count; d++) {
+      if (dend - p < 4) throw ThriftError("truncated dictionary");
+      uint32_t len;
+      std::memcpy(&len, p, 4);
+      p += 4;
+      if (static_cast<size_t>(dend - p) < len) throw ThriftError("truncated dictionary");
+      if (data) std::memcpy(data + nbytes, p, len);
+      p += len;
+      nbytes += len;
+      offsets[d + 1] = nbytes;
+    }
+    return cur.dict_count;
+  } catch (const std::exception& e) {
+    fill_err(err, err_cap, e.what());
+    return -1;
+  }
 }
 
 // ---------------------------------------------------------------------------

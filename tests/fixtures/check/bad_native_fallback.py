@@ -1,0 +1,25 @@
+"""Seeded native-fallback violations: excepts around native decode calls
+that neither re-raise, classify, nor count hs_native_fallback_total —
+unaccounted pyarrow fallbacks."""
+
+
+def whole_file(native, path, cols, hints):
+    try:
+        return native.read_columns(path, cols, hints)
+    except Exception:
+        return None
+
+
+def per_chunk(handle, g, c, dst):
+    # narrow handlers are flagged too: the fallback itself must be counted
+    try:
+        handle.read_fixed_rg_into(g, c, dst)
+    except ValueError:
+        dst[...] = 0
+
+
+def dict_codes(handle, g, c):
+    try:
+        return handle.read_codes_rg(g, c)
+    except:  # noqa: E722
+        return None

@@ -1,0 +1,64 @@
+"""Clean native-fallback fixture: re-raises, classified swallows, counted
+fallbacks, pragma'd deliberate swallows, and excepts away from native
+decode all pass."""
+
+from hyperspace_tpu.reliability.errors import classify, count_io_error
+
+
+def _native_fallback_counter(reason):
+    class _C:
+        def inc(self, n=1):
+            pass
+
+    return _C()
+
+
+def reraises(native, path, cols, hints):
+    try:
+        return native.read_columns(path, cols, hints)
+    except Exception as exc:
+        raise classify(exc, path=path) from exc
+
+
+def counted_reroute(handle, g, c, dst):
+    try:
+        handle.read_fixed_rg_into(g, c, dst)
+        return True
+    except Exception:
+        _native_fallback_counter("dialect").inc()
+        return False
+
+
+def classified_swallow(handle, g, c):
+    try:
+        return handle.read_codes_rg(g, c)
+    except OSError as exc:
+        count_io_error("io.decode", exc, swallowed=True)
+        return None
+
+
+def inline_counter(registry, handle, g, c):
+    try:
+        return handle.read_dict_rg(g, c)
+    except Exception:
+        registry.counter(
+            "hs_native_fallback_total",
+            "decodes rerouted to pyarrow",
+            reason="dialect",
+        ).inc()
+        return None
+
+
+def deliberate(handle, g, c):
+    try:
+        return handle.read_codes_rg(g, c)
+    except Exception:  # hscheck: disable=native-fallback
+        return None
+
+
+def not_native(values):
+    # read_columns on a non-native receiver is out of scope
+    try:
+        return values.read_columns()
+    except Exception:
+        return None

@@ -144,6 +144,24 @@ class TestRulePairs:
         # starred calls (positions unknowable) all pass.
         assert lint_one(fixture("clean_donated_reuse.py"), "donated-buffer-reuse") == []
 
+    def test_native_fallback_bad(self):
+        found = lint_one(fixture("bad_native_fallback.py"), "native-fallback")
+        assert [f.line for f in found] == [9, 17, 24]
+        assert "hs_native_fallback_total" in found[0].message
+
+    def test_native_fallback_clean(self):
+        # Re-raises, classified swallows, counted fallbacks (helper and
+        # inline registration), pragmas, and read_columns on a non-native
+        # receiver all pass.
+        assert lint_one(fixture("clean_native_fallback.py"), "native-fallback") == []
+
+    def test_native_fallback_only_fires_under_exec(self):
+        from hyperspace_tpu.check.rules.native_fallback import _in_scope
+
+        assert _in_scope(os.path.join("hyperspace_tpu", "exec", "io.py"))
+        assert not _in_scope(os.path.join("hyperspace_tpu", "obs", "x.py"))
+        assert not _in_scope("bench.py")
+
     def test_donation_compiler_counts_as_jit_for_purity(self):
         # compile_stage(skeleton, fn, donate_argnums=...) jits fn — a host
         # numpy call inside fn must fire jit-purity just like jax.jit(fn)
@@ -205,6 +223,7 @@ class TestRunLint:
             "process-local-state",
             "trace-context-drop",
             "donated-buffer-reuse",
+            "native-fallback",
         }
 
     def test_default_scope_excludes_tests(self):

@@ -15,6 +15,13 @@ import pytest
 from hyperspace_tpu import native
 from hyperspace_tpu.exec.io import read_parquet_batch
 
+pytestmark = [
+    pytest.mark.native,
+    pytest.mark.skipif(
+        not native.is_available(), reason="native toolchain unavailable"
+    ),
+]
+
 
 @pytest.fixture(scope="module")
 def sample_table():

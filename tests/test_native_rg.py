@@ -1,0 +1,399 @@
+"""Native row-group fast path: parity oracle, zero-copy contract, dictionary
+shipping, mixed-dialect scans, and fault discipline.
+
+The fast path (exec/io.py ``_native_rg_scan``) decodes every surviving
+(file × row group × column) chunk in parallel straight into one
+bucket-padded buffer per column. Its contract is byte-identity with the
+pyarrow path under every dialect dimension the decoder claims — and an
+accounted fallback everywhere else. The whole module rides the ``native``
+tier-1 marker and skips cleanly when the C toolchain is absent.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.dataset as pads
+import pyarrow.parquet as pq
+import pytest
+
+import hyperspace_tpu as hst
+from hyperspace_tpu import native
+from hyperspace_tpu.exec import io as hio
+from hyperspace_tpu.exec.batch import DictBackedArray
+from hyperspace_tpu.exec.io import clear_io_cache, read_parquet_batch
+from hyperspace_tpu.obs.metrics import REGISTRY
+from hyperspace_tpu.plan.expr import col, lit
+
+pytestmark = [
+    pytest.mark.native,
+    pytest.mark.skipif(
+        not native.is_available(), reason="native toolchain unavailable"
+    ),
+]
+
+CODECS = ["NONE", "SNAPPY", "GZIP", "ZSTD"]
+
+
+@pytest.fixture(autouse=True)
+def _fresh_io_state():
+    """Cache entries and the module decode knobs are process-global; pin the
+    defaults around every test so legs cannot see each other's state."""
+    clear_io_cache()
+    hio.set_native_options(enabled=True, rowgroup=True, max_dict_entries=4096)
+    yield
+    clear_io_cache()
+    hio.set_native_options(enabled=True, rowgroup=True, max_dict_entries=4096)
+
+
+def _oracle_table(n=2400, null_runs=False):
+    """Every dtype the decoder claims; ``null_runs`` adds long NULL stretches
+    (whole row groups of nulls) on top of scattered ones."""
+    rng = np.random.default_rng(23)
+
+    def _mask(period, run):
+        m = np.zeros(n, dtype=bool)
+        if null_runs:
+            m[(np.arange(n) // run) % period == 0] = True  # long runs
+        m[rng.integers(0, n, n // 17)] = True  # scattered
+        return m
+
+    def _null(arr, m):
+        return pa.array([None if m[i] else v for i, v in enumerate(arr.tolist())])
+
+    i32 = rng.integers(-(2**31), 2**31 - 1, n).astype(np.int32)
+    i64 = rng.integers(-(10**12), 10**12, n).astype(np.int64)
+    f32 = rng.standard_normal(n).astype(np.float32)
+    f64 = rng.standard_normal(n)
+    date = (np.datetime64("2021-01-01") + rng.integers(0, 2000, n).astype("timedelta64[D]"))
+    ts = (np.datetime64("2020-01-01") + rng.integers(0, 10**6, n).astype("timedelta64[s]"))
+    s = [f"v{i % 311}" for i in range(n)]
+    cols = {
+        "k": pa.array(np.arange(n, dtype=np.int64)),  # sorted prune key
+        "i32": pa.array(i32),
+        "i64": pa.array(i64),
+        "f32": pa.array(f32),
+        "f64": pa.array(f64),
+        "date": pa.array(date.astype("datetime64[D]")),
+        "ts": pa.array(ts),
+        "s": pa.array(s),
+    }
+    if null_runs:
+        cols["ni"] = _null(i64, _mask(3, 300))
+        cols["nf"] = _null(f64, _mask(4, 300))
+        cols["ns"] = pa.array(
+            [None if m else v for m, v in zip(_mask(2, 300).tolist(), s)]
+        )
+    return pa.table(cols)
+
+
+def _assert_columns_equal(got, exp, label=""):
+    assert set(got) == set(exp), label
+    for c in got:
+        a, b = np.asarray(got[c]), np.asarray(exp[c])
+        assert a.dtype == b.dtype, (label, c, a.dtype, b.dtype)
+        if a.dtype == object:
+            assert len(a) == len(b), (label, c)
+            for x, y in zip(a, b):
+                assert (x is None and y is None) or x == y, (label, c)
+        else:
+            # NaN/NaT compare equal under assert_array_equal
+            np.testing.assert_array_equal(a, b, err_msg=f"{label}:{c}")
+
+
+def _two_leg_read(files, columns=None, predicate=None):
+    """The oracle harness: the same read with the fast path on and with
+    native decode entirely off (pure pyarrow), caches cleared between."""
+    clear_io_cache()
+    hio.set_native_options(enabled=True, rowgroup=True)
+    fast = read_parquet_batch(list(files), columns, predicate=predicate)
+    clear_io_cache()
+    hio.set_native_options(enabled=False)
+    slow = read_parquet_batch(list(files), columns, predicate=predicate)
+    return fast, slow
+
+
+class TestOracleMatrix:
+    @pytest.mark.parametrize("codec", CODECS)
+    def test_all_dtypes(self, tmp_path, codec):
+        t = _oracle_table()
+        p = str(tmp_path / f"m_{codec}.parquet")
+        pq.write_table(t, p, compression=codec, row_group_size=500)
+        fast, slow = _two_leg_read([p], t.column_names)
+        _assert_columns_equal(fast, slow, codec)
+
+    @pytest.mark.parametrize("codec", CODECS)
+    def test_null_runs(self, tmp_path, codec):
+        t = _oracle_table(null_runs=True)
+        p = str(tmp_path / f"n_{codec}.parquet")
+        pq.write_table(t, p, compression=codec, row_group_size=400)
+        fast, slow = _two_leg_read([p], t.column_names)
+        _assert_columns_equal(fast, slow, codec)
+
+    @pytest.mark.parametrize("codec", CODECS)
+    def test_pruned_rowgroup_subsets(self, tmp_path, codec):
+        t = _oracle_table()
+        files = []
+        for i in range(2):
+            p = str(tmp_path / f"p{i}_{codec}.parquet")
+            pq.write_table(t, p, compression=codec, row_group_size=400)
+            files.append(p)
+        # k is sorted 0..n: the predicate survives exactly rows < 900
+        # (row groups 0-2 of 6 per file)
+        pred = col("k") < lit(900)
+        fast, slow = _two_leg_read(files, t.column_names, predicate=pred)
+        _assert_columns_equal(fast, slow, codec)
+        assert np.asarray(fast["k"]).max() < 1200  # pruning actually dropped RGs
+
+    def test_multi_file_concat(self, tmp_path):
+        t = _oracle_table()
+        files = []
+        for i in range(3):
+            p = str(tmp_path / f"c{i}.parquet")
+            pq.write_table(t.slice(i * 800, 800), p, compression="SNAPPY",
+                           row_group_size=300)
+            files.append(p)
+        fast, slow = _two_leg_read(files, t.column_names)
+        _assert_columns_equal(fast, slow, "concat")
+        assert len(np.asarray(fast["k"])) == 2400
+
+    def test_decode_metrics_and_trace(self, tmp_path):
+        from hyperspace_tpu.exec import trace
+
+        t = _oracle_table()
+        p = str(tmp_path / "metrics.parquet")
+        pq.write_table(t, p, compression="ZSTD", row_group_size=600)
+        decoded = REGISTRY.counter("hs_native_decode_total", codec="zstd").value
+        nbytes = REGISTRY.counter("hs_native_decode_bytes_total").value
+        clear_io_cache()
+        with trace.recording() as events:
+            read_parquet_batch([p], t.column_names)
+        assert ("decode", "native-rg") in events
+        assert REGISTRY.counter("hs_native_decode_total", codec="zstd").value == (
+            decoded + 4 * len(t.column_names)  # 4 row groups x every column
+        )
+        assert REGISTRY.counter("hs_native_decode_bytes_total").value > nbytes
+
+
+class TestZeroCopy:
+    def test_decode_buffer_ships_pointer_identical(self, tmp_path):
+        """The exact numpy buffer the C decoder wrote is what device staging
+        pads to — no host copy between decode and device_put."""
+        from hyperspace_tpu.exec import device as D
+
+        hio.set_staging_pad(8)
+        t = pa.table({"a": pa.array(np.arange(1000, dtype=np.int64)),
+                      "x": pa.array(np.arange(1000, dtype=np.float64))})
+        p = str(tmp_path / "zc.parquet")
+        pq.write_table(t, p, compression="NONE", row_group_size=250)
+        b = read_parquet_batch([p], ["a", "x"])
+        for c, fill in (("a", 0), ("x", np.nan)):
+            arr = b[c]
+            assert arr.base is not None and arr.base.shape == (4096,), c
+            enc, _codec = D.encode_column(arr)
+            assert enc is arr, c  # encode is a no-op view, not a copy
+            padded = D._pad_to_bucket(enc, 8, fill)
+            assert padded is arr.base, c  # staging adopts the decoder's buffer
+
+    def test_adoption_rejects_garbage_tail(self):
+        """A coincidentally-shaped view whose base tail is NOT the fill value
+        must be copied, never adopted — the tail would leak into the device
+        column."""
+        from hyperspace_tpu.exec import device as D
+
+        base = np.full(4096, 7, dtype=np.int64)  # tail != 0
+        view = base[:1000]
+        padded = D._pad_to_bucket(view, 8, 0)
+        assert padded is not base
+        assert (padded[1000:] == 0).all()
+
+
+class TestDictionaryShipping:
+    def test_strings_come_back_dict_backed(self, tmp_path):
+        t = pa.table({"s": pa.array([f"cat{i % 7}" for i in range(2000)])})
+        p = str(tmp_path / "d.parquet")
+        pq.write_table(t, p, compression="SNAPPY", row_group_size=500)
+        b = read_parquet_batch([p], ["s"])
+        arr = b["s"]
+        assert isinstance(arr, DictBackedArray)
+        assert arr.hs_dict_codes is not None and arr.hs_dict_codes.dtype == np.int32
+        assert sorted(arr.hs_dict_uniques) == sorted({f"cat{i}" for i in range(7)})
+        # expanded values equal the codes gathered through the dictionary
+        exp = arr.hs_dict_uniques[arr.hs_dict_codes]
+        assert all(a == b_ for a, b_ in zip(arr, exp))
+
+    def test_max_dict_entries_gate(self, tmp_path):
+        t = pa.table({"s": pa.array([f"cat{i % 7}" for i in range(1000)])})
+        p = str(tmp_path / "g.parquet")
+        pq.write_table(t, p, compression="NONE", row_group_size=500)
+        hio.set_native_options(max_dict_entries=3)  # dict of 7 > 3: no shipping
+        b = read_parquet_batch([p], ["s"])
+        assert not isinstance(b["s"], DictBackedArray)
+        assert b["s"][13] == "cat6"
+
+    def test_dict_expand_on_device_matches_and_passes_contract(self, tmp_path):
+        """Decode → stage → fused on-device expansion, end to end: a device
+        filter over a dict-shipped string column masks identically to host
+        evaluation, dispatches the dict-expand program, and violates no
+        registered HLO contract (HS_CHECK_HLO semantics)."""
+        from hyperspace_tpu.check import hlo_lint
+        from hyperspace_tpu.exec import device as D
+        from hyperspace_tpu.plan.expr import as_bool_mask
+
+        rng = np.random.default_rng(5)
+        t = pa.table({
+            "s": pa.array([f"cat{j % 5}" for j in range(3000)]),
+            "a": pa.array(rng.integers(0, 3000, 3000).astype(np.int64)),
+        })
+        p = str(tmp_path / "f.parquet")
+        pq.write_table(t, p, row_group_size=500)
+
+        hlo_lint.reset_runtime_state()
+        sess = hst.Session(conf={hst.keys.CHECK_HLO_ENABLED: True})
+        batch = read_parquet_batch([p], ["s", "a"])
+        assert isinstance(batch["s"], DictBackedArray)  # shipped, not strings
+
+        cond = (col("s") == lit("cat3")) & (col("a") >= lit(1000))
+        before = REGISTRY.counter(
+            "hs_device_dispatches_total", program="dict-expand"
+        ).value
+        mask = D.device_filter_mask(sess, batch, cond)
+        after = REGISTRY.counter(
+            "hs_device_dispatches_total", program="dict-expand"
+        ).value
+        assert after == before + 1  # the fused expansion actually ran
+        assert hlo_lint.runtime_violations() == []
+
+        exp = as_bool_mask(cond.eval(batch))
+        np.testing.assert_array_equal(np.asarray(mask), exp)
+        assert exp.sum() > 0  # the predicate selected something real
+
+
+class TestMixedDialects:
+    def test_native_plus_schema_evolved(self, tmp_path):
+        """One native-dialect file + one schema-evolved file (missing column)
+        in the same scan: the native file takes the fast path, the evolved one
+        decodes through pyarrow against the unified schema, and the result is
+        identical to a pure dataset read."""
+        t1 = pa.table({"a": pa.array(np.arange(1000, dtype=np.int64)),
+                       "b": pa.array(np.arange(1000, dtype=np.float64))})
+        t2 = pa.table({"a": pa.array(np.arange(1000, 1600, dtype=np.int64))})
+        p1, p2 = str(tmp_path / "full.parquet"), str(tmp_path / "old.parquet")
+        pq.write_table(t1, p1, row_group_size=250)
+        pq.write_table(t2, p2, row_group_size=250)
+
+        evolved_before = REGISTRY.counter(
+            "hs_native_fallback_total", reason="schema-evolved"
+        ).value
+        got = read_parquet_batch([p1, p2], ["a", "b"])
+        assert REGISTRY.counter(
+            "hs_native_fallback_total", reason="schema-evolved"
+        ).value == evolved_before + 1
+
+        ds = pads.dataset([p1, p2], format="parquet")
+        exp = ds.to_table(columns=["a", "b"])
+        assert np.array_equal(got["a"], exp["a"].to_numpy())
+        # the missing column null-fills: dataset semantics exactly
+        exp_b = exp["b"].to_numpy(zero_copy_only=False)
+        assert got["b"].dtype == exp_b.dtype
+        np.testing.assert_array_equal(got["b"], exp_b)
+
+    def test_unsupported_file_rides_along(self, tmp_path):
+        """A same-schema file outside the native dialect (unsupported codec)
+        must not poison the scan: it falls back per file, counted, and the
+        batch is still exactly right."""
+        t = pa.table({"a": pa.array(np.arange(800, dtype=np.int64))})
+        p1, p2 = str(tmp_path / "n.parquet"), str(tmp_path / "lz4.parquet")
+        pq.write_table(t, p1, compression="NONE")
+        try:
+            pq.write_table(t, p2, compression="LZ4")
+        except Exception:
+            pytest.skip("pyarrow built without LZ4")
+        dialect_before = REGISTRY.counter(
+            "hs_native_fallback_total", reason="dialect"
+        ).value
+        got = read_parquet_batch([p1, p2], ["a"])
+        assert np.array_equal(
+            got["a"], np.concatenate([np.arange(800), np.arange(800)])
+        )
+        assert (
+            REGISTRY.counter("hs_native_fallback_total", reason="dialect").value
+            > dialect_before
+        )
+
+
+@pytest.mark.faults
+class TestNativeFaultSeam:
+    @pytest.fixture(autouse=True)
+    def _clean_faults(self):
+        from hyperspace_tpu.reliability.faults import FAULTS
+
+        yield
+        FAULTS.clear()
+
+    def _write(self, tmp_path):
+        t = pa.table({"a": pa.array(np.arange(1200, dtype=np.int64))})
+        p = str(tmp_path / "f.parquet")
+        pq.write_table(t, p, row_group_size=300)
+        return p
+
+    def test_corrupt_fault_surfaces_typed_and_strikes_quarantine(self, tmp_path):
+        from hyperspace_tpu.reliability.degrade import QUARANTINE
+        from hyperspace_tpu.reliability.errors import CorruptDataError
+        from hyperspace_tpu.reliability.faults import FaultRule, fault_scope
+
+        # quarantine attributes strikes through the index layout
+        # <system.path>/<name>/...: put the file under one
+        idx = tmp_path / "indexes" / "idx1"
+        idx.mkdir(parents=True)
+        p = self._write(idx)
+        hst.Session(conf={
+            hst.keys.SYSTEM_PATH: str(tmp_path / "indexes"),
+            hst.keys.RELIABILITY_QUARANTINE_ENABLED: True,
+        })
+        try:
+            with fault_scope(FaultRule("io.decode", "corrupt", nth=1)):
+                with pytest.raises(CorruptDataError):
+                    read_parquet_batch([p], ["a"])
+            assert QUARANTINE.local_strikes().get("idx1", 0) >= 1
+        finally:
+            QUARANTINE.enabled = False
+            QUARANTINE._breakers = {}
+
+    def test_transient_fault_falls_back_without_wrong_answer(self, tmp_path):
+        from hyperspace_tpu.reliability.faults import FaultRule, fault_scope
+
+        p = self._write(tmp_path)
+        swallowed = REGISTRY.counter("hs_native_fallback_total", reason="io-error").value
+        with fault_scope(FaultRule("io.decode", "transient", nth=1)):
+            got = read_parquet_batch([p], ["a"])
+        # the consumed fault is recorded, and the answer is still exact
+        assert (
+            REGISTRY.counter("hs_native_fallback_total", reason="io-error").value
+            == swallowed + 1
+        )
+        assert np.array_equal(got["a"], np.arange(1200))
+
+
+class TestKillSwitches:
+    def test_env_kill_switch(self, tmp_path, monkeypatch):
+        from hyperspace_tpu.exec import trace
+
+        t = pa.table({"a": pa.array(np.arange(500, dtype=np.int64))})
+        p = str(tmp_path / "k.parquet")
+        pq.write_table(t, p, compression="NONE")
+        monkeypatch.setenv("HS_NATIVE_RG", "0")
+        with trace.recording() as events:
+            got = read_parquet_batch([p], ["a"])
+        assert ("decode", "native-rg") not in events
+        assert np.array_equal(got["a"], np.arange(500))
+
+    def test_conf_keys_reach_the_knobs(self, tmp_path):
+        sess = hst.Session(conf={
+            hst.keys.EXEC_IO_NATIVE_ROWGROUP: False,
+            hst.keys.EXEC_IO_NATIVE_MAX_DICT: 17,
+        })
+        assert sess.conf.io_native_rowgroup is False
+        assert sess.conf.io_native_max_dict_entries == 17
+        assert hio._NATIVE_RG is False
+        assert hio._MAX_DICT == 17
